@@ -1,0 +1,22 @@
+"""Test harness: force an 8-device CPU mesh before JAX initializes.
+
+This is the JAX-native answer to "test multi-device without a cluster"
+(SURVEY.md §4): every test sees 8 virtual devices, so dp/fsdp/tp sharding
+paths are exercised on any machine, matching how the driver dry-runs the
+multi-chip path.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,73 @@
+import pytest
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import (
+    default_ilql_config,
+    default_ppo_config,
+    default_rft_config,
+    default_sft_config,
+)
+from trlx_tpu.data.method_configs import ILQLConfig, PPOConfig, get_method
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [default_ppo_config, default_ilql_config, default_sft_config, default_rft_config],
+)
+def test_roundtrip(factory):
+    cfg = factory()
+    again = TRLConfig.from_dict(cfg.to_dict())
+    assert again.to_dict() == cfg.to_dict()
+
+
+def test_yaml_roundtrip(tmp_path):
+    import yaml
+
+    cfg = default_ppo_config()
+    p = tmp_path / "cfg.yml"
+    p.write_text(yaml.safe_dump(cfg.to_dict()))
+    loaded = TRLConfig.load_yaml(str(p))
+    assert loaded.method.cliprange == cfg.method.cliprange
+    assert loaded.train.batch_size == cfg.train.batch_size
+
+
+def test_evolve_deep_merge():
+    cfg = default_ilql_config()
+    new = cfg.evolve(method=dict(gamma=0.5, gen_kwargs=dict(max_new_tokens=7)))
+    assert new.method.gamma == 0.5
+    assert new.method.gen_kwargs["max_new_tokens"] == 7
+    # untouched siblings preserved
+    assert new.method.gen_kwargs["top_k"] == cfg.method.gen_kwargs["top_k"]
+    assert cfg.method.gamma == 0.99  # original untouched
+
+
+def test_update_dotted_paths():
+    cfg = default_ppo_config()
+    new = TRLConfig.update(cfg, {"train.seed": 7, "method.gamma": 0.9})
+    assert new.train.seed == 7
+    assert new.method.gamma == 0.9
+
+
+def test_update_unknown_path_raises():
+    cfg = default_ppo_config()
+    with pytest.raises(ValueError, match="not present"):
+        TRLConfig.update(cfg, {"train.does_not_exist": 1})
+
+
+def test_unknown_section_key_raises():
+    d = default_ppo_config().to_dict()
+    d["model"]["bogus_key"] = 1
+    with pytest.raises(ValueError, match="unknown keys"):
+        TRLConfig.from_dict(d)
+
+
+def test_method_registry():
+    assert get_method("ppoconfig") is PPOConfig
+    assert get_method("ILQLConfig") is ILQLConfig
+    with pytest.raises(ValueError):
+        get_method("nope")
+
+
+def test_mesh_defaults():
+    cfg = default_ppo_config()
+    assert cfg.train.mesh == {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1}

@@ -1,0 +1,214 @@
+"""Unit tests for the pure numerics, checked against independent NumPy
+implementations of the same formulas (reference test analog:
+tests/test_utils.py:95-112 RunningMoments, hypothesis index tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.ops import (
+    batched_index_select,
+    gae_advantages_and_returns,
+    get_tensor_stats,
+    logprobs_of_labels,
+    ppo_loss,
+    running_moments_init,
+    running_moments_update,
+    topk_mask,
+    whiten,
+)
+
+
+def np_gae(values, rewards, gamma, lam):
+    B, T = values.shape
+    advs = np.zeros_like(values)
+    lastgaelam = np.zeros(B)
+    for t in reversed(range(T)):
+        nextv = values[:, t + 1] if t < T - 1 else 0.0
+        delta = rewards[:, t] + gamma * nextv - values[:, t]
+        lastgaelam = delta + gamma * lam * lastgaelam
+        advs[:, t] = lastgaelam
+    return advs
+
+
+def test_gae_matches_loop(rng):
+    values = rng.normal(size=(4, 9)).astype(np.float32)
+    rewards = rng.normal(size=(4, 9)).astype(np.float32)
+    adv, ret = gae_advantages_and_returns(
+        jnp.array(values), jnp.array(rewards), gamma=0.98, lam=0.9, use_whitening=False
+    )
+    expected = np_gae(values, rewards, 0.98, 0.9)
+    np.testing.assert_allclose(np.asarray(adv), expected, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), expected + values, rtol=1e-5, atol=1e-5)
+
+
+def test_gae_whitening(rng):
+    values = rng.normal(size=(8, 5)).astype(np.float32)
+    rewards = rng.normal(size=(8, 5)).astype(np.float32)
+    adv, _ = gae_advantages_and_returns(
+        jnp.array(values), jnp.array(rewards), gamma=1.0, lam=0.95, use_whitening=True
+    )
+    assert abs(float(adv.mean())) < 1e-5
+    assert abs(float(adv.std()) - 1.0) < 1e-2
+
+
+def test_whiten(rng):
+    xs = jnp.array(rng.normal(loc=3.0, scale=2.0, size=(128,)).astype(np.float32))
+    w = whiten(xs)
+    assert abs(float(w.mean())) < 1e-5
+    assert abs(float(w.std()) - 1.0) < 1e-2
+    w2 = whiten(xs, shift_mean=False)
+    np.testing.assert_allclose(float(w2.mean()), float(xs.mean()), rtol=1e-4)
+
+
+def test_logprobs_of_labels(rng):
+    logits = jnp.array(rng.normal(size=(2, 5, 11)).astype(np.float32))
+    labels = jnp.array(rng.integers(0, 11, size=(2, 5)))
+    out = logprobs_of_labels(logits, labels)
+    ref = jax.nn.log_softmax(logits, axis=-1)
+    expected = np.take_along_axis(np.asarray(ref), np.asarray(labels)[..., None], axis=-1)[..., 0]
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_topk_mask(rng):
+    xs = jnp.array(rng.normal(size=(3, 10)).astype(np.float32))
+    masked = topk_mask(xs, 4)
+    finite = np.isfinite(np.asarray(masked))
+    assert (finite.sum(-1) >= 4).all()  # ties can keep more than k
+    # top-4 values survive
+    top4 = np.sort(np.asarray(xs), axis=-1)[:, -4:]
+    for b in range(3):
+        for v in top4[b]:
+            assert v in np.asarray(masked)[b]
+    assert topk_mask(xs, 100) is xs
+
+
+def test_batched_index_select(rng):
+    x = jnp.array(rng.normal(size=(2, 7, 3)).astype(np.float32))
+    idxs = jnp.array(rng.integers(0, 7, size=(2, 4)))
+    out = batched_index_select(x, idxs, dim=1)
+    assert out.shape == (2, 4, 3)
+    for b in range(2):
+        for i in range(4):
+            np.testing.assert_array_equal(
+                np.asarray(out)[b, i], np.asarray(x)[b, int(idxs[b, i])]
+            )
+
+
+def test_ppo_loss_zero_when_identical(rng):
+    """With ratio == 1 and values == returns the loss is purely the
+    advantage-weighted term: -mean(adv)."""
+    B, T = 3, 6
+    logprobs = jnp.array(rng.normal(size=(B, T)).astype(np.float32))
+    values = jnp.array(rng.normal(size=(B, T)).astype(np.float32))
+    adv = jnp.array(rng.normal(size=(B, T)).astype(np.float32))
+    mask = jnp.ones((B, T))
+    loss, stats = ppo_loss(
+        logprobs, values, logprobs, values, adv, values, mask,
+        cliprange=0.2, cliprange_value=0.2, vf_coef=1.0,
+    )
+    np.testing.assert_allclose(float(loss), float(-adv.mean()), rtol=1e-5, atol=1e-5)
+    assert float(stats["policy/approx_kl"]) == pytest.approx(0.0, abs=1e-6)
+    assert float(stats["policy/clipfrac"]) == pytest.approx(0.0, abs=1e-6)
+    assert float(stats["values/clipfrac"]) == pytest.approx(0.0, abs=1e-6)
+    assert float(stats["ratio"]) == pytest.approx(1.0, rel=1e-5)
+
+
+def test_ppo_loss_clipping_engages(rng):
+    B, T = 2, 4
+    old_logprobs = jnp.zeros((B, T))
+    logprobs = jnp.full((B, T), 1.0)  # ratio = e > 1.2 -> clips
+    values = jnp.zeros((B, T))
+    adv = jnp.ones((B, T))
+    mask = jnp.ones((B, T))
+    loss, stats = ppo_loss(
+        logprobs, values, old_logprobs, values, adv, values, mask,
+        cliprange=0.2, cliprange_value=0.2, vf_coef=0.0,
+    )
+    # pessimistic max picks the clipped branch: max(-e, -1.2) = -1.2
+    assert float(stats["policy/clipfrac"]) == pytest.approx(1.0)
+    np.testing.assert_allclose(float(loss), -1.2, rtol=1e-5)
+
+
+def test_ppo_loss_respects_mask(rng):
+    B, T = 2, 5
+    lp = jnp.array(rng.normal(size=(B, T)).astype(np.float32))
+    olp = jnp.array(rng.normal(size=(B, T)).astype(np.float32))
+    v = jnp.array(rng.normal(size=(B, T)).astype(np.float32))
+    ov = jnp.array(rng.normal(size=(B, T)).astype(np.float32))
+    adv = jnp.array(rng.normal(size=(B, T)).astype(np.float32))
+    ret = jnp.array(rng.normal(size=(B, T)).astype(np.float32))
+    mask = jnp.array([[1, 1, 0, 0, 0], [1, 1, 1, 0, 0]], dtype=jnp.float32)
+
+    loss1, _ = ppo_loss(lp, v, olp, ov, adv, ret, mask, 0.2, 0.2, 1.0)
+    # corrupt masked positions: loss must not change
+    noise = jnp.array(rng.normal(size=(B, T)).astype(np.float32)) * (1 - mask)
+    loss2, _ = ppo_loss(lp + noise, v + noise, olp, ov, adv, ret, mask, 0.2, 0.2, 1.0)
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-4)
+
+
+def test_running_moments_matches_numpy(rng):
+    state = running_moments_init()
+    chunks = [rng.normal(loc=2.0, scale=3.0, size=(37,)).astype(np.float32) for _ in range(5)]
+    for c in chunks:
+        state, bm, bs = running_moments_update(state, jnp.array(c))
+    allx = np.concatenate(chunks)
+    np.testing.assert_allclose(float(state.mean), allx.mean(), rtol=1e-4)
+    np.testing.assert_allclose(float(state.std), allx.std(ddof=1), rtol=1e-3)
+    # last batch stats
+    np.testing.assert_allclose(float(bm), chunks[-1].mean(), rtol=1e-4)
+    np.testing.assert_allclose(float(bs), chunks[-1].std(ddof=1), rtol=1e-3)
+
+
+def test_get_tensor_stats(rng):
+    xs = jnp.array([[1.0, 2.0, 100.0], [3.0, 4.0, -100.0]])
+    mask = jnp.array([[1.0, 1.0, 0.0], [1.0, 1.0, 0.0]])
+    stats = get_tensor_stats(xs, mask, mask.sum())
+    assert float(stats["mean"]) == pytest.approx(2.5)
+    assert float(stats["min"]) == 1.0
+    assert float(stats["max"]) == 4.0
+
+
+def test_ilql_loss_runs(rng):
+    from trlx_tpu.data import ILQLBatch
+    from trlx_tpu.ops import ilql_loss
+
+    B, T, V = 2, 8, 13
+    n_actions, n_states = 5, 6
+    qs = [jnp.array(rng.normal(size=(B, n_actions, V)).astype(np.float32)) for _ in range(2)]
+    tqs = [q + 0.1 for q in qs]
+    vs = jnp.array(rng.normal(size=(B, n_states, 1)).astype(np.float32))
+    logits = jnp.array(rng.normal(size=(B, n_actions, V)).astype(np.float32))
+    batch = ILQLBatch(
+        input_ids=jnp.array(rng.integers(0, V, size=(B, T))),
+        attention_mask=jnp.ones((B, T), dtype=jnp.int32),
+        rewards=jnp.array(rng.normal(size=(B, n_actions)).astype(np.float32)),
+        states_ixs=jnp.array(rng.integers(0, T - 1, size=(B, n_states))),
+        actions_ixs=jnp.array(np.sort(rng.integers(0, T - 1, size=(B, n_actions)), axis=-1)),
+        dones=jnp.ones((B, n_states), dtype=jnp.int32),
+    )
+    loss, stats = ilql_loss(
+        logits, qs, tqs, vs, batch,
+        tau=0.7, gamma=0.99, cql_scale=0.1, awac_scale=1.0, beta=0.0, two_qs=True,
+    )
+    assert np.isfinite(float(loss))
+    for key in ("losses/loss", "losses/loss_q", "losses/loss_v", "losses/loss_cql", "losses/loss_awac"):
+        assert key in stats
+
+
+def test_losses_are_jittable(rng):
+    B, T = 2, 4
+    args = [jnp.array(rng.normal(size=(B, T)).astype(np.float32)) for _ in range(6)]
+    mask = jnp.ones((B, T))
+    jitted = jax.jit(
+        lambda *a: ppo_loss(*a, cliprange=0.2, cliprange_value=0.2, vf_coef=1.0)
+    )
+    loss, _ = jitted(*args, mask)
+    assert np.isfinite(float(loss))
+
+    jit_gae = jax.jit(
+        lambda v, r: gae_advantages_and_returns(v, r, gamma=0.99, lam=0.95)
+    )
+    adv, ret = jit_gae(args[0], args[1])
+    assert adv.shape == (B, T)

@@ -1,0 +1,114 @@
+"""`trlx_tpu.train` — the single user entry point.
+
+Parity: /root/reference/trlx/trlx.py:15-143 — same signature and the same
+argument-driven algorithm selection: `reward_fn` -> online PPO,
+`rewards`/`dataset` -> offline ILQL, otherwise SFT.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import (
+    default_ilql_config,
+    default_ppo_config,
+    default_sft_config,
+)
+from trlx_tpu.utils import set_seed
+from trlx_tpu.utils.loading import get_pipeline, get_trainer
+
+
+def train(
+    model_path: Optional[str] = None,
+    reward_fn: Optional[Callable[[List[str], List[str], List[str]], List[float]]] = None,
+    dataset: Optional[Iterable[Tuple[str, float]]] = None,
+    samples: Optional[List[str]] = None,
+    rewards: Optional[List[float]] = None,
+    prompts: Optional[Union[List[str], List[Dict[str, Any]]]] = None,
+    eval_prompts: Optional[Union[List[str], List[Dict[str, Any]]]] = None,
+    metric_fn: Optional[Callable[[List[str], List[str], List[str]], Dict[str, List[float]]]] = None,
+    config: Optional[TRLConfig] = None,
+    stop_sequences: Optional[List[str]] = None,
+):
+    """Run online RL (PPO), offline RL (ILQL) or supervised fine-tuning,
+    selected by which arguments are provided.
+
+    reward_fn(samples, prompts, outputs, **metadata) -> list of scalar
+    rewards drives online training; (samples, rewards) drive offline
+    training; samples alone drive SFT.
+    """
+    if config is None:
+        warnings.warn(
+            "Passing the `config` argument implicitly is depreciated, use or"
+            "adapt some from `trlx_tpu/data/default_configs.py` instead"
+        )
+        if reward_fn:
+            config = default_ppo_config()
+        elif rewards:
+            config = default_ilql_config()
+        else:
+            config = default_sft_config()
+
+    set_seed(config.train.seed)
+
+    if dataset is not None:
+        warnings.warn("the `dataset` argument is being depreciated, split it into `samples` and `rewards` instead")
+        samples, rewards = dataset
+
+    if model_path:
+        config.model.model_path = model_path
+
+    trainer_cls = get_trainer(config.train.trainer)
+    trainer = trainer_cls(
+        config=config,
+        reward_fn=reward_fn,
+        metric_fn=metric_fn,
+        stop_sequences=stop_sequences or [],
+        **config.train.trainer_kwargs,
+    )
+
+    batch_size = config.train.batch_size
+    max_prompt_length = config.train.seq_length - config.method.gen_kwargs.get(
+        "max_new_tokens", 0
+    )
+
+    # --- online ----------------------------------------------------------
+    if reward_fn:
+        if prompts is None:
+            raise ValueError("`prompts` are required for online training")
+        if eval_prompts is None:
+            eval_prompts = prompts[:batch_size]
+
+        pipeline = get_pipeline(config.train.pipeline)(
+            prompts, max_prompt_length, trainer.tokenizer
+        )
+        trainer.add_prompt_pipeline(pipeline)
+
+    # --- offline RL ------------------------------------------------------
+    elif rewards is not None:
+        if samples is None:
+            raise ValueError("`samples` are required alongside `rewards`")
+        if eval_prompts is None:
+            eval_prompts = [trainer.tokenizer.bos_token] * batch_size
+        trainer.make_experience(samples, rewards, config.train.seq_length)
+
+    # --- supervised ------------------------------------------------------
+    else:
+        if samples is None:
+            raise ValueError("Either `samples`, `rewards` or `reward_fn` must be given")
+        if eval_prompts is None:
+            eval_prompts = [trainer.tokenizer.bos_token] * batch_size
+        trainer.make_experience(samples, None, config.train.seq_length)
+
+    eval_pipeline = get_pipeline(config.train.pipeline)(
+        eval_prompts, max_prompt_length, trainer.tokenizer
+    )
+    trainer.add_eval_pipeline(eval_pipeline)
+
+    if config.train.resume_from_checkpoint:
+        trainer.load(config.train.resume_from_checkpoint)
+
+    trainer.learn()
+    return trainer

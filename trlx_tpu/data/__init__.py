@@ -1,0 +1,85 @@
+"""Typed batch elements as JAX pytrees.
+
+Parity: /root/reference/trlx/data/__init__.py, ppo_types.py, ilql_types.py.
+The reference moves lists of per-sample tensors between pipeline and
+trainer and needed ad-hoc dataclass<->tensor-list flattening for the NeMo
+transport (SURVEY.md §2.3 — broken in the fork). Here every batch type is
+a `flax.struct.dataclass`, i.e. a real pytree: jit/pjit/shard_map move
+them natively, no bridging code.
+
+All arrays carry static padded shapes (XLA requirement).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import flax.struct
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class PromptBatch:
+    """A batch of tokenized prompts, left-padded to a fixed length."""
+
+    input_ids: jnp.ndarray  # [batch, prompt_len] int32
+    attention_mask: jnp.ndarray  # [batch, prompt_len] int32 (1 = real token)
+    # host-side metadata (per-prompt dicts forwarded to reward_fn);
+    # pytree-static so it never touches the device
+    metadata: Any = flax.struct.field(pytree_node=False, default=None)
+
+
+@flax.struct.dataclass
+class PPORolloutBatch:
+    """Batched PPO experience (parity: reference ppo_types.py:6-63).
+
+    The reference stores ragged per-sample tensors and pads at collate
+    time (ppo_pipeline.py:14-50); here rollouts are born padded: queries
+    left-padded to max_prompt_len, responses right-padded to
+    max_new_tokens, so the whole store is one pytree of rectangular
+    arrays that lives on device end-to-end.
+    """
+
+    query_tensors: jnp.ndarray  # [batch, prompt_len] int32, left-padded
+    response_tensors: jnp.ndarray  # [batch, resp_len] int32, right-padded
+    logprobs: jnp.ndarray  # [batch, resp_len] f32, per response token
+    values: jnp.ndarray  # [batch, resp_len] f32
+    rewards: jnp.ndarray  # [batch, resp_len] f32 (KL penalty + terminal score)
+    response_mask: jnp.ndarray  # [batch, resp_len] f32 (1 = real response token)
+
+
+@flax.struct.dataclass
+class ILQLBatch:
+    """Batched ILQL experience (parity: reference ilql_types.py:7-139)."""
+
+    input_ids: jnp.ndarray  # [batch, seq] int32
+    attention_mask: jnp.ndarray  # [batch, seq] int32
+    rewards: jnp.ndarray  # [batch, n_actions] f32
+    states_ixs: jnp.ndarray  # [batch, n_states] int32
+    actions_ixs: jnp.ndarray  # [batch, n_actions] int32
+    dones: jnp.ndarray  # [batch, n_states] int32
+
+
+@flax.struct.dataclass
+class ILQLSeq2SeqBatch:
+    """ILQL batch for encoder-decoder models."""
+
+    input_ids: jnp.ndarray
+    attention_mask: jnp.ndarray
+    decoder_input_ids: jnp.ndarray
+    rewards: jnp.ndarray
+    states_ixs: jnp.ndarray
+    actions_ixs: jnp.ndarray
+    dones: jnp.ndarray
+
+
+@flax.struct.dataclass
+class SFTBatch:
+    """Supervised batch; labels use -100 to mask prompt/pad positions."""
+
+    input_ids: jnp.ndarray  # [batch, seq] int32
+    attention_mask: jnp.ndarray  # [batch, seq] int32
+    labels: jnp.ndarray  # [batch, seq] int32, -100 = ignored
+
+    # decoder side for seq2seq SFT; None for causal
+    decoder_input_ids: Optional[jnp.ndarray] = None

@@ -1,0 +1,151 @@
+"""Method (algorithm) hyperparameter configs and their registry.
+
+Parity: /root/reference/trlx/data/method_configs.py:9-56 (registry semantics),
+/root/reference/trlx/models/modeling_ppo.py:73-238 (PPOConfig fields),
+/root/reference/trlx/models/modeling_ilql.py:48-93 (ILQLConfig fields),
+/root/reference/trlx/trainer/accelerate_sft_trainer.py:16-26 (SFTConfig),
+/root/reference/trlx/trainer/accelerate_rft_trainer.py:18-44 (RFTConfig).
+
+Unlike the reference, the loss functions themselves are pure jittable
+functions in :mod:`trlx_tpu.ops`; the dataclasses here only carry
+hyperparameters (and thin `.loss` delegates for API familiarity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+_METHODS: Dict[str, type] = {}
+
+
+def register_method(name_or_cls):
+    """Register a method config class under a lowercase name (decorator)."""
+
+    def _register(cls, name: str):
+        _METHODS[name.lower()] = cls
+        return cls
+
+    if isinstance(name_or_cls, str):
+        return lambda cls: _register(cls, name_or_cls)
+    return _register(name_or_cls, name_or_cls.__name__)
+
+
+def get_method(name: str) -> type:
+    try:
+        return _METHODS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown method {name!r}; registered: {sorted(_METHODS)}"
+        ) from None
+
+
+def _fields_only(cls, config: Dict[str, Any]) -> Dict[str, Any]:
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(config) - known
+    if unknown:
+        raise ValueError(f"{cls.__name__}: unknown config keys {sorted(unknown)}")
+    return {k: v for k, v in config.items() if k in known}
+
+
+@dataclass
+@register_method
+class MethodConfig:
+    """Base config for an RL method; `name` selects the registry entry."""
+
+    name: str
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**_fields_only(cls, config))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+@register_method
+class PPOConfig(MethodConfig):
+    """PPO hyperparameters (field parity with reference modeling_ppo.py:73-238)."""
+
+    ppo_epochs: int = 4
+    num_rollouts: int = 128
+    chunk_size: int = 128
+    init_kl_coef: float = 0.05
+    target: Optional[float] = None
+    horizon: int = 10000
+    gamma: float = 1.0
+    lam: float = 0.95
+    cliprange: float = 0.2
+    cliprange_value: float = 0.2
+    vf_coef: float = 1.0
+    scale_reward: Optional[str] = "ignored"
+    ref_mean: Optional[float] = None
+    ref_std: Optional[float] = None
+    cliprange_reward: float = 10.0
+    gen_kwargs: dict = field(default_factory=lambda: dict(max_new_tokens=40))
+    gen_experience_kwargs: Optional[dict] = None
+    num_value_layers_unfrozen: int = 0
+
+    def get_advantages_and_returns(self, values, rewards, response_length, use_whitening=True):
+        from trlx_tpu.ops.ppo import gae_advantages_and_returns
+
+        return gae_advantages_and_returns(
+            values, rewards, gamma=self.gamma, lam=self.lam, use_whitening=use_whitening
+        )
+
+    def loss(self, logprobs, values, old_logprobs, old_values, advantages, returns, mask):
+        from trlx_tpu.ops.ppo import ppo_loss
+
+        return ppo_loss(
+            logprobs, values, old_logprobs, old_values, advantages, returns, mask,
+            cliprange=self.cliprange, cliprange_value=self.cliprange_value,
+            vf_coef=self.vf_coef,
+        )
+
+
+@dataclass
+@register_method
+class ILQLConfig(MethodConfig):
+    """ILQL hyperparameters (field parity with reference modeling_ilql.py:48-93)."""
+
+    tau: float = 0.7
+    gamma: float = 0.99
+    cql_scale: float = 0.1
+    awac_scale: float = 1.0
+    alpha: float = 0.001
+    beta: float = 0.0
+    steps_for_target_q_sync: int = 5
+    two_qs: bool = True
+    gen_kwargs: dict = field(default_factory=lambda: dict(max_new_tokens=56, top_k=20, beta=1.0))
+
+    def loss(self, outputs, labels):
+        from trlx_tpu.ops.ilql import ilql_loss
+
+        logits, (qs, target_qs, vs) = outputs
+        return ilql_loss(
+            logits, qs, target_qs, vs, labels,
+            tau=self.tau, gamma=self.gamma, cql_scale=self.cql_scale,
+            awac_scale=self.awac_scale, beta=self.beta, two_qs=self.two_qs,
+        )
+
+
+@dataclass
+@register_method
+class SFTConfig(MethodConfig):
+    """SFT hyperparameters (parity: accelerate_sft_trainer.py:16-26)."""
+
+    gen_kwargs: dict = field(default_factory=lambda: dict(max_new_tokens=40))
+
+
+@dataclass
+@register_method
+class RFTConfig(MethodConfig):
+    """Rejection-sampling fine-tuning (parity: accelerate_rft_trainer.py:18-44)."""
+
+    gen_kwargs: dict = field(default_factory=lambda: dict(max_new_tokens=40))
+    start_percentile: float = 0.7
+    end_percentile: float = 0.95
+    n_improve_steps: int = 4
+    n_generations_per_prompt: int = 32

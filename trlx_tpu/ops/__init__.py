@@ -1,0 +1,38 @@
+"""Pure jittable numerics: losses, advantages, sampling, statistics.
+
+Everything in this package is a pure function of arrays + static
+hyperparameters — the TPU-native answer to the reference's mixture of
+loss methods on config objects and torch.distributed stat helpers
+(/root/reference/trlx/utils/modeling.py:185-314).
+"""
+
+from trlx_tpu.ops.common import (
+    RunningMoments,
+    batched_index_select,
+    flatten_dict,
+    get_tensor_stats,
+    logprobs_of_labels,
+    masked_mean,
+    running_moments_init,
+    running_moments_update,
+    topk_mask,
+    whiten,
+)
+from trlx_tpu.ops.ppo import gae_advantages_and_returns, ppo_loss
+from trlx_tpu.ops.ilql import ilql_loss
+
+__all__ = [
+    "RunningMoments",
+    "batched_index_select",
+    "flatten_dict",
+    "gae_advantages_and_returns",
+    "get_tensor_stats",
+    "ilql_loss",
+    "logprobs_of_labels",
+    "masked_mean",
+    "ppo_loss",
+    "running_moments_init",
+    "running_moments_update",
+    "topk_mask",
+    "whiten",
+]

@@ -1,0 +1,168 @@
+"""Shared numeric primitives.
+
+Parity: /root/reference/trlx/utils/modeling.py:185-314 (whiten,
+logprobs_of_labels, get_tensor_stats, RunningMoments, flatten_dict) and
+/root/reference/trlx/models/modeling_ilql.py:29-46 (topk_mask,
+batched_index_select) — re-expressed as pure JAX.
+
+Distribution note: these run inside `jit` over a `Mesh` with batch
+sharded along `dp`. GSPMD makes `jnp.mean`/`jnp.sum` global across the
+mesh automatically, so the reference's explicit all_reduce paths
+(get_global_statistics) need no separate "distributed" branch. An
+optional `axis_name` argument covers `shard_map`/`pmap` contexts where
+reductions are per-shard.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, MutableMapping, Optional, Tuple, Union
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+def masked_mean(xs: jnp.ndarray, mask: Optional[jnp.ndarray], axis=None) -> jnp.ndarray:
+    if mask is None:
+        return jnp.mean(xs, axis=axis)
+    mask = mask.astype(xs.dtype)
+    return (xs * mask).sum(axis=axis) / jnp.maximum(mask.sum(axis=axis), 1e-8)
+
+
+def _global_mean_var(
+    xs: jnp.ndarray, axis_name: Optional[str] = None
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Element-count, mean and (biased) variance, reduced over `axis_name`
+    if inside shard_map/pmap, else over the (logically global) array."""
+    count = jnp.asarray(xs.size, jnp.float32)
+    total = xs.sum()
+    if axis_name is not None:
+        count = jax.lax.psum(count, axis_name)
+        total = jax.lax.psum(total, axis_name)
+    mean = total / count
+    sq = ((xs - mean) ** 2).sum()
+    if axis_name is not None:
+        sq = jax.lax.psum(sq, axis_name)
+    return mean, sq / count, count
+
+
+def whiten(
+    xs: jnp.ndarray,
+    shift_mean: bool = True,
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Normalize to zero mean / unit variance (across the global batch)."""
+    mean, var, _ = _global_mean_var(xs, axis_name)
+    whitened = (xs - mean) * jax.lax.rsqrt(var + 1e-8)
+    if not shift_mean:
+        whitened = whitened + mean
+    return whitened
+
+
+def logprobs_of_labels(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """log p(label_t) from logits [..., seq, vocab] and labels [..., seq].
+
+    Computed without materializing the full log-softmax gather in fp32 HBM:
+    logsumexp is fused by XLA with the label gather.
+    """
+    labels = labels[..., None]
+    picked = jnp.take_along_axis(logits, labels, axis=-1)[..., 0]
+    return picked.astype(jnp.float32) - jax.nn.logsumexp(
+        logits.astype(jnp.float32), axis=-1
+    )
+
+
+def topk_mask(xs: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask all but the top-k logits to -inf (k >= vocab is a no-op)."""
+    if k <= 0 or k >= xs.shape[-1]:
+        return xs
+    kth = jax.lax.top_k(xs, k)[0][..., -1:]
+    return jnp.where(xs < kth, -jnp.inf, xs)
+
+
+def batched_index_select(x: jnp.ndarray, idxs: jnp.ndarray, dim: int = 1) -> jnp.ndarray:
+    """Gather rows of x [batch, seq, hidden] at idxs [batch, n] along `dim`."""
+    idxs = jnp.expand_dims(idxs, -1)
+    if x.ndim == idxs.ndim:
+        idxs = jnp.broadcast_to(idxs, idxs.shape[:-1] + (x.shape[-1],))
+        return jnp.take_along_axis(x, idxs, axis=dim)
+    return jnp.take_along_axis(x, idxs[..., 0], axis=dim)
+
+
+def get_tensor_stats(xs: jnp.ndarray, mask: jnp.ndarray, n) -> Dict[str, jnp.ndarray]:
+    """mean/min/max/std over masked entries (parity: utils/modeling.py:269-279)."""
+    if xs.size == 0:
+        zero = jnp.float32(0)
+        return dict(mean=zero, min=zero, max=zero, std=zero)
+    mask = mask.astype(xs.dtype)
+    n = jnp.maximum(n, 1e-8)
+    mean = (xs * mask).sum() / n
+    return dict(
+        mean=mean,
+        min=jnp.where(mask > 0, xs, jnp.inf).min(),
+        max=jnp.where(mask > 0, xs, -jnp.inf).max(),
+        std=jnp.sqrt((((xs - mean) * mask) ** 2).sum() / n),
+    )
+
+
+def flatten_dict(d: Union[dict, MutableMapping], parent_key: str = "", sep: str = "/") -> dict:
+    """{"a": {"b": 1}} -> {"a/b": 1} (metric-key parity with the reference)."""
+    items = {}
+    for k, v in d.items():
+        key = f"{parent_key}{sep}{k}" if parent_key else str(k)
+        if isinstance(v, MutableMapping):
+            items.update(flatten_dict(v, key, sep=sep))
+        else:
+            items[key] = v
+    return items
+
+
+# ---------------------------------------------------------------------------
+# Running moments — functional state (Chan et al. parallel variance), the
+# pytree version of reference RunningMoments (utils/modeling.py:282-314).
+# ---------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class RunningMoments:
+    mean: jnp.ndarray
+    var: jnp.ndarray
+    std: jnp.ndarray
+    count: jnp.ndarray
+
+
+def running_moments_init() -> RunningMoments:
+    return RunningMoments(
+        mean=jnp.float32(0.0),
+        var=jnp.float32(1.0),
+        std=jnp.float32(1.0),
+        count=jnp.float32(1e-24),
+    )
+
+
+def running_moments_update(
+    state: RunningMoments, xs: jnp.ndarray, axis_name: Optional[str] = None
+) -> Tuple[RunningMoments, jnp.ndarray, jnp.ndarray]:
+    """Fold a batch into the running moments.
+
+    Returns (new_state, batch_mean, batch_std) where batch_std is the
+    unbiased standard deviation of `xs` itself.
+    """
+    xs_mean, xs_var, xs_count = _global_mean_var(xs, axis_name)
+    delta = xs_mean - state.mean
+    tot_count = state.count + xs_count
+
+    new_sum = xs_var * xs_count
+    old_sum = state.var * state.count + delta**2 * state.count * xs_count / tot_count
+    tot_sum = old_sum + new_sum
+
+    new_mean = state.mean + delta * xs_count / tot_count
+    new_var = tot_sum / tot_count
+    new_state = RunningMoments(
+        mean=new_mean,
+        var=new_var,
+        std=jnp.sqrt(new_var * tot_count / jnp.maximum(tot_count - 1, 1e-8)),
+        count=tot_count,
+    )
+    batch_std = jnp.sqrt(xs_var * xs_count / jnp.maximum(xs_count - 1, 1e-8))
+    return new_state, xs_mean, batch_std

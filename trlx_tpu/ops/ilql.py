@@ -1,0 +1,120 @@
+"""ILQL numerics: TD Q-loss, expectile value loss, CQL and AWAC terms,
+plus the advantage-shaped sampling perturbation.
+
+Parity: /root/reference/trlx/models/modeling_ilql.py:94-166 (loss) and
+:325-412 / modeling_nemo_ilql.py:723-735 (beta*(minQ - V) logit shaping).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.ops.common import (
+    batched_index_select,
+    flatten_dict,
+    get_tensor_stats,
+    topk_mask,
+)
+
+
+def ilql_loss(
+    logits: jnp.ndarray,  # [batch, n_actions, vocab] (already action-selected)
+    qs: Sequence[jnp.ndarray],  # each [batch, n_actions, vocab]
+    target_qs: Sequence[jnp.ndarray],
+    vs: jnp.ndarray,  # [batch, n_states, 1]; n_states = n_actions + 1
+    labels,  # ILQLBatch (actions from input_ids) or seq2seq batch
+    tau: float,
+    gamma: float,
+    cql_scale: float,
+    awac_scale: float,
+    beta: float,
+    two_qs: bool,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    from trlx_tpu.data import ILQLBatch
+
+    dones = labels.dones.astype(jnp.float32)
+    terminal_mask = dones[:, :-1]  # [batch, n_actions]
+    n_nonterminal = jnp.maximum(terminal_mask.sum(), 1.0)
+
+    if isinstance(labels, ILQLBatch):
+        shifted = labels.input_ids[:, 1:]
+        actions = jnp.take_along_axis(shifted, labels.actions_ixs, axis=1)
+    else:
+        actions = labels.decoder_input_ids[:, 1:]
+    actions = actions[..., None]  # [batch, n_actions, 1]
+    bsize, nactions, dsize = logits.shape
+
+    def pick(q):
+        return jnp.take_along_axis(q, actions, axis=-1)[..., 0]
+
+    Q = [pick(q) for q in qs]
+    targetQ = jax.lax.stop_gradient(
+        jnp.minimum(*[pick(q) for q in target_qs]) if two_qs else pick(target_qs[0])
+    )
+
+    V = vs[:, :-1, 0]  # values of current states
+    Vnext = vs[:, 1:, 0] * dones[:, 1:]
+    Q_target = labels.rewards + gamma * jax.lax.stop_gradient(Vnext)
+
+    loss_q = sum(
+        (((Qi - Q_target) * terminal_mask) ** 2).sum() / n_nonterminal for Qi in Q
+    )
+
+    # expectile regression of V toward min target-Q
+    vdiff2 = (targetQ - V) ** 2
+    loss_v = (
+        jnp.where(targetQ >= V, tau * vdiff2, (1 - tau) * vdiff2) * terminal_mask
+    ).sum() / n_nonterminal
+
+    def masked_xent(scores):
+        logp = jax.nn.log_softmax(scores.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, actions, axis=-1)[..., 0]
+        return nll  # [batch, n_actions]
+
+    loss_cql = sum(
+        (masked_xent(q) * terminal_mask).sum() / n_nonterminal for q in qs
+    )
+
+    cross_entropy = masked_xent(logits)
+    awac_weight = jax.lax.stop_gradient(jnp.exp(beta * (targetQ - V)))
+    loss_awac = (cross_entropy * awac_weight * terminal_mask).sum() / n_nonterminal
+
+    loss = loss_q + loss_v + cql_scale * loss_cql + awac_scale * loss_awac
+
+    stats = dict(
+        losses=dict(
+            loss=loss, loss_q=loss_q, loss_v=loss_v,
+            loss_cql=loss_cql, loss_awac=loss_awac,
+        ),
+        values=get_tensor_stats(V, terminal_mask, n_nonterminal),
+        qvalues={
+            str(ix): get_tensor_stats(Q[ix], terminal_mask, n_nonterminal)
+            for ix in range(len(Q))
+        },
+        awac_weight=get_tensor_stats(awac_weight, terminal_mask, n_nonterminal),
+    )
+    return loss, flatten_dict(stats)
+
+
+def ilql_shape_logits(
+    logits: jnp.ndarray,  # [batch, vocab] last-position logits
+    qs: Sequence[jnp.ndarray],  # each [batch, vocab]
+    vs: jnp.ndarray,  # [batch, 1]
+    beta: float,
+    top_k: int = 0,
+) -> jnp.ndarray:
+    """Perturb sampling logits by the advantage: pi_beta + beta*(minQ - V).
+
+    This is ILQL's inference-time policy improvement (parity:
+    modeling_ilql.py:365-374); a pure function usable inside the jitted
+    decode loop.
+    """
+    min_q = qs[0] if len(qs) == 1 else jnp.minimum(*qs)
+    adv = min_q - vs
+    shaped = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1) + beta * adv
+    if top_k:
+        shaped = topk_mask(shaped, top_k)
+    return shaped

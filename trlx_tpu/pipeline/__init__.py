@@ -1,0 +1,175 @@
+"""Pipeline registry, base classes, dataloading, microbatching.
+
+Parity: /root/reference/trlx/pipeline/__init__.py:14-177. The reference
+builds on torch DataLoader; here batches are pytrees of numpy/jax arrays
+and the loader is a thin host-side batcher (single host thread feeding the
+device; heavy lifting happens inside jit).
+"""
+
+from __future__ import annotations
+
+import sys
+from abc import abstractmethod
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+_DATAPIPELINE: Dict[str, type] = {}
+
+
+def register_datapipeline(name_or_cls):
+    """Register a pipeline class under its (lowercased) name (decorator)."""
+
+    def _register(cls, name: str):
+        _DATAPIPELINE[name.lower()] = cls
+        return cls
+
+    if isinstance(name_or_cls, str):
+        return lambda cls: _register(cls, name_or_cls)
+    return _register(name_or_cls, name_or_cls.__name__)
+
+
+class DataLoader:
+    """Minimal host-side batcher over an indexable dataset.
+
+    Replaces torch.utils.data.DataLoader (reference BasePipeline
+    create_loader): yields `collate_fn([items...])` over shuffled or
+    sequential index order. Deterministic given `seed`.
+    """
+
+    def __init__(
+        self,
+        dataset: Sequence,
+        batch_size: int,
+        collate_fn: Callable[[List[Any]], Any] = None,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or (lambda items: items)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Any]:
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            self._rng.shuffle(order)
+        for start in range(0, len(order), self.batch_size):
+            idxs = order[start : start + self.batch_size]
+            if self.drop_last and len(idxs) < self.batch_size:
+                return
+            yield self.collate_fn([self.dataset[int(i)] for i in idxs])
+
+
+class BasePipeline:
+    """Indexable dataset + loader factory (parity: pipeline/__init__.py:41-70)."""
+
+    def __init__(self, path: str = "dataset"):
+        self.path = path
+
+    @abstractmethod
+    def __getitem__(self, index: int):
+        raise NotImplementedError
+
+    @abstractmethod
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    @abstractmethod
+    def create_loader(self, batch_size: int, shuffle: bool = False) -> DataLoader:
+        raise NotImplementedError
+
+
+class BaseRolloutStore:
+    """Experience buffer (parity: pipeline/__init__.py:73-102)."""
+
+    def __init__(self, capacity: int = -1):
+        self.history = None
+        self.capacity = capacity
+
+    @abstractmethod
+    def push(self, exps):
+        raise NotImplementedError
+
+    @abstractmethod
+    def __getitem__(self, index: int):
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        return len(self.history)
+
+    @abstractmethod
+    def create_loader(self, batch_size: int, shuffle: bool = False) -> DataLoader:
+        raise NotImplementedError
+
+
+def _slice_tree(batch, start: int, stop: int):
+    """Slice every array leaf of a pytree batch along axis 0."""
+    import jax
+
+    def _slice(leaf):
+        if hasattr(leaf, "__getitem__") and hasattr(leaf, "shape"):
+            return leaf[start:stop]
+        return leaf
+
+    return jax.tree_util.tree_map(_slice, batch)
+
+
+class MiniBatchIterator:
+    """Split each dataloader batch into `num_mb` microbatches of `mb_size`
+    for gradient accumulation, preserving pytree structure.
+
+    Parity: reference pipeline/__init__.py:105-177 (which special-cases
+    dict / dataclass / BatchEncoding); pytrees make the structure cases
+    uniform. Warns on ragged trailing microbatches just like the
+    reference.
+    """
+
+    def __init__(self, data_loader: Iterator, mb_size: int, num_mb: int):
+        self.data_loader = iter(data_loader)
+        self.mb_size = mb_size
+        self.num_mb = num_mb
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> List[Any]:
+        batch = next(self.data_loader)
+        first = _first_leaf(batch)
+        batch_len = len(first)
+        minibatches = []
+        for i in range(self.num_mb):
+            start, stop = i * self.mb_size, (i + 1) * self.mb_size
+            if start >= batch_len:
+                logger.warning(
+                    "ragged batch: %d samples < %d microbatches x %d; "
+                    "dropping empty tail", batch_len, self.num_mb, self.mb_size,
+                )
+                break
+            mb = _slice_tree(batch, start, min(stop, batch_len))
+            minibatches.append(mb)
+        if not minibatches:
+            raise StopIteration
+        return minibatches
+
+
+def _first_leaf(batch):
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        raise ValueError("empty batch")
+    return leaves[0]

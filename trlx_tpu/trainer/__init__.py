@@ -1,0 +1,56 @@
+"""Trainer registry and abstract base.
+
+Parity: /root/reference/trlx/trainer/__init__.py:9-64 — string->class
+registry populated by decorator, plus the abstract `learn()` contract.
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Any, Callable, Dict, List, Optional
+
+_TRAINERS: Dict[str, type] = {}
+
+
+def register_trainer(name_or_cls):
+    """Register a trainer class under its (lowercased) name (decorator)."""
+
+    def _register(cls, name: str):
+        _TRAINERS[name.lower()] = cls
+        return cls
+
+    if isinstance(name_or_cls, str):
+        return lambda cls: _register(cls, name_or_cls)
+    return _register(name_or_cls, name_or_cls.__name__)
+
+
+class BaseRLTrainer:
+    """Abstract trainer: owns model/optimizer/tokenizer and the train loop.
+
+    Subclasses implement `learn()`; online trainers also implement the
+    rollout engine `make_experience`.
+    """
+
+    def __init__(
+        self,
+        config,
+        reward_fn: Optional[Callable] = None,
+        metric_fn: Optional[Callable] = None,
+        stop_sequences: Optional[List[str]] = None,
+        **kwargs: Any,
+    ):
+        self.config = config
+        self.reward_fn = reward_fn
+        self.metric_fn = metric_fn
+        self.stop_sequences = stop_sequences or []
+
+    def push_to_store(self, data):
+        self.store.push(data)
+
+    def add_eval_pipeline(self, eval_pipeline):
+        self.eval_pipeline = eval_pipeline
+
+    @abstractmethod
+    def learn(self):
+        """Run the full training loop."""
+        raise NotImplementedError

@@ -1,0 +1,45 @@
+"""Registry lookups for trainers and pipelines.
+
+Parity: /root/reference/trlx/utils/loading.py:14-50. Importing the trainer
+package populates the registries as a side effect.
+"""
+
+from __future__ import annotations
+
+
+def get_trainer(name: str) -> type:
+    import trlx_tpu.trainer as trainer_pkg
+    import trlx_tpu.trainer.ppo  # noqa: F401  (registration side effects)
+    import trlx_tpu.trainer.ilql  # noqa: F401
+    import trlx_tpu.trainer.sft  # noqa: F401
+    import trlx_tpu.trainer.rft  # noqa: F401
+
+    key = name.lower()
+    # accept the reference's trainer names so its configs run unmodified
+    aliases = {
+        "accelerateppotrainer": "tpuppotrainer",
+        "accelerateilqltrainer": "tpuilqltrainer",
+        "acceleratesfttrainer": "tpusfttrainer",
+        "acceleraterfttrainer": "tpurfttrainer",
+        "nemoppotrainer": "tpuppotrainer",
+        "nemoilqltrainer": "tpuilqltrainer",
+        "nemosfttrainer": "tpusfttrainer",
+    }
+    key = aliases.get(key, key)
+    if key not in trainer_pkg._TRAINERS:
+        raise ValueError(
+            f"Unknown trainer {name!r}; registered: {sorted(trainer_pkg._TRAINERS)}"
+        )
+    return trainer_pkg._TRAINERS[key]
+
+
+def get_pipeline(name: str) -> type:
+    import trlx_tpu.pipeline as pipeline_pkg
+    import trlx_tpu.pipeline.offline_pipeline  # noqa: F401
+
+    key = name.lower()
+    if key not in pipeline_pkg._DATAPIPELINE:
+        raise ValueError(
+            f"Unknown pipeline {name!r}; registered: {sorted(pipeline_pkg._DATAPIPELINE)}"
+        )
+    return pipeline_pkg._DATAPIPELINE[key]

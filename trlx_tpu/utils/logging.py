@@ -1,0 +1,134 @@
+"""Process-aware logging.
+
+Parity: /root/reference/trlx/utils/logging.py — per-library verbosity with
+env override and rank-filtered multiprocess logging. On TPU "rank" is
+`jax.process_index()` (multi-host SPMD), not a torch.distributed rank.
+Env var: TRLX_TPU_VERBOSITY in {debug, info, warning, error, critical}.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_handler: Optional[logging.Handler] = None
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+_DEFAULT_LEVEL = logging.INFO
+
+
+def _env_level() -> int:
+    raw = os.environ.get("TRLX_TPU_VERBOSITY")
+    if raw is None:
+        return _DEFAULT_LEVEL
+    try:
+        return LOG_LEVELS[raw.lower()]
+    except KeyError:
+        logging.getLogger().warning(
+            "Unknown TRLX_TPU_VERBOSITY=%s; expected one of %s", raw, sorted(LOG_LEVELS)
+        )
+        return _DEFAULT_LEVEL
+
+
+def _root_name() -> str:
+    return __name__.split(".")[0]
+
+
+def _configure_root() -> logging.Logger:
+    global _handler
+    root = logging.getLogger(_root_name())
+    with _lock:
+        if _handler is None:
+            _handler = logging.StreamHandler(sys.stdout)
+            _handler.setFormatter(
+                logging.Formatter(
+                    "[%(levelname)s|%(name)s] %(asctime)s >> %(message)s",
+                    datefmt="%H:%M:%S",
+                )
+            )
+            root.addHandler(_handler)
+            root.setLevel(_env_level())
+            root.propagate = False
+    return root
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pre-init or no backend: act as the primary process
+        return 0
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """Logs only on selected processes (default: process 0).
+
+    `logger.info(msg, ranks=[0, 1])` logs on processes 0 and 1;
+    `ranks=[-1]` logs everywhere. Messages are prefixed with the process
+    index when there are multiple hosts.
+    """
+
+    def log(self, level, msg, *args, **kwargs):
+        ranks = kwargs.pop("ranks", [0])
+        proc = _process_index()
+        if proc in ranks or -1 in ranks:
+            try:
+                import jax
+
+                n_proc = jax.process_count()
+            except Exception:
+                n_proc = 1
+            if n_proc > 1:
+                msg = f"[host {proc}] {msg}"
+            if self.isEnabledFor(level):
+                self.logger.log(level, msg, *args, **kwargs)
+
+
+def get_logger(name: Optional[str] = None) -> MultiProcessAdapter:
+    _configure_root()
+    if name is None:
+        name = _root_name()
+    return MultiProcessAdapter(logging.getLogger(name), {})
+
+
+def get_verbosity() -> int:
+    return _configure_root().getEffectiveLevel()
+
+
+def set_verbosity(verbosity: int) -> None:
+    _configure_root().setLevel(verbosity)
+
+
+def set_verbosity_debug():
+    set_verbosity(logging.DEBUG)
+
+
+def set_verbosity_info():
+    set_verbosity(logging.INFO)
+
+
+def set_verbosity_warning():
+    set_verbosity(logging.WARNING)
+
+
+def set_verbosity_error():
+    set_verbosity(logging.ERROR)
+
+
+# re-exported level constants for API familiarity
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+CRITICAL = logging.CRITICAL
