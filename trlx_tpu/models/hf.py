@@ -1,0 +1,451 @@
+"""HuggingFace checkpoint interop: config mapping + weight conversion.
+
+Parity: /root/reference/trlx/models/modeling_base.py:124-326
+(from_pretrained with sharded-index merging) — here torch state dicts are
+converted into the stacked-layer functional param tree of
+trlx_tpu.models.transformer, and back (HF export for deploy parity,
+reference accelerate_ppo_trainer.py:526-553).
+
+Supported model families: gpt2, gptj, gpt_neox, llama, opt (decoder
+side). Each family is a declarative layout description, not a separate
+model class.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# config mapping
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf(hf_config: Any, dtype=None, param_dtype=None) -> TransformerConfig:
+    """Translate a transformers PretrainedConfig into a TransformerConfig."""
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    param_dtype = param_dtype or jnp.float32
+    mt = hf_config.model_type
+
+    if mt == "gpt2":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            n_layer=hf_config.n_layer,
+            n_head=hf_config.n_head,
+            n_positions=hf_config.n_positions,
+            intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+            pos_embed="learned",
+            activation="gelu_new",
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+            tie_word_embeddings=True,
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+    if mt == "gptj":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            n_layer=hf_config.n_layer,
+            n_head=hf_config.n_head,
+            n_positions=hf_config.n_positions,
+            intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+            pos_embed="rotary",
+            rotary_style="gptj",
+            rotary_dim=hf_config.rotary_dim,
+            activation="gelu_new",
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+            parallel_residual=True,
+            use_attn_bias=False,
+            use_mlp_bias=True,
+            tie_word_embeddings=False,
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+    if mt == "gpt_neox":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            n_layer=hf_config.num_hidden_layers,
+            n_head=hf_config.num_attention_heads,
+            n_positions=hf_config.max_position_embeddings,
+            intermediate_size=hf_config.intermediate_size,
+            pos_embed="rotary",
+            rotary_style="neox",
+            rotary_dim=int(
+                (hf_config.hidden_size // hf_config.num_attention_heads)
+                * hf_config.rotary_pct
+            ),
+            rope_theta=getattr(hf_config, "rotary_emb_base", 10000.0),
+            activation="gelu",
+            layer_norm_epsilon=hf_config.layer_norm_eps,
+            parallel_residual=getattr(hf_config, "use_parallel_residual", True),
+            use_attn_bias=True,
+            use_mlp_bias=True,
+            tie_word_embeddings=False,
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+    if mt == "llama":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            n_layer=hf_config.num_hidden_layers,
+            n_head=hf_config.num_attention_heads,
+            n_kv_head=getattr(hf_config, "num_key_value_heads", None)
+            or hf_config.num_attention_heads,
+            n_positions=hf_config.max_position_embeddings,
+            intermediate_size=hf_config.intermediate_size,
+            pos_embed="rotary",
+            rotary_style="neox",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            norm="rmsnorm",
+            layer_norm_epsilon=hf_config.rms_norm_eps,
+            activation="silu",
+            mlp_gated=True,
+            use_attn_bias=False,
+            use_mlp_bias=False,
+            use_norm_bias=False,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+    raise ValueError(f"unsupported model_type {mt!r} (supported: gpt2, gptj, gpt_neox, llama)")
+
+
+# ---------------------------------------------------------------------------
+# weight conversion: torch state_dict -> stacked functional param tree
+# ---------------------------------------------------------------------------
+
+
+def _np(t) -> np.ndarray:
+    # torch tensor or numpy array -> float32 numpy (bf16-safe via float())
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu").float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _stack(layers: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """[{'a': arr}, ...] per layer -> {'a': arr[L, ...]} stacked."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *layers)
+
+
+def params_from_state_dict(sd: Dict[str, Any], cfg: TransformerConfig, model_type: str) -> Dict:
+    """Convert an HF torch state_dict to the functional param tree."""
+    H, D, E = cfg.n_head, cfg.head_dim, cfg.hidden_size
+    Hkv = cfg.n_kv_head
+
+    def qkv_from_fused(w, b, order: str = "qkv"):
+        """Fused c_attn [E, 3E] (+bias) -> q/k/v dicts with [E,H,D] kernels."""
+        ws = np.split(w, 3, axis=-1)
+        out = {}
+        for name, wi in zip(order, ws):
+            out[name] = {"kernel": wi.reshape(E, H, D)}
+        if b is not None:
+            bs = np.split(b, 3, axis=-1)
+            for name, bi in zip(order, bs):
+                out[name]["bias"] = bi.reshape(H, D)
+        return out
+
+    if model_type == "gpt2":
+        # HF Conv1D stores [in, out] — same as our kernels, no transpose.
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        layers = []
+        for i in range(cfg.n_layer):
+            b = f"{pfx}h.{i}."
+            attn = qkv_from_fused(_np(sd[b + "attn.c_attn.weight"]), _np(sd[b + "attn.c_attn.bias"]))
+            attn["o"] = {
+                "kernel": _np(sd[b + "attn.c_proj.weight"]).reshape(H, D, E),
+                "bias": _np(sd[b + "attn.c_proj.bias"]),
+            }
+            layers.append(
+                {
+                    "ln_1": {"scale": _np(sd[b + "ln_1.weight"]), "bias": _np(sd[b + "ln_1.bias"])},
+                    "attn": attn,
+                    "ln_2": {"scale": _np(sd[b + "ln_2.weight"]), "bias": _np(sd[b + "ln_2.bias"])},
+                    "mlp": {
+                        "fc_in": {"kernel": _np(sd[b + "mlp.c_fc.weight"]), "bias": _np(sd[b + "mlp.c_fc.bias"])},
+                        "fc_out": {"kernel": _np(sd[b + "mlp.c_proj.weight"]), "bias": _np(sd[b + "mlp.c_proj.bias"])},
+                    },
+                }
+            )
+        return {
+            "embed": {"wte": _np(sd[pfx + "wte.weight"]), "wpe": _np(sd[pfx + "wpe.weight"])},
+            "blocks": _stack(layers),
+            "ln_f": {"scale": _np(sd[pfx + "ln_f.weight"]), "bias": _np(sd[pfx + "ln_f.bias"])},
+        }
+
+    if model_type == "gptj":
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        layers = []
+        for i in range(cfg.n_layer):
+            b = f"{pfx}h.{i}."
+            attn = {}
+            for ours, theirs in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")):
+                attn[ours] = {"kernel": _np(sd[f"{b}attn.{theirs}.weight"]).T.reshape(E, H, D)}
+            attn["o"] = {"kernel": _np(sd[b + "attn.out_proj.weight"]).T.reshape(H, D, E)}
+            layers.append(
+                {
+                    "ln_1": {"scale": _np(sd[b + "ln_1.weight"]), "bias": _np(sd[b + "ln_1.bias"])},
+                    "attn": attn,
+                    "mlp": {
+                        "fc_in": {"kernel": _np(sd[b + "mlp.fc_in.weight"]).T, "bias": _np(sd[b + "mlp.fc_in.bias"])},
+                        "fc_out": {"kernel": _np(sd[b + "mlp.fc_out.weight"]).T, "bias": _np(sd[b + "mlp.fc_out.bias"])},
+                    },
+                }
+            )
+        params = {
+            "embed": {"wte": _np(sd[pfx + "wte.weight"])},
+            "blocks": _stack(layers),
+            "ln_f": {"scale": _np(sd[pfx + "ln_f.weight"]), "bias": _np(sd[pfx + "ln_f.bias"])},
+            "lm_head": {"kernel": _np(sd["lm_head.weight"]).T},
+        }
+        return params
+
+    if model_type == "gpt_neox":
+        pfx = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+        layers = []
+        for i in range(cfg.n_layer):
+            b = f"{pfx}layers.{i}."
+            # fused qkv [3E, E], interleaved per head: [H, 3, D, E]
+            w = _np(sd[b + "attention.query_key_value.weight"]).reshape(H, 3, D, E)
+            bias = _np(sd[b + "attention.query_key_value.bias"]).reshape(H, 3, D)
+            attn = {
+                name: {
+                    "kernel": np.moveaxis(w[:, j], -1, 0).reshape(E, H, D),
+                    "bias": bias[:, j],
+                }
+                for j, name in enumerate("qkv")
+            }
+            attn["o"] = {
+                "kernel": _np(sd[b + "attention.dense.weight"]).T.reshape(H, D, E),
+                "bias": _np(sd[b + "attention.dense.bias"]),
+            }
+            layers.append(
+                {
+                    "ln_1": {
+                        "scale": _np(sd[b + "input_layernorm.weight"]),
+                        "bias": _np(sd[b + "input_layernorm.bias"]),
+                    },
+                    "attn": attn,
+                    "ln_2": {
+                        "scale": _np(sd[b + "post_attention_layernorm.weight"]),
+                        "bias": _np(sd[b + "post_attention_layernorm.bias"]),
+                    },
+                    "mlp": {
+                        "fc_in": {
+                            "kernel": _np(sd[b + "mlp.dense_h_to_4h.weight"]).T,
+                            "bias": _np(sd[b + "mlp.dense_h_to_4h.bias"]),
+                        },
+                        "fc_out": {
+                            "kernel": _np(sd[b + "mlp.dense_4h_to_h.weight"]).T,
+                            "bias": _np(sd[b + "mlp.dense_4h_to_h.bias"]),
+                        },
+                    },
+                }
+            )
+        stacked = _stack(layers)
+        if not getattr(cfg, "parallel_residual", True):
+            pass  # ln_2 still present in sequential layout
+        return {
+            "embed": {"wte": _np(sd[pfx + "embed_in.weight"])},
+            "blocks": stacked,
+            "ln_f": {
+                "scale": _np(sd[pfx + "final_layer_norm.weight"]),
+                "bias": _np(sd[pfx + "final_layer_norm.bias"]),
+            },
+            "lm_head": {"kernel": _np(sd["embed_out.weight"]).T},
+        }
+
+    if model_type == "llama":
+        pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+        layers = []
+        for i in range(cfg.n_layer):
+            b = f"{pfx}layers.{i}."
+            attn = {
+                "q": {"kernel": _np(sd[b + "self_attn.q_proj.weight"]).T.reshape(E, H, D)},
+                "k": {"kernel": _np(sd[b + "self_attn.k_proj.weight"]).T.reshape(E, Hkv, D)},
+                "v": {"kernel": _np(sd[b + "self_attn.v_proj.weight"]).T.reshape(E, Hkv, D)},
+                "o": {"kernel": _np(sd[b + "self_attn.o_proj.weight"]).T.reshape(H, D, E)},
+            }
+            layers.append(
+                {
+                    "ln_1": {"scale": _np(sd[b + "input_layernorm.weight"])},
+                    "attn": attn,
+                    "ln_2": {"scale": _np(sd[b + "post_attention_layernorm.weight"])},
+                    "mlp": {
+                        # HF: gate_proj activated, up_proj linear; ours:
+                        # fc_in activated, fc_gate linear multiplier
+                        "fc_in": {"kernel": _np(sd[b + "mlp.gate_proj.weight"]).T},
+                        "fc_gate": {"kernel": _np(sd[b + "mlp.up_proj.weight"]).T},
+                        "fc_out": {"kernel": _np(sd[b + "mlp.down_proj.weight"]).T},
+                    },
+                }
+            )
+        params = {
+            "embed": {"wte": _np(sd[pfx + "embed_tokens.weight"])},
+            "blocks": _stack(layers),
+            "ln_f": {"scale": _np(sd[pfx + "norm.weight"])},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
+        return params
+
+    raise ValueError(f"unsupported model_type {model_type!r}")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint IO
+# ---------------------------------------------------------------------------
+
+
+def _read_state_dict(path: str) -> Dict[str, Any]:
+    """Read torch-format weights from an HF-layout directory, merging
+    sharded checkpoints via the index file when present (parity:
+    reference modeling_base.py:277-315)."""
+    single_bins = ["pytorch_model.bin", "model.safetensors"]
+    index_files = ["pytorch_model.bin.index.json", "model.safetensors.index.json"]
+
+    def _load_file(fp: str) -> Dict[str, Any]:
+        if fp.endswith(".safetensors"):
+            from safetensors import safe_open
+
+            out = {}
+            with safe_open(fp, framework="np") as f:
+                for key in f.keys():
+                    out[key] = f.get_tensor(key)
+            return out
+        import torch
+
+        return torch.load(fp, map_location="cpu", weights_only=True)
+
+    for idx_name in index_files:
+        idx_fp = os.path.join(path, idx_name)
+        if os.path.exists(idx_fp):
+            with open(idx_fp) as f:
+                index = json.load(f)
+            sd: Dict[str, Any] = {}
+            for shard in sorted(set(index["weight_map"].values())):
+                sd.update(_load_file(os.path.join(path, shard)))
+            return sd
+    for bin_name in single_bins:
+        fp = os.path.join(path, bin_name)
+        if os.path.exists(fp):
+            return _load_file(fp)
+    raise FileNotFoundError(f"no model weights found under {path}")
+
+
+def load_pretrained(
+    path: str, dtype=None, param_dtype=None
+) -> Tuple[TransformerLM, Dict, str]:
+    """Load an HF-layout local checkpoint directory.
+
+    Returns (model, params, model_type). `params` leaves are numpy arrays
+    (host memory) — the trainer device_puts them with shardings.
+    """
+    import transformers
+
+    hf_config = transformers.AutoConfig.from_pretrained(path)
+    cfg = config_from_hf(hf_config, dtype=dtype, param_dtype=param_dtype)
+    sd = _read_state_dict(path)
+    params = params_from_state_dict(sd, cfg, hf_config.model_type)
+    return TransformerLM(cfg), params, hf_config.model_type
+
+
+def save_pretrained_hf(
+    params: Dict, cfg: TransformerConfig, model_type: str, hf_config: Any, path: str
+) -> None:
+    """Export the param tree as a plain HF torch checkpoint (deploy
+    artifact parity: reference accelerate_base_trainer save_pretrained)."""
+    import torch
+
+    os.makedirs(path, exist_ok=True)
+    sd = state_dict_from_params(params, cfg, model_type)
+    torch.save({k: torch.from_numpy(np.asarray(v)) for k, v in sd.items()},
+               os.path.join(path, "pytorch_model.bin"))
+    hf_config.save_pretrained(path)
+
+
+def state_dict_from_params(params: Dict, cfg: TransformerConfig, model_type: str) -> Dict[str, np.ndarray]:
+    """Inverse of params_from_state_dict (currently gpt2 + llama)."""
+    H, D, E = cfg.n_head, cfg.head_dim, cfg.hidden_size
+    Hkv = cfg.n_kv_head
+    out: Dict[str, np.ndarray] = {}
+
+    def A(x):
+        return np.asarray(x, dtype=np.float32)
+
+    blocks = params["blocks"]
+    if model_type == "gpt2":
+        out["transformer.wte.weight"] = A(params["embed"]["wte"])
+        out["transformer.wpe.weight"] = A(params["embed"]["wpe"])
+        for i in range(cfg.n_layer):
+            b = f"transformer.h.{i}."
+            blk = {k: A_tree(v, i) for k, v in blocks.items()}
+            out[b + "ln_1.weight"] = blk["ln_1"]["scale"]
+            out[b + "ln_1.bias"] = blk["ln_1"]["bias"]
+            qkv_w = np.concatenate(
+                [blk["attn"][n]["kernel"].reshape(E, E) for n in "qkv"], axis=-1
+            )
+            qkv_b = np.concatenate(
+                [blk["attn"][n]["bias"].reshape(E) for n in "qkv"], axis=-1
+            )
+            out[b + "attn.c_attn.weight"] = qkv_w
+            out[b + "attn.c_attn.bias"] = qkv_b
+            out[b + "attn.c_proj.weight"] = blk["attn"]["o"]["kernel"].reshape(E, E)
+            out[b + "attn.c_proj.bias"] = blk["attn"]["o"]["bias"]
+            out[b + "ln_2.weight"] = blk["ln_2"]["scale"]
+            out[b + "ln_2.bias"] = blk["ln_2"]["bias"]
+            out[b + "mlp.c_fc.weight"] = blk["mlp"]["fc_in"]["kernel"]
+            out[b + "mlp.c_fc.bias"] = blk["mlp"]["fc_in"]["bias"]
+            out[b + "mlp.c_proj.weight"] = blk["mlp"]["fc_out"]["kernel"]
+            out[b + "mlp.c_proj.bias"] = blk["mlp"]["fc_out"]["bias"]
+        out["transformer.ln_f.weight"] = A(params["ln_f"]["scale"])
+        out["transformer.ln_f.bias"] = A(params["ln_f"]["bias"])
+        out["lm_head.weight"] = out["transformer.wte.weight"]
+        return out
+
+    if model_type == "llama":
+        out["model.embed_tokens.weight"] = A(params["embed"]["wte"])
+        for i in range(cfg.n_layer):
+            b = f"model.layers.{i}."
+            blk = {k: A_tree(v, i) for k, v in blocks.items()}
+            out[b + "input_layernorm.weight"] = blk["ln_1"]["scale"]
+            out[b + "self_attn.q_proj.weight"] = blk["attn"]["q"]["kernel"].reshape(E, H * D).T
+            out[b + "self_attn.k_proj.weight"] = blk["attn"]["k"]["kernel"].reshape(E, Hkv * D).T
+            out[b + "self_attn.v_proj.weight"] = blk["attn"]["v"]["kernel"].reshape(E, Hkv * D).T
+            out[b + "self_attn.o_proj.weight"] = blk["attn"]["o"]["kernel"].reshape(H * D, E).T
+            out[b + "post_attention_layernorm.weight"] = blk["ln_2"]["scale"]
+            out[b + "mlp.gate_proj.weight"] = blk["mlp"]["fc_in"]["kernel"].T
+            out[b + "mlp.up_proj.weight"] = blk["mlp"]["fc_gate"]["kernel"].T
+            out[b + "mlp.down_proj.weight"] = blk["mlp"]["fc_out"]["kernel"].T
+        out["model.norm.weight"] = A(params["ln_f"]["scale"])
+        if "lm_head" in params:
+            out["lm_head.weight"] = A(params["lm_head"]["kernel"]).T
+        else:
+            out["lm_head.weight"] = out["model.embed_tokens.weight"]
+        return out
+
+    raise ValueError(f"export not implemented for {model_type!r}")
+
+
+def A_tree(tree, i: int):
+    """Select layer i from a stacked subtree, as float32 numpy."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x[i], dtype=np.float32), tree
+    )

@@ -1,0 +1,568 @@
+"""TPU-native causal transformer: one architecture-polymorphic decoder.
+
+Replaces the reference's per-architecture `ModelBranch` family
+(/root/reference/trlx/models/modeling_ppo.py:502-1637 — six hand-copied
+decoder loops for GPT2/OPT/Bloom/Llama/BigCode/T5): here a single
+functional decoder covers GPT-2 / GPT-J / GPT-NeoX / OPT / Llama through
+config switches (position embedding, norm type, MLP gating, residual
+layout), and "run the top-k layers from a hidden state" is an array slice
+of the stacked layer parameters, not a reimplementation.
+
+Design notes (TPU-first):
+- Layer parameters are **stacked** along a leading `layer` axis
+  (init via vmap) and the forward is a `lax.scan` over them: one traced
+  block regardless of depth -> fast compile, and XLA keeps the loop on
+  device. Hydra reference branches and layer freezing become slicing /
+  masking of the leading axis.
+- Sharding is by **path rules** (trlx_tpu/parallel/sharding.py), not
+  boxed flax metadata: the param tree stays a plain pytree of arrays so
+  the trainers can slice/mask/donate it freely.
+- Compute dtype is configurable (bf16 on the MXU); attention scores,
+  softmax, norms and logits accumulate in fp32.
+- KV-cache decode reuses the same block code: attention takes
+  preallocated static-shape cache buffers and a write index (no dynamic
+  shapes anywhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+NEG_INF = -1e9  # additive mask value (finite: avoids NaN rows for all-masked)
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """Static architecture description (hashable: usable as a jit static)."""
+
+    vocab_size: int
+    hidden_size: int
+    n_layer: int
+    n_head: int
+    n_positions: int = 1024
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    n_kv_head: Optional[int] = None  # grouped-query attention; default n_head
+    head_dim: Optional[int] = None  # default hidden // n_head
+
+    # architecture switches
+    pos_embed: str = "learned"  # "learned" | "rotary" | "none"
+    rotary_style: str = "neox"  # "neox" (half rotate) | "gptj" (interleaved)
+    rotary_dim: Optional[int] = None  # default head_dim
+    rope_theta: float = 10000.0
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    layer_norm_epsilon: float = 1e-5
+    activation: str = "gelu_new"  # "gelu_new" | "gelu" | "silu" | "relu"
+    mlp_gated: bool = False  # llama-style SwiGLU
+    parallel_residual: bool = False  # gptj/neox: attn and mlp share input
+    use_attn_bias: bool = True
+    use_mlp_bias: bool = True
+    use_norm_bias: bool = True
+    tie_word_embeddings: bool = True
+
+    # numerics
+    dtype: Any = jnp.bfloat16  # compute dtype inside blocks
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            object.__setattr__(self, "intermediate_size", 4 * self.hidden_size)
+        if self.head_dim is None:
+            object.__setattr__(self, "head_dim", self.hidden_size // self.n_head)
+        if self.n_kv_head is None:
+            object.__setattr__(self, "n_kv_head", self.n_head)
+        if self.rotary_dim is None and self.pos_embed == "rotary":
+            object.__setattr__(self, "rotary_dim", self.head_dim)
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def _activation(name: str) -> Callable[[Array], Array]:
+    return {
+        "gelu_new": partial(jax.nn.gelu, approximate=True),
+        "gelu": partial(jax.nn.gelu, approximate=False),
+        "silu": jax.nn.silu,
+        "relu": jax.nn.relu,
+    }[name]
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embedding
+# ---------------------------------------------------------------------------
+
+
+def rope_frequencies(cfg: TransformerConfig, positions: Array) -> Tuple[Array, Array]:
+    """cos/sin tables [batch, seq, rotary_dim//2] for given positions."""
+    dim = cfg.rotary_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, dim/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: Array, cos: Array, sin: Array, style: str) -> Array:
+    """Rotate the first rotary_dim channels of x [B, T, H, D]."""
+    rot_dim = cos.shape[-1] * 2
+    x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+    x_rot = x_rot.astype(jnp.float32)
+    cos = cos[:, :, None, :]  # broadcast over heads
+    sin = sin[:, :, None, :]
+    if style == "gptj":
+        x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
+        rotated = jnp.stack(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).reshape(x_rot.shape)
+    else:  # neox / llama: rotate halves
+        half = rot_dim // 2
+        x1, x2 = x_rot[..., :half], x_rot[..., half:]
+        rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return jnp.concatenate([rotated.astype(x.dtype), x_pass], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Modules (params are plain arrays; composition is functional below)
+# ---------------------------------------------------------------------------
+
+
+class Norm(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        cfg = self.cfg
+        x32 = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (cfg.hidden_size,), cfg.param_dtype)
+        if cfg.norm == "rmsnorm":
+            var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+            y = x32 * jax.lax.rsqrt(var + cfg.layer_norm_epsilon) * scale
+        else:
+            mean = jnp.mean(x32, axis=-1, keepdims=True)
+            var = jnp.var(x32, axis=-1, keepdims=True)
+            y = (x32 - mean) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon) * scale
+            if cfg.use_norm_bias:
+                y = y + self.param(
+                    "bias", nn.initializers.zeros, (cfg.hidden_size,), cfg.param_dtype
+                )
+        return y.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,  # [B, T, E]
+        attn_bias: Array,  # [B, 1, T, S] additive fp32
+        positions: Array,  # [B, T] absolute positions (for rope)
+        cache: Optional[Dict[str, Array]] = None,  # {"k","v"}: [B, S, Hkv, D], "index"
+    ) -> Tuple[Array, Optional[Dict[str, Array]]]:
+        cfg = self.cfg
+        B, T, E = x.shape
+        H, Hkv, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+
+        dense = partial(
+            nn.DenseGeneral,
+            axis=-1,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            use_bias=cfg.use_attn_bias,
+        )
+        q = dense(features=(H, D), name="q")(x)
+        k = dense(features=(Hkv, D), name="k")(x)
+        v = dense(features=(Hkv, D), name="v")(x)
+
+        if cfg.pos_embed == "rotary":
+            cos, sin = rope_frequencies(cfg, positions)
+            q = apply_rope(q, cos, sin, cfg.rotary_style)
+            k = apply_rope(k, cos, sin, cfg.rotary_style)
+
+        new_kv = None
+        if cache is not None:
+            idx = cache["index"]
+            k_all = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0)
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0)
+            )
+            new_kv = {"k": k_all, "v": v_all}
+            k, v = k_all.astype(cfg.dtype), v_all.astype(cfg.dtype)
+
+        if Hkv != H:  # grouped-query: repeat kv heads
+            rep = H // Hkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        scale = 1.0 / math.sqrt(D)
+        # [B, H, T, S]; accumulate scores in fp32 for stability
+        scores = jnp.einsum(
+            "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        scores = scores + attn_bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+
+        proj = nn.DenseGeneral(
+            features=E,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02 / math.sqrt(2 * cfg.n_layer)),
+            use_bias=cfg.use_attn_bias,
+            name="o",
+        )
+        return proj(out), new_kv
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        cfg = self.cfg
+        act = _activation(cfg.activation)
+        up = partial(
+            nn.DenseGeneral,
+            features=cfg.intermediate_size,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            use_bias=cfg.use_mlp_bias,
+        )
+        h = act(up(name="fc_in")(x))
+        if cfg.mlp_gated:
+            h = h * up(name="fc_gate")(x)
+        down = nn.DenseGeneral(
+            features=cfg.hidden_size,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02 / math.sqrt(2 * cfg.n_layer)),
+            use_bias=cfg.use_mlp_bias,
+            name="fc_out",
+        )
+        return down(h)
+
+
+class Block(nn.Module):
+    """Pre-norm decoder block; sequential (gpt2/llama) or parallel
+    (gptj/neox) residual layout."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        attn_bias: Array,
+        positions: Array,
+        cache: Optional[Dict[str, Array]] = None,
+    ) -> Tuple[Array, Optional[Dict[str, Array]]]:
+        cfg = self.cfg
+        h = Norm(cfg, name="ln_1")(x)
+        attn_out, new_kv = Attention(cfg, name="attn")(h, attn_bias, positions, cache)
+        if cfg.parallel_residual:
+            x = x + attn_out + MLP(cfg, name="mlp")(h)
+        else:
+            x = x + attn_out
+            x = x + MLP(cfg, name="mlp")(Norm(cfg, name="ln_2")(x))
+        return x, new_kv
+
+
+class Embedding(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids: Array, positions: Array) -> Array:
+        cfg = self.cfg
+        wte = self.param(
+            "wte", nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype,
+        )
+        h = jnp.take(wte, input_ids, axis=0)
+        if cfg.pos_embed == "learned":
+            wpe = self.param(
+                "wpe", nn.initializers.normal(0.01),
+                (cfg.n_positions, cfg.hidden_size), cfg.param_dtype,
+            )
+            h = h + jnp.take(wpe, jnp.clip(positions, 0, cfg.n_positions - 1), axis=0)
+        return h.astype(cfg.dtype)
+
+    def attend(self, hidden: Array) -> Array:
+        """Tied-embedding logits: hidden @ wte.T (fp32 accumulation)."""
+        wte = self.get_variable("params", "wte")
+        return jnp.einsum(
+            "bte,ve->btv", hidden, wte.astype(hidden.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+
+class LMHead(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden: Array) -> Array:
+        kernel = self.param(
+            "kernel", nn.initializers.normal(0.02),
+            (self.cfg.hidden_size, self.cfg.vocab_size), self.cfg.param_dtype,
+        )
+        return jnp.einsum(
+            "bte,ev->btv", hidden, kernel.astype(hidden.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Functional composition: explicit param tree, scan over stacked layers
+# ---------------------------------------------------------------------------
+
+
+def make_attention_bias(
+    key_mask: Array,  # [B, S] 1 = attendable key slot
+    q_slots: Array,  # [T] or [B, T] slot index of each query token
+    k_slots: Array,  # [S] slot index of each key slot
+) -> Array:
+    """Additive causal+padding bias [B, 1, T, S] in fp32.
+
+    Causality compares SLOT indices (physical storage order), which stays
+    correct under left padding; rope/wpe positions are a separate notion
+    (real position = cumsum of the mask) handled by the caller.
+    """
+    if q_slots.ndim == 1:
+        q_slots = q_slots[None, :]
+    causal = q_slots[:, :, None] >= k_slots[None, None, :]
+    visible = causal & (key_mask[:, None, :] > 0)
+    return jnp.where(visible, 0.0, NEG_INF)[:, None, :, :].astype(jnp.float32)
+
+
+class TransformerLM:
+    """Functional causal LM: explicit params, scan-over-layers forward.
+
+    params pytree:
+      embed:  {wte, [wpe]}
+      blocks: every Block param stacked with leading axis n_layer
+      ln_f:   final norm
+      [lm_head]: untied output projection
+
+    Not an nn.Module by design — explicit params let the PPO hydra branch
+    (`forward_from_layer` over a sliced param stack) and per-layer freeze
+    masks operate on the tree directly (SURVEY.md §2.5 ModelBranch
+    collapse).
+    """
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+        self.embed = Embedding(cfg)
+        self.block = Block(cfg)
+        self.ln_f = Norm(cfg)
+        self.lm_head = None if cfg.tie_word_embeddings else LMHead(cfg)
+
+    # -- init ------------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> Dict:
+        cfg = self.cfg
+        B, T = 1, 8
+        ids = jnp.zeros((B, T), jnp.int32)
+        pos = jnp.arange(T)[None, :]
+        bias = make_attention_bias(jnp.ones((B, T), jnp.int32), pos, jnp.arange(T))
+
+        r_embed, r_block, r_head, r_lm = jax.random.split(rng, 4)
+        embed_params = self.embed.init(r_embed, ids, pos)["params"]
+        h = jnp.zeros((B, T, cfg.hidden_size), cfg.dtype)
+
+        block_params = jax.vmap(
+            lambda key: self.block.init(key, h, bias, pos)["params"]
+        )(jax.random.split(r_block, cfg.n_layer))
+        params = {
+            "embed": embed_params,
+            "blocks": block_params,
+            "ln_f": self.ln_f.init(r_head, h)["params"],
+        }
+        if self.lm_head is not None:
+            params["lm_head"] = self.lm_head.init(r_lm, h)["params"]
+        return params
+
+    # -- forward ---------------------------------------------------------
+
+    def _scan_blocks(
+        self,
+        block_params: Dict,
+        h: Array,
+        attn_bias: Array,
+        positions: Array,
+        cache: Optional[Dict[str, Array]] = None,
+        remat: bool = False,
+    ) -> Tuple[Array, Optional[Dict[str, Array]]]:
+        """lax.scan over the stacked layer params (and cache layers)."""
+
+        def body(hidden, layer):
+            if cache is not None:
+                lp, layer_kv = layer
+                layer_cache = dict(layer_kv, index=cache["index"])
+            else:
+                lp, layer_cache = layer, None
+            out, new_kv = self.block.apply(
+                {"params": lp}, hidden, attn_bias, positions, layer_cache
+            )
+            return out, new_kv
+
+        if remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+
+        if cache is not None:
+            xs = (block_params, {"k": cache["k"], "v": cache["v"]})
+        else:
+            xs = block_params
+        h, new_kvs = jax.lax.scan(body, h, xs)
+        new_cache = None
+        if cache is not None:
+            new_cache = dict(
+                new_kvs, index=cache["index"] + positions.shape[1],
+                key_mask=cache["key_mask"],
+            )
+        return h, new_cache
+
+    def __call__(
+        self,
+        params: Dict,
+        input_ids: Array,  # [B, T]
+        attention_mask: Optional[Array] = None,  # [B, T]
+        positions: Optional[Array] = None,
+        cache: Optional[Dict[str, Array]] = None,
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        """Full forward. Without `cache`: plain teacher-forced pass over a
+        (possibly left-padded) sequence. With `cache`: the input occupies
+        cache slots [index, index+T) and attends over the cache prefix —
+        the same entry point serves prefill (T=prompt_len) and decode
+        (T=1)."""
+        B, T = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, T), jnp.int32)
+        if cache is not None:
+            S = cache["k"].shape[2]  # [L, B, S, Hkv, D]
+            q_slots = cache["index"] + jnp.arange(T)
+            if positions is None:
+                positions = q_slots[None, :] * jnp.ones((B, 1), jnp.int32)
+            within = jnp.arange(S)[None, :] < cache["index"] + T  # [1, S]
+            key_mask = (within & (cache["key_mask"] > 0)).astype(jnp.int32)
+            bias = make_attention_bias(key_mask, q_slots, jnp.arange(S))
+            layer_cache = cache
+        else:
+            if positions is None:
+                positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+            bias = make_attention_bias(attention_mask, jnp.arange(T), jnp.arange(T))
+            layer_cache = None
+
+        h = self.embed.apply({"params": params["embed"]}, input_ids, positions)
+        h, new_cache = self._scan_blocks(
+            params["blocks"], h, bias, positions, layer_cache, remat=remat
+        )
+        hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
+        logits = self._logits(params, hidden)
+        return {
+            "logits": logits,
+            "hidden_states": hidden,
+            "cache": new_cache,
+            "positions": positions,
+        }
+
+    def _logits(self, params: Dict, hidden: Array) -> Array:
+        if self.lm_head is not None:
+            return self.lm_head.apply({"params": params["lm_head"]}, hidden)
+        return self.embed.apply(
+            {"params": params["embed"]}, hidden, method=Embedding.attend
+        )
+
+    # -- hydra support ---------------------------------------------------
+
+    def forward_with_branch_capture(
+        self,
+        params: Dict,
+        input_ids: Array,
+        attention_mask: Optional[Array],
+        branch_at: int,
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        """Forward that also returns the hidden state entering layer
+        `branch_at`: the scan is split into [0, branch_at) + [branch_at,
+        L), same total compute. The captured hidden feeds the frozen
+        reference branch (`forward_from_layer`)."""
+        B, T = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, T), jnp.int32)
+        positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+        bias = make_attention_bias(attention_mask, jnp.arange(T), jnp.arange(T))
+        h = self.embed.apply({"params": params["embed"]}, input_ids, positions)
+
+        bottom = jax.tree_util.tree_map(lambda x: x[:branch_at], params["blocks"])
+        top = jax.tree_util.tree_map(lambda x: x[branch_at:], params["blocks"])
+        h_branch, _ = self._scan_blocks(bottom, h, bias, positions, remat=remat)
+        h_top, _ = self._scan_blocks(top, h_branch, bias, positions, remat=remat)
+        hidden = self.ln_f.apply({"params": params["ln_f"]}, h_top)
+        logits = self._logits(params, hidden)
+        return {
+            "logits": logits,
+            "hidden_states": hidden,
+            "branch_hidden": h_branch,
+            "positions": positions,
+            "attn_bias": bias,
+        }
+
+    def forward_from_layer(
+        self,
+        branch_params: Dict,
+        branch_hidden: Array,
+        attn_bias: Array,
+        positions: Array,
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        """Run only a top-k branch from a captured hidden state.
+
+        `branch_params` holds {"blocks": stacked top-k params, "ln_f",
+        "embed", ["lm_head"]} — the frozen in-process reference model
+        (parity: hydra `forward_hydra`, reference modeling_ppo.py:410-453).
+        """
+        h, _ = self._scan_blocks(
+            branch_params["blocks"], branch_hidden, attn_bias, positions, remat=remat
+        )
+        hidden = self.ln_f.apply({"params": branch_params["ln_f"]}, h)
+        logits = self._logits(branch_params, hidden)
+        return {"logits": logits, "hidden_states": hidden}
+
+    # -- cache -----------------------------------------------------------
+
+    def init_cache(self, batch: int, max_len: int, key_mask: Optional[Array] = None) -> Dict:
+        """Preallocate a static-shape KV cache [L, B, S, Hkv, D]."""
+        cfg = self.cfg
+        shape = (cfg.n_layer, batch, max_len, cfg.n_kv_head, cfg.head_dim)
+        return {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "index": jnp.int32(0),
+            "key_mask": key_mask if key_mask is not None
+            else jnp.ones((batch, max_len), jnp.int32),
+        }
+
+
+def extract_branch_params(params: Dict, branch_at: int) -> Dict:
+    """Copy the top-(L-branch_at) layers + final norm + logit head as a
+    frozen reference branch. Parity: the hydra 'frozen_head' build
+    (reference modeling_ppo.py:475-499) without per-arch classes."""
+    branch = {
+        "blocks": jax.tree_util.tree_map(lambda x: x[branch_at:], params["blocks"]),
+        "ln_f": params["ln_f"],
+        "embed": params["embed"],
+    }
+    if "lm_head" in params:
+        branch["lm_head"] = params["lm_head"]
+    return jax.lax.stop_gradient(branch)
