@@ -8,10 +8,18 @@ multi-chip path.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-force CPU. The image's sitecustomize imports jax and registers a
+# TPU PJRT plugin at interpreter startup (overriding JAX_PLATFORMS in the
+# environment), so env vars alone are not enough — but backends are not
+# initialized yet, so jax.config still wins if set before first use.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

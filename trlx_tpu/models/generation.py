@@ -1,0 +1,206 @@
+"""Jitted autoregressive generation: static-shape prefill + decode scan.
+
+Parity: the reference delegates sampling to HF `model.generate`
+(/root/reference/trlx/trainer/accelerate_base_trainer.py:256-288) and to a
+custom token-by-token loop for ILQL
+(/root/reference/trlx/models/modeling_ilql.py:325-412). Here generation is
+one jitted function: a KV-cache prefill over the (left-padded) prompt and
+a `lax.scan` over `max_new_tokens` single-token steps.
+
+TPU design notes:
+- Static shapes everywhere: the cache is preallocated to
+  prompt_len + max_new_tokens; finished sequences keep stepping but emit
+  `pad_token_id` (the reference needed `synced_gpus` / no-early-break
+  hacks for ZeRO-3 — SPMD makes "all devices run the full loop" the
+  default, and the mask bookkeeping makes it correct).
+- Sampling is `jax.random.categorical` over processed logits
+  (temperature / top-k / top-p) — fp32 on the VPU, fused by XLA.
+- An optional `logits_processor(hidden, logits) -> logits` hook runs
+  inside the loop; ILQL's `pi_beta + beta*(minQ - V)` shaping plugs in
+  here without a separate decode implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.transformer import TransformerLM
+from trlx_tpu.ops.common import topk_mask
+
+Array = jnp.ndarray
+
+
+@dataclass(frozen=True)
+class SamplerSettings:
+    """Static sampling hyperparameters (hashable: usable as jit statics).
+
+    Mirrors the reference's HF `gen_kwargs` surface
+    (default_configs.py:36: max_new_tokens / top_k / top_p / do_sample /
+    temperature, plus eos/pad ids resolved by the trainer).
+    """
+
+    max_new_tokens: int
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    do_sample: bool = True
+    eos_token_id: int = -1  # -1: never stops early
+    pad_token_id: int = 0
+
+    @classmethod
+    def from_gen_kwargs(cls, gen_kwargs: Dict, eos_token_id=None, pad_token_id=None):
+        kw = dict(gen_kwargs)
+        eos = kw.pop("eos_token_id", eos_token_id)
+        pad = kw.pop("pad_token_id", pad_token_id)
+        known = {f.name for f in dataclasses.fields(cls)}
+        # HF gen_kwargs this sampler doesn't implement (beta is ILQL's
+        # shaping strength, consumed by the logits processor) are ignored
+        # rather than fatal, so reference configs run unmodified
+        kw = {k: v for k, v in kw.items() if k in known}
+        return cls(
+            **kw,
+            eos_token_id=-1 if eos is None else int(eos),
+            pad_token_id=0 if pad is None else int(pad),
+        )
+
+
+def top_p_mask(logits: Array, p: float) -> Array:
+    """Nucleus filtering: mask logits outside the smallest set with
+    cumulative probability >= p (always keeps the argmax)."""
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # a sorted position is kept while the mass *before* it is < p
+    keep = cum - probs < p
+    cutoff = jnp.where(keep, sorted_desc, jnp.inf).min(axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def process_logits(logits: Array, settings: SamplerSettings) -> Array:
+    """Temperature / top-k / top-p pipeline in fp32."""
+    logits = logits.astype(jnp.float32)
+    if settings.temperature != 1.0:
+        logits = logits / max(settings.temperature, 1e-6)
+    if settings.top_k:
+        logits = topk_mask(logits, settings.top_k)
+    if settings.top_p < 1.0:
+        logits = top_p_mask(logits, settings.top_p)
+    return logits
+
+
+def sample_token(rng: jax.Array, logits: Array, settings: SamplerSettings) -> Array:
+    """Draw next tokens [B] from last-position logits [B, V]."""
+    logits = process_logits(logits, settings)
+    if not settings.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    model: TransformerLM,
+    params: Dict,
+    input_ids: Array,  # [B, P] int32, LEFT-padded
+    attention_mask: Array,  # [B, P] int32
+    rng: jax.Array,
+    settings: SamplerSettings,
+    logits_processor: Optional[Callable[[Array, Array], Array]] = None,
+) -> Dict[str, Array]:
+    """Sample up to `settings.max_new_tokens` continuations.
+
+    Returns:
+      sequences:      [B, P+N] prompt ++ response (response right-padded)
+      response_ids:   [B, N]
+      response_mask:  [B, N] 1 for real response tokens (incl. the EOS)
+
+    `logits_processor(hidden_last, logits) -> logits` (both [B, ...]) runs
+    before temperature/top-k/top-p — the ILQL advantage-shaping hook.
+    """
+    B, P = input_ids.shape
+    N = settings.max_new_tokens
+    if N < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    total = P + N
+
+    # response slots count as attendable keys once written
+    key_mask = jnp.concatenate(
+        [attention_mask.astype(jnp.int32), jnp.ones((B, N), jnp.int32)], axis=1
+    )
+    cache = model.init_cache(B, total, key_mask)
+
+    # real positions (rope/wpe) run over non-pad tokens only
+    positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+    out = model(params, input_ids, attention_mask, positions=positions, cache=cache)
+    prompt_len = attention_mask.sum(axis=1)  # [B] real lengths
+
+    def pick_next(rng, hidden_last, logits_last, finished):
+        if logits_processor is not None:
+            logits_last = logits_processor(hidden_last, logits_last)
+        tok = sample_token(rng, logits_last, settings)
+        tok = jnp.where(finished, jnp.int32(settings.pad_token_id), tok)
+        now_finished = finished | (tok == settings.eos_token_id)
+        return tok, now_finished
+
+    rng, sub = jax.random.split(rng)
+    finished0 = jnp.zeros((B,), bool)
+    tok0, finished0 = pick_next(
+        sub, out["hidden_states"][:, -1], out["logits"][:, -1], finished0
+    )
+
+    def step(carry, rng_t):
+        cache, tok, pos, finished, was_real = carry
+        step_out = model(params, tok[:, None], positions=pos[:, None], cache=cache)
+        next_tok, now_finished = pick_next(
+            rng_t, step_out["hidden_states"][:, -1], step_out["logits"][:, -1], finished
+        )
+        # the token we just *emitted* (tok) was real iff its sequence had
+        # not finished before it was sampled
+        y = (tok, was_real)
+        return (step_out["cache"], next_tok, pos + 1, now_finished, ~finished), y
+
+    if N > 1:
+        step_rngs = jax.random.split(rng, N - 1)
+        pos0 = prompt_len  # next token's real position
+        carry0 = (out["cache"], tok0, pos0, finished0, jnp.ones((B,), bool))
+        (cache_f, tok_last, _, finished_f, last_real), (toks, reals) = jax.lax.scan(
+            step, carry0, step_rngs
+        )
+        response_ids = jnp.concatenate(
+            [toks.T, tok_last[:, None]], axis=1
+        )  # [B, N]: t0..t_{N-2} emitted by scan ys, t_{N-1} from final carry
+        response_mask = jnp.concatenate([reals.T, last_real[:, None]], axis=1)
+    else:
+        response_ids = tok0[:, None]
+        response_mask = jnp.ones((B, 1), bool)
+
+    sequences = jnp.concatenate([input_ids, response_ids], axis=1)
+    return {
+        "sequences": sequences,
+        "response_ids": response_ids,
+        "response_mask": response_mask.astype(jnp.int32),
+    }
+
+
+def make_generate_fn(
+    model: TransformerLM,
+    settings: SamplerSettings,
+    logits_processor: Optional[Callable] = None,
+):
+    """Build a jitted `(params, input_ids, attention_mask, rng) -> dict`
+    sampler. Shapes are static per (B, P); XLA caches one executable per
+    distinct prompt padding length (trainers pad prompts to a fixed
+    max_prompt_length so there is exactly one)."""
+
+    @partial(jax.jit, donate_argnums=())
+    def fn(params, input_ids, attention_mask, rng):
+        return generate(
+            model, params, input_ids, attention_mask, rng, settings,
+            logits_processor=logits_processor,
+        )
+
+    return fn

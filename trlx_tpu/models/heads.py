@@ -1,0 +1,107 @@
+"""Auxiliary heads: the 2-layer MLP used for value / Q heads.
+
+Parity: /root/reference/trlx/utils/modeling.py:21-27 (`make_head` =
+Linear(n_embd, 512) -> ReLU -> Linear(512, out) — this fork pins the
+hidden width to 512) and /root/reference/trlx/models/modeling_ilql.py:169-227
+(`ILQLHeads`: v head + 1-2 q heads + frozen Polyak-synced target q heads).
+
+Heads are plain param pytrees ({"fc_in": {kernel, bias}, "fc_out":
+{kernel, bias}}) applied by pure functions, so trainers can freeze / sync
+/ shard them with tree ops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+HEAD_HIDDEN = 512  # fork-pinned width (reference utils/modeling.py:21-27)
+
+
+def init_head(
+    rng: jax.Array,
+    in_dim: int,
+    out_dim: int,
+    hidden: int = HEAD_HIDDEN,
+    dtype=jnp.float32,
+) -> Dict:
+    k1, k2 = jax.random.split(rng)
+    scale_in = 1.0 / jnp.sqrt(jnp.float32(in_dim))
+    scale_h = 1.0 / jnp.sqrt(jnp.float32(hidden))
+    return {
+        "fc_in": {
+            "kernel": (jax.random.uniform(k1, (in_dim, hidden), jnp.float32, -1, 1) * scale_in).astype(dtype),
+            "bias": jnp.zeros((hidden,), dtype),
+        },
+        "fc_out": {
+            "kernel": (jax.random.uniform(k2, (hidden, out_dim), jnp.float32, -1, 1) * scale_h).astype(dtype),
+            "bias": jnp.zeros((out_dim,), dtype),
+        },
+    }
+
+
+def apply_head(params: Dict, x: Array) -> Array:
+    """MLP head in fp32 (value/Q losses are fp32; negligible FLOPs)."""
+    x = x.astype(jnp.float32)
+    h = jax.nn.relu(x @ params["fc_in"]["kernel"].astype(jnp.float32) + params["fc_in"]["bias"])
+    return h @ params["fc_out"]["kernel"].astype(jnp.float32) + params["fc_out"]["bias"]
+
+
+# ---------------------------------------------------------------------------
+# ILQL head group
+# ---------------------------------------------------------------------------
+
+
+def init_ilql_heads(
+    rng: jax.Array, hidden_size: int, vocab_size: int, two_qs: bool = True
+) -> Dict:
+    """{"q_heads": [...], "target_q_heads": [...], "v_head": ...}.
+
+    Target heads start as copies of the online heads (reference
+    modeling_ilql.py:186-191 `copy_(...)` on init via sync alpha=1).
+    """
+    n_qs = 2 if two_qs else 1
+    keys = jax.random.split(rng, n_qs + 1)
+    q_heads = [init_head(keys[i], hidden_size, vocab_size) for i in range(n_qs)]
+    return {
+        "q_heads": q_heads,
+        "target_q_heads": jax.tree_util.tree_map(lambda x: x, q_heads),
+        "v_head": init_head(keys[-1], hidden_size, 1),
+    }
+
+
+def apply_ilql_heads(
+    heads: Dict,
+    hidden: Array,  # [B, T, E]
+    states_ixs: Array,  # [B, n_states]
+    actions_ixs: Array,  # [B, n_actions]
+) -> Tuple[Sequence[Array], Sequence[Array], Array]:
+    """Gather hidden states first, then apply heads (the reference does the
+    same — modeling_ilql.py:193-208 — so Q/V matmuls run over n_actions,
+    not the full sequence)."""
+    from trlx_tpu.ops.common import batched_index_select
+
+    states_hs = batched_index_select(hidden, states_ixs, dim=1)
+    actions_hs = batched_index_select(hidden, actions_ixs, dim=1)
+    qs = [apply_head(h, actions_hs) for h in heads["q_heads"]]
+    target_qs = [
+        jax.lax.stop_gradient(apply_head(h, actions_hs))
+        for h in heads["target_q_heads"]
+    ]
+    vs = apply_head(heads["v_head"], states_hs)
+    return qs, target_qs, vs
+
+
+def sync_target_q_heads(heads: Dict, alpha: float) -> Dict:
+    """Polyak update target <- alpha * online + (1 - alpha) * target
+    (parity: modeling_ilql.py:210-227)."""
+    new_targets = jax.tree_util.tree_map(
+        lambda q, t: alpha * q + (1.0 - alpha) * t,
+        heads["q_heads"],
+        heads["target_q_heads"],
+    )
+    return dict(heads, target_q_heads=new_targets)

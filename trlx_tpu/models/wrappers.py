@@ -1,0 +1,182 @@
+"""Model wrappers: causal LM + value head (PPO, with in-process frozen
+reference branch) and causal LM + ILQL heads.
+
+Parity: /root/reference/trlx/models/modeling_ppo.py:244-499
+(`AutoModelForCausalLMWith{Value,HydraValue}Head`) and
+modeling_ilql.py:262-479 (`AutoModelForCausalLMWithILQLHeads`). The
+reference's per-architecture `ModelBranch` classes (modeling_ppo.py:502-1637)
+are unnecessary here: the frozen reference branch is a slice of the stacked
+layer stack re-run from the captured hidden state
+(`TransformerLM.forward_with_branch_capture` / `forward_from_layer`).
+
+Wrappers are functional: `params` trees in, activation dicts out, so the
+trainers can jit/shard/donate them directly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.heads import (
+    apply_head,
+    apply_ilql_heads,
+    init_head,
+    init_ilql_heads,
+    sync_target_q_heads,
+)
+from trlx_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    extract_branch_params,
+)
+
+Array = jnp.ndarray
+
+
+class CausalLMWithValueHead:
+    """Policy LM + scalar value head; optional hydra reference branch.
+
+    `branch_at` (= n_layer - num_layers_unfrozen) picks where the frozen
+    reference branch forks off. With `branch_at is None` (all layers
+    unfrozen) PPO needs a full frozen copy of the params as reference —
+    the trainer keeps that copy and calls `forward_ref_full`.
+    """
+
+    def __init__(self, cfg: TransformerConfig, branch_at: Optional[int] = None):
+        self.cfg = cfg
+        self.lm = TransformerLM(cfg)
+        self.branch_at = branch_at
+
+    # -- params ----------------------------------------------------------
+
+    def init_params(self, rng: jax.Array, base_params: Optional[Dict] = None) -> Dict:
+        r_base, r_head = jax.random.split(rng)
+        if base_params is None:
+            base_params = self.lm.init(r_base)
+        return {
+            "base": base_params,
+            "v_head": init_head(r_head, self.cfg.hidden_size, 1),
+        }
+
+    def make_ref_params(self, params: Dict) -> Dict:
+        """Frozen reference: the top branch only (hydra) or the full tree."""
+        if self.branch_at is not None:
+            return extract_branch_params(params["base"], self.branch_at)
+        return jax.lax.stop_gradient(params["base"])
+
+    # -- forwards --------------------------------------------------------
+
+    def forward(
+        self,
+        params: Dict,
+        input_ids: Array,
+        attention_mask: Optional[Array] = None,
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        out = self.lm(params["base"], input_ids, attention_mask, remat=remat)
+        values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
+        return dict(out, values=values)
+
+    def forward_train(
+        self,
+        params: Dict,
+        ref_params: Dict,
+        input_ids: Array,
+        attention_mask: Optional[Array] = None,
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        """One pass producing policy logits, values AND reference logits.
+
+        Hydra mode shares the trunk below `branch_at` between policy and
+        reference (the whole point of the reference's hydra heads —
+        modeling_ppo.py:410-453 — done here with an array slice instead of
+        six per-arch branch classes).
+        """
+        if self.branch_at is None:
+            out = self.forward(params, input_ids, attention_mask, remat=remat)
+            ref_out = self.lm(ref_params, input_ids, attention_mask, remat=remat)
+            return dict(out, ref_logits=jax.lax.stop_gradient(ref_out["logits"]))
+
+        out = self.lm.forward_with_branch_capture(
+            params["base"], input_ids, attention_mask, self.branch_at, remat=remat
+        )
+        values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
+        ref_out = self.lm.forward_from_layer(
+            ref_params,
+            jax.lax.stop_gradient(out["branch_hidden"]),
+            out["attn_bias"],
+            out["positions"],
+            remat=remat,
+        )
+        return dict(
+            out, values=values, ref_logits=jax.lax.stop_gradient(ref_out["logits"])
+        )
+
+
+class CausalLMWithILQLHeads:
+    """Causal LM + ILQL head group (v, q, frozen target q).
+
+    Parity: modeling_ilql.py:262-479; generation-time advantage shaping is
+    a `logits_processor` for trlx_tpu.models.generation (built by
+    `make_ilql_logits_processor`).
+    """
+
+    def __init__(self, cfg: TransformerConfig, two_qs: bool = True, alpha: float = 0.001):
+        self.cfg = cfg
+        self.lm = TransformerLM(cfg)
+        self.two_qs = two_qs
+        self.alpha = alpha
+
+    def init_params(self, rng: jax.Array, base_params: Optional[Dict] = None) -> Dict:
+        r_base, r_heads = jax.random.split(rng)
+        if base_params is None:
+            base_params = self.lm.init(r_base)
+        return {
+            "base": base_params,
+            "heads": init_ilql_heads(
+                r_heads, self.cfg.hidden_size, self.cfg.vocab_size, self.two_qs
+            ),
+        }
+
+    def forward(
+        self,
+        params: Dict,
+        input_ids: Array,
+        attention_mask: Optional[Array],
+        states_ixs: Array,
+        actions_ixs: Array,
+        remat: bool = False,
+    ) -> Tuple[Array, Tuple]:
+        """Returns (logits_at_actions, (qs, target_qs, vs)) — the shape the
+        ILQL loss consumes (trlx_tpu.ops.ilql.ilql_loss)."""
+        from trlx_tpu.ops.common import batched_index_select
+
+        out = self.lm(params["base"], input_ids, attention_mask, remat=remat)
+        qs, target_qs, vs = apply_ilql_heads(
+            params["heads"], out["hidden_states"], states_ixs, actions_ixs
+        )
+        logits_at_actions = batched_index_select(out["logits"], actions_ixs, dim=1)
+        return logits_at_actions, (qs, target_qs, vs)
+
+    def sync_target(self, params: Dict, alpha: Optional[float] = None) -> Dict:
+        return dict(
+            params,
+            heads=sync_target_q_heads(
+                params["heads"], self.alpha if alpha is None else alpha
+            ),
+        )
+
+    def make_logits_processor(self, params_heads: Dict, beta: float):
+        """Advantage shaping `log pi_beta + beta * (minQ - V)` for the
+        jitted decode loop (parity: modeling_ilql.py:365-374)."""
+        from trlx_tpu.ops.ilql import ilql_shape_logits
+
+        def processor(hidden_last: Array, logits_last: Array) -> Array:
+            qs = [apply_head(h, hidden_last) for h in params_heads["target_q_heads"]]
+            vs = apply_head(params_heads["v_head"], hidden_last)
+            return ilql_shape_logits(logits_last, qs, vs, beta)
+
+        return processor

@@ -1,0 +1,29 @@
+"""Parallelism: one device mesh, sharding as config.
+
+Replaces the reference's three parallelism facades (Accelerate
+DDP/DeepSpeed ZeRO, raw torch.distributed, Apex `parallel_state` —
+SURVEY.md §2.7/2.8) with a single `jax.sharding.Mesh` carrying named axes:
+
+  dp    replicated data parallel            (DDP parity)
+  fsdp  param/opt-state sharded data parallel (ZeRO-3 parity)
+  tp    tensor parallel                     (Megatron TP parity)
+  sp    sequence/context parallel           (long-context upgrade path)
+
+XLA emits the collectives (psum / all-gather / reduce-scatter) over
+ICI/DCN from sharding annotations; there is no NCCL-style call-site code
+to port.
+"""
+
+from trlx_tpu.parallel.mesh import (  # noqa: F401
+    MeshAxes,
+    batch_pspec,
+    data_sharding,
+    local_batch_size,
+    make_mesh,
+    replicated_sharding,
+)
+from trlx_tpu.parallel.sharding import (  # noqa: F401
+    infer_param_pspecs,
+    param_shardings,
+    shard_params,
+)

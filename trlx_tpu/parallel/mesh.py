@@ -1,0 +1,78 @@
+"""Mesh construction from the `TrainConfig.mesh` axis-size dict.
+
+The reference picks parallelism by choosing a backend + YAML
+(configs/accelerate/zero*.yaml vs configs/nemo_configs/megatron_*.yaml);
+here `{"dp": -1, "fsdp": 8, "tp": 4, "sp": 1}` is the whole story: one
+axis may be -1 to absorb the remaining devices.
+
+Device order: axes are laid out (dp, fsdp, tp, sp) major-to-minor so tp
+(the chattiest axis: per-matmul all-reduces) maps to physically adjacent
+devices on the ICI torus — the same reasoning as Megatron's
+tensor-parallel-innermost group layout.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = ("dp", "fsdp", "tp", "sp")
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh over `devices` (default: all) from an axis-size dict.
+
+    Any single axis set to -1 absorbs the remaining device count; absent
+    axes default to 1 (dp defaults to -1).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1}
+    sizes.update(axis_sizes or {})
+    unknown = set(sizes) - set(MeshAxes)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {MeshAxes}")
+
+    fill = [ax for ax, s in sizes.items() if s == -1]
+    if len(fill) > 1:
+        raise ValueError(f"only one mesh axis may be -1, got {fill}")
+    fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+    if fill:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+        sizes[fill[0]] = n // fixed
+    elif fixed != n:
+        raise ValueError(f"mesh {sizes} needs {fixed} devices, have {n}")
+
+    shape = tuple(sizes[ax] for ax in MeshAxes)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MeshAxes)
+
+
+def batch_pspec(shard_seq: bool = False) -> P:
+    """PartitionSpec for a [batch, seq, ...] array: batch over (dp, fsdp)
+    — fsdp devices are data-parallel for activations, ZeRO-style — and
+    optionally seq over sp."""
+    return P(("dp", "fsdp"), "sp" if shard_seq else None)
+
+
+def data_sharding(mesh: Mesh, shard_seq: bool = False) -> NamedSharding:
+    return NamedSharding(mesh, batch_pspec(shard_seq))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+    """Per-data-shard batch (dp*fsdp ways)."""
+    ways = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if global_batch % ways:
+        raise ValueError(f"batch {global_batch} not divisible by dp*fsdp={ways}")
+    return global_batch // ways

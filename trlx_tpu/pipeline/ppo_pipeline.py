@@ -1,0 +1,82 @@
+"""PPO rollout storage.
+
+Parity: /root/reference/trlx/pipeline/ppo_pipeline.py:14-104. The
+reference stores ragged per-sample tensors and pads at collate time;
+rollouts here are born rectangular (PPORolloutBatch — queries left-padded
+to max_prompt_length, responses right-padded to max_new_tokens), so the
+store is row-indexed numpy and collation is pure slicing: zero host
+compute between rollout and train step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from trlx_tpu.data import PPORolloutBatch
+from trlx_tpu.pipeline import BaseRolloutStore, DataLoader
+
+
+class PPORolloutStorage(BaseRolloutStore):
+    """Experience buffer of PPO rollouts (pushed as PPORolloutBatch)."""
+
+    def __init__(self, pad_token_id: int = 0):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        self.history: Optional[PPORolloutBatch] = None
+
+    def push(self, exps: PPORolloutBatch) -> None:
+        exps = jax.tree_util.tree_map(np.asarray, exps)
+        if self.history is None:
+            self.history = exps
+        else:
+            self.history = jax.tree_util.tree_map(
+                lambda a, b: np.concatenate([a, b], axis=0), self.history, exps
+            )
+
+    def clear_history(self) -> None:
+        self.history = None
+
+    def __len__(self) -> int:
+        return 0 if self.history is None else len(self.history.query_tensors)
+
+    def __getitem__(self, ix: int) -> PPORolloutBatch:
+        return jax.tree_util.tree_map(lambda x: x[ix], self.history)
+
+    def export_history(self, location: str, tokenizer=None) -> None:
+        """Dump rollouts as JSON for algorithm-distillation-style logging
+        (parity: reference ppo_pipeline.py:30-49)."""
+        os.makedirs(location, exist_ok=True)
+        fpath = os.path.join(location, f"epoch-{str(time.time())}.json")
+
+        def exp_to_dict(i: int):
+            d = {
+                "query_tensor": self.history.query_tensors[i].tolist(),
+                "response_tensor": self.history.response_tensors[i].tolist(),
+                "logprobs": self.history.logprobs[i].tolist(),
+                "values": self.history.values[i].tolist(),
+                "rewards": self.history.rewards[i].tolist(),
+            }
+            if tokenizer is not None:
+                d["query"] = tokenizer.decode(d["query_tensor"])
+                d["response"] = tokenizer.decode(d["response_tensor"])
+            return d
+
+        with open(fpath, "w") as f:
+            json.dump([exp_to_dict(i) for i in range(len(self))], f)
+
+    def collate(self, elems: List[PPORolloutBatch]) -> PPORolloutBatch:
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *elems)
+
+    def create_loader(
+        self, batch_size: int, shuffle: bool = False, drop_last: bool = False, seed: int = 0
+    ) -> DataLoader:
+        return DataLoader(
+            self, batch_size, collate_fn=self.collate, shuffle=shuffle,
+            drop_last=drop_last, seed=seed,
+        )
