@@ -1,0 +1,222 @@
+"""Benchmark: PPO throughput (samples/sec) on a GPT2-small-class model.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The driver's north star (BASELINE.json) is GPT2-small PPO sentiments at
+>= 8x the Accelerate-CPU baseline's samples/sec. With zero network
+egress the IMDB checkpoint/reward model can't be fetched, so this bench
+runs the same *workload shape* end to end with random-init weights and a
+host-side synthetic reward:
+
+  rollout: sample 32 new tokens per prompt (left-padded prompts, 32) for
+           `num_rollouts` prompts, decode + reward round-trip to host,
+           teacher-forced policy+ref+value forward, KL penalty
+  train:   4 PPO epochs over the rollouts (GAE + clipped surrogate +
+           AdamW), batch 32
+
+The baseline is the SAME loop driven through torch/transformers on CPU
+(the reference's Accelerate-CPU configuration), measured once and cached
+in .bench_baseline.json. samples/sec = num_rollouts / (rollout + train
+wall time), steady-state (one warmup cycle first).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+# GPT2-small geometry
+L, H, HEADS, VOCAB = 12, 768, 12, 50257
+PROMPT_LEN, NEW_TOKENS = 32, 32
+NUM_ROLLOUTS, CHUNK, BATCH, PPO_EPOCHS = 64, 32, 32, 4
+
+BASELINE_CACHE = os.path.join(REPO, ".bench_baseline.json")
+
+
+class WideByteTokenizer:
+    """ByteTokenizer view over a GPT2-sized vocab: encode produces byte
+    ids (< 258 ⊂ 50257); decode folds sampled ids into byte space so the
+    host reward round-trip is exercised at full vocab width."""
+
+    def __init__(self):
+        from trlx_tpu.utils.tokenizers import ByteTokenizer
+
+        self._bt = ByteTokenizer()
+        self.vocab_size = VOCAB
+        for attr in ("bos_token", "eos_token", "pad_token",
+                     "bos_token_id", "eos_token_id", "pad_token_id",
+                     "padding_side", "truncation_side"):
+            setattr(self, attr, getattr(self._bt, attr))
+
+    def __call__(self, *a, **kw):
+        return self._bt(*a, **kw)
+
+    def decode(self, ids, skip_special_tokens=True):
+        folded = [int(i) if int(i) < 258 else int(i) % 256 for i in ids]
+        return self._bt.decode(folded, skip_special_tokens)
+
+    def batch_decode(self, batch, skip_special_tokens=True):
+        return [self.decode(ids, skip_special_tokens) for ids in batch]
+
+    def save_pretrained(self, path):
+        self._bt.save_pretrained(path)
+
+
+def reward_fn(samples, prompts, outputs, **kw):
+    return [float(o.count("a")) - 0.1 * len(o) for o in outputs]
+
+
+PROMPTS = [
+    "the movie was", "I watched this and", "a review of the film:",
+    "honestly the plot", "the acting in this", "what a film,",
+    "two hours of", "the director chose",
+] * 16
+
+
+def bench_tpu() -> float:
+    import jax
+
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=BATCH, total_steps=10_000, eval_interval=10_000,
+            checkpoint_interval=10_000, seq_length=PROMPT_LEN + NEW_TOKENS,
+            epochs=10_000, tracker=None,
+            checkpoint_dir=os.path.join("/tmp", "bench_ckpts"),
+            compute_dtype="bfloat16",
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    vocab_size=VOCAB, hidden_size=H, n_layer=L, n_head=HEADS,
+                    n_positions=1024,
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=NUM_ROLLOUTS, chunk_size=CHUNK, ppo_epochs=PPO_EPOCHS,
+            gen_kwargs=dict(max_new_tokens=NEW_TOKENS, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.utils.loading import get_trainer
+
+    trainer_cls = get_trainer(config.train.trainer)
+    trainer = trainer_cls(config=config, reward_fn=reward_fn)
+    trainer.tokenizer = WideByteTokenizer()
+
+    pipeline = PromptPipeline(PROMPTS, PROMPT_LEN, trainer.tokenizer)
+    trainer.add_prompt_pipeline(pipeline)
+
+    def cycle():
+        trainer.store.clear_history()
+        trainer.make_experience(NUM_ROLLOUTS)
+        if trainer._train_step is None:
+            trainer._train_step = trainer.make_train_step()
+        for _ in range(PPO_EPOCHS):
+            for batch in trainer.store.create_loader(BATCH, shuffle=True, drop_last=True):
+                db = trainer.place_batch(batch)
+                with trainer.mesh:
+                    trainer.params, trainer.opt_state, loss, _ = trainer._train_step(
+                        trainer.params, trainer.opt_state, db
+                    )
+        jax.block_until_ready(trainer.params)
+
+    cycle()  # warmup: compiles sampler, experience fn, train step
+    t0 = time.time()
+    cycle()
+    dt = time.time() - t0
+    return NUM_ROLLOUTS / dt
+
+
+def bench_torch_cpu() -> float:
+    """The reference stack's CPU configuration on the same workload."""
+    import torch
+    import transformers
+
+    torch.manual_seed(0)
+    cfg = transformers.GPT2Config(
+        vocab_size=VOCAB, n_positions=1024, n_embd=H, n_layer=L, n_head=HEADS,
+    )
+    model = transformers.GPT2LMHeadModel(cfg)
+    ref_model = transformers.GPT2LMHeadModel(cfg)
+    ref_model.eval()
+    v_head = torch.nn.Sequential(
+        torch.nn.Linear(H, 512), torch.nn.ReLU(), torch.nn.Linear(512, 1)
+    )
+    opt = torch.optim.AdamW(
+        list(model.parameters()) + list(v_head.parameters()), lr=3e-5
+    )
+    tok = WideByteTokenizer()
+
+    enc = tok(PROMPTS[:NUM_ROLLOUTS], truncation=True, padding="max_length",
+              max_length=PROMPT_LEN)
+    input_ids = torch.tensor(enc["input_ids"])
+    attn = torch.tensor(enc["attention_mask"])
+
+    def cycle():
+        rollouts = []
+        for i in range(0, NUM_ROLLOUTS, CHUNK):
+            ids, mask = input_ids[i : i + CHUNK], attn[i : i + CHUNK]
+            with torch.no_grad():
+                samples = model.generate(
+                    ids, attention_mask=mask, do_sample=True,
+                    max_new_tokens=NEW_TOKENS, pad_token_id=tok.pad_token_id,
+                )
+            texts = tok.batch_decode(samples.tolist())
+            _scores = reward_fn(texts, texts, texts)
+            full_mask = torch.cat([mask, torch.ones(len(ids), samples.shape[1] - PROMPT_LEN, dtype=mask.dtype)], 1)
+            with torch.no_grad():
+                out = model(samples, attention_mask=full_mask, output_hidden_states=True)
+                _values = v_head(out.hidden_states[-1])
+                _ref = ref_model(samples, attention_mask=full_mask)
+            rollouts.append((samples, full_mask))
+        for _ in range(PPO_EPOCHS):
+            for samples, full_mask in rollouts:
+                out = model(samples, attention_mask=full_mask, output_hidden_states=True)
+                values = v_head(out.hidden_states[-1]).squeeze(-1)
+                logp = torch.log_softmax(out.logits[:, :-1].float(), -1)
+                picked = logp.gather(-1, samples[:, 1:, None])[..., 0]
+                loss = -(picked.mean()) + values.pow(2).mean()
+                opt.zero_grad()
+                loss.backward()
+                opt.step()
+
+    t0 = time.time()
+    cycle()
+    dt = time.time() - t0
+    return NUM_ROLLOUTS / dt
+
+
+def main():
+    if os.path.exists(BASELINE_CACHE):
+        with open(BASELINE_CACHE) as f:
+            baseline = json.load(f)["samples_per_sec"]
+    else:
+        baseline = bench_torch_cpu()
+        with open(BASELINE_CACHE, "w") as f:
+            json.dump({"samples_per_sec": baseline, "measured_at": time.time()}, f)
+
+    value = bench_tpu()
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_gpt2s_samples_per_sec",
+                "value": round(value, 3),
+                "unit": "samples/s",
+                "vs_baseline": round(value / baseline, 2) if baseline else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""ILQL GPT2 on IMDB sentiment (parity:
+/root/reference/examples/ilql_sentiments.py): offline training on raw
+reviews labeled by a sentiment classifier."""
+
+from typing import Dict, List
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_ilql_config
+
+
+def get_positive_score(scores: List[Dict[str, float]]) -> float:
+    return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_ilql_config().to_dict(), hparams)
+
+    from datasets import load_dataset
+    from transformers import pipeline as hf_pipeline
+
+    sentiment_fn = hf_pipeline(
+        "sentiment-analysis",
+        "lvwerra/distilbert-imdb",
+        top_k=2,
+        truncation=True,
+        batch_size=256,
+    )
+
+    def metric_fn(samples: List[str], **kwargs) -> Dict[str, List[float]]:
+        return {"sentiments": list(map(get_positive_score, sentiment_fn(samples)))}
+
+    imdb = load_dataset("imdb", split="train+test")
+
+    return trlx_tpu.train(
+        samples=imdb["text"],
+        rewards=metric_fn(imdb["text"])["sentiments"],
+        eval_prompts=["I don't know much about Hungarian underground"] * 256,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

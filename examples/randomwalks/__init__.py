@@ -1,0 +1,1 @@
+from examples.randomwalks.randomwalks import generate_random_walks  # noqa: F401

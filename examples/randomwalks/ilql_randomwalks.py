@@ -1,0 +1,77 @@
+"""ILQL on the randomwalks task (parity:
+/root/reference/examples/randomwalks/ilql_randomwalks.py): offline
+training on the random walk corpus with per-walk optimality rewards."""
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.method_configs import ILQLConfig
+
+from examples.randomwalks import generate_random_walks
+
+default_config = TRLConfig(
+    train=TrainConfig(
+        seq_length=11,
+        epochs=100,
+        total_steps=1000,
+        batch_size=96,
+        checkpoint_interval=100000,
+        eval_interval=16,
+        pipeline="PromptPipeline",
+        trainer="TPUILQLTrainer",
+        tracker=None,
+        checkpoint_dir="ckpts/ilql_randomwalks",
+    ),
+    model=ModelConfig(
+        model_path="random",
+        num_layers_unfrozen=-1,
+        model_extra_configs={
+            "transformer": dict(hidden_size=144, n_layer=4, n_head=6, n_positions=32)
+        },
+    ),
+    tokenizer=TokenizerConfig(tokenizer_path="byte", truncation_side="right"),
+    optimizer=OptimizerConfig(
+        name="adamw", kwargs=dict(lr=2.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6)
+    ),
+    scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=1000, eta_min=2.0e-4)),
+    method=ILQLConfig(
+        name="ilqlconfig",
+        tau=0.9,
+        gamma=0.99,
+        cql_scale=0.1,
+        awac_scale=1,
+        alpha=0.1,
+        beta=0,
+        steps_for_target_q_sync=5,
+        two_qs=True,
+        gen_kwargs=dict(max_new_tokens=9, top_k=10, beta=[0, 1, 100], temperature=1.0),
+    ),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+    metric_fn, eval_prompts, walks, _ = generate_random_walks(seed=config.train.seed)
+    rewards = metric_fn(walks)["optimality"]
+
+    return trlx_tpu.train(
+        samples=walks,
+        rewards=rewards,
+        eval_prompts=eval_prompts,
+        metric_fn=lambda samples, **kwargs: metric_fn(samples),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,117 @@
+"""PPO on the randomwalks task (parity:
+/root/reference/examples/randomwalks/ppo_randomwalks.py). Runs with zero
+network egress: a small random-init decoder trained from scratch with the
+built-in byte tokenizer."""
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.method_configs import PPOConfig
+
+from examples.randomwalks import generate_random_walks
+
+default_config = TRLConfig(
+    train=TrainConfig(
+        seq_length=10,
+        epochs=20,
+        total_steps=1000,
+        batch_size=96,
+        checkpoint_interval=10000,
+        eval_interval=20,
+        pipeline="PromptPipeline",
+        trainer="TPUPPOTrainer",
+        tracker=None,
+        checkpoint_dir="ckpts/ppo_randomwalks",
+    ),
+    model=ModelConfig(
+        model_path="random",
+        num_layers_unfrozen=-1,
+        model_extra_configs={
+            "transformer": dict(hidden_size=144, n_layer=4, n_head=6, n_positions=32)
+        },
+    ),
+    tokenizer=TokenizerConfig(tokenizer_path="byte", truncation_side="right"),
+    optimizer=OptimizerConfig(
+        name="adamw", kwargs=dict(lr=3.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6)
+    ),
+    scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=3.0e-4)),
+    method=PPOConfig(
+        name="PPOConfig",
+        num_rollouts=96,
+        chunk_size=96,
+        ppo_epochs=4,
+        init_kl_coef=0,
+        target=None,
+        horizon=10000,
+        gamma=1,
+        lam=0.95,
+        cliprange=0.2,
+        cliprange_value=0.2,
+        vf_coef=1.2,
+        scale_reward="ignored",
+        ref_mean=None,
+        ref_std=None,
+        cliprange_reward=1,
+        gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, do_sample=True),
+    ),
+)
+
+
+def bc_warmup(config, walks) -> str:
+    """Behavior-clone the random-walk corpus so PPO starts from a model
+    that emits valid walks. (The reference starts from the pretrained
+    CarperAI/randomwalks checkpoint — examples/randomwalks/ppo_randomwalks.py:31
+    — which the zero-egress TPU environment must reproduce locally.)"""
+    import os
+
+    sft_dir = os.path.join(config.train.checkpoint_dir, "bc_warmup")
+    model_dir = os.path.join(sft_dir, "hf_model")
+    if not os.path.exists(os.path.join(model_dir, "trlx_tpu_config.json")):
+        from trlx_tpu.data.method_configs import SFTConfig
+
+        sft_config = TRLConfig.from_dict(
+            dict(
+                config.to_dict(),
+                method=SFTConfig(name="sftconfig", gen_kwargs=dict(max_new_tokens=9)).to_dict(),
+            )
+        ).evolve(
+            train=dict(
+                trainer="TPUSFTTrainer", total_steps=200, epochs=40,
+                eval_interval=1000, checkpoint_interval=1000,
+                checkpoint_dir=sft_dir,
+            ),
+        )
+        trainer = trlx_tpu.train(
+            samples=[(w[0], w[1:]) for w in walks], config=sft_config
+        )
+        trainer.save_pretrained(model_dir)
+    return model_dir
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+    metric_fn, prompts, walks, _ = generate_random_walks(seed=config.train.seed)
+
+    config.model.model_path = bc_warmup(config, walks)
+
+    return trlx_tpu.train(
+        reward_fn=lambda samples, **kwargs: metric_fn(samples)["optimality"],
+        prompts=prompts,
+        eval_prompts=prompts,
+        metric_fn=lambda samples, **kwargs: metric_fn(samples),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,71 @@
+"""RFT on the randomwalks task (parity:
+/root/reference/examples/randomwalks/rft_randomwalks.py)."""
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.method_configs import RFTConfig
+
+from examples.randomwalks import generate_random_walks
+
+default_config = TRLConfig(
+    train=TrainConfig(
+        seq_length=11,
+        epochs=100,
+        total_steps=200,
+        batch_size=96,
+        checkpoint_interval=100000,
+        eval_interval=16,
+        pipeline="PromptPipeline",
+        trainer="TPURFTTrainer",
+        tracker=None,
+        checkpoint_dir="ckpts/rft_randomwalks",
+    ),
+    model=ModelConfig(
+        model_path="random",
+        num_layers_unfrozen=-1,
+        model_extra_configs={
+            "transformer": dict(hidden_size=144, n_layer=4, n_head=6, n_positions=32)
+        },
+    ),
+    tokenizer=TokenizerConfig(tokenizer_path="byte", truncation_side="right"),
+    optimizer=OptimizerConfig(
+        name="adamw", kwargs=dict(lr=3.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6)
+    ),
+    scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=1000, eta_min=3.0e-4)),
+    method=RFTConfig(
+        name="rftconfig",
+        n_generations_per_prompt=8,
+        start_percentile=0.9,
+        end_percentile=0.95,
+        n_improve_steps=4,
+        gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, do_sample=True),
+    ),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+    metric_fn, prompts, *_ = generate_random_walks(seed=config.train.seed)
+
+    return trlx_tpu.train(
+        reward_fn=lambda samples, **kwargs: metric_fn(samples)["optimality"],
+        prompts=prompts,
+        eval_prompts=prompts,
+        metric_fn=lambda samples, **kwargs: metric_fn(samples),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,48 @@
+"""SFT GPT2 on positive IMDB reviews (parity:
+/root/reference/examples/sft_sentiments.py)."""
+
+from typing import Dict, List
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_sft_config
+
+
+def get_positive_score(scores: List[Dict[str, float]]) -> float:
+    return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_sft_config().to_dict(), hparams)
+
+    from datasets import load_dataset
+    from transformers import pipeline as hf_pipeline
+
+    imdb = load_dataset("imdb", split="train")
+    # fine-tune on positive reviews only
+    samples = [sample["text"] for sample in imdb if sample["label"] == 1][:10000]
+
+    sentiment_fn = hf_pipeline(
+        "sentiment-analysis",
+        "lvwerra/distilbert-imdb",
+        top_k=2,
+        truncation=True,
+        batch_size=256,
+    )
+
+    def metric_fn(samples: List[str], **kwargs) -> Dict[str, List[float]]:
+        return {"sentiments": list(map(get_positive_score, sentiment_fn(samples)))}
+
+    return trlx_tpu.train(
+        samples=samples,
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,103 @@
+"""Generation tests: greedy/teacher-forced consistency, EOS masking,
+sampling processors (reference analog: HF generate is assumed correct;
+here the decode loop is first-party so it gets direct coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.generation import (
+    SamplerSettings,
+    generate,
+    process_logits,
+    top_p_mask,
+)
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=16, n_layer=2, n_head=2, n_positions=64,
+        dtype=jnp.float32,
+    )
+    lm = TransformerLM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    return lm, params
+
+
+def test_greedy_matches_teacher_forced(tiny_lm):
+    lm, params = tiny_lm
+    B, P, N = 2, 6, 5
+    ids = jax.random.randint(jax.random.PRNGKey(1), (B, P), 0, 64)
+    mask = jnp.ones((B, P), jnp.int32).at[0, :2].set(0)  # left-pad row 0
+    settings = SamplerSettings(max_new_tokens=N, do_sample=False)
+    out = generate(lm, params, ids, mask, jax.random.PRNGKey(2), settings)
+
+    full_mask = jnp.concatenate([mask, jnp.ones((B, N), jnp.int32)], 1)
+    logits = lm(params, out["sequences"], full_mask)["logits"]
+    for b in range(B):
+        for t in range(N):
+            pred = int(jnp.argmax(logits[b, P + t - 1]))
+            assert pred == int(out["sequences"][b, P + t])
+
+
+def test_eos_stops_and_pads(tiny_lm):
+    lm, params = tiny_lm
+    B, P, N = 2, 4, 6
+    EOS, PAD = 7, 9
+    ids = jnp.ones((B, P), jnp.int32)
+    mask = jnp.ones((B, P), jnp.int32)
+
+    calls = {"n": 0}
+
+    def force_eos_at_2(hidden, logits):
+        # step counter trick won't trace; instead force EOS always for
+        # row 0 and never for row 1 via a huge logit bump
+        bump = jnp.zeros_like(logits).at[0, EOS].set(1e9)
+        anti = jnp.zeros_like(logits).at[1, EOS].set(-1e9)
+        return logits + bump + anti
+
+    settings = SamplerSettings(
+        max_new_tokens=N, do_sample=False, eos_token_id=EOS, pad_token_id=PAD
+    )
+    out = generate(
+        lm, params, ids, mask, jax.random.PRNGKey(0), settings,
+        logits_processor=force_eos_at_2,
+    )
+    resp = np.asarray(out["response_ids"])
+    rmask = np.asarray(out["response_mask"])
+    # row 0 emits EOS immediately; EOS itself is real, everything after pad
+    assert resp[0, 0] == EOS
+    assert rmask[0].tolist() == [1, 0, 0, 0, 0, 0]
+    assert (resp[0, 1:] == PAD).all()
+    # row 1 never finishes
+    assert rmask[1].tolist() == [1] * N
+    assert not (resp[1] == EOS).any()
+
+
+def test_top_p_mask_keeps_nucleus():
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    masked = top_p_mask(logits, 0.7)
+    finite = np.isfinite(np.asarray(masked))[0]
+    assert finite.tolist() == [True, True, False, False]
+    # always keeps argmax even for tiny p
+    masked = top_p_mask(logits, 1e-9)
+    assert np.isfinite(np.asarray(masked))[0].tolist() == [True, False, False, False]
+
+
+def test_process_logits_temperature_topk():
+    logits = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
+    s = SamplerSettings(max_new_tokens=1, temperature=0.5, top_k=2)
+    out = np.asarray(process_logits(logits, s))[0]
+    assert np.isinf(out[0]) and np.isinf(out[1]) and out[0] < 0
+    np.testing.assert_allclose(out[2:], [6.0, 8.0])
+
+
+def test_from_gen_kwargs_ignores_foreign_keys():
+    s = SamplerSettings.from_gen_kwargs(
+        dict(max_new_tokens=4, top_k=5, max_length=99, num_beams=2, beta=1.0),
+        eos_token_id=3, pad_token_id=0,
+    )
+    assert s.max_new_tokens == 4 and s.top_k == 5 and s.eos_token_id == 3

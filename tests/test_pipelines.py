@@ -1,0 +1,152 @@
+"""Pipeline tests (reference analog: tests/test_pipelines.py +
+test_minibatch.py): dialogue tokenization invariants, prompt batching,
+rollout storages, microbatch iteration."""
+
+import numpy as np
+import pytest
+
+from trlx_tpu.data import ILQLBatch, PromptBatch, SFTBatch
+from trlx_tpu.pipeline import MiniBatchIterator
+from trlx_tpu.pipeline.offline_pipeline import (
+    DialogStore,
+    PromptPipeline,
+    tokenize_dialogue,
+)
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+from trlx_tpu.utils.tokenizers import ByteTokenizer
+
+
+@pytest.fixture
+def tok():
+    return ByteTokenizer()
+
+
+def test_tokenize_dialogue_single_string(tok):
+    msgs = tokenize_dialogue("hello", tok, max_length=32)
+    assert msgs[0].is_output is False and msgs[0].tokens == (tok.bos_token_id,)
+    assert msgs[1].is_output is True
+    assert msgs[1].tokens[-1] == tok.eos_token_id
+    assert bytes(msgs[1].tokens[:-1]).decode() == "hello"
+
+
+def test_tokenize_dialogue_multi_turn(tok):
+    msgs = tokenize_dialogue(("q1", "a1", "q2", "a2"), tok, max_length=64)
+    outputs = [m.is_output for m in msgs]
+    assert outputs == [False, True, False, True]
+    assert msgs[-1].tokens[-1] == tok.eos_token_id
+
+
+def test_tokenize_dialogue_right_truncation(tok):
+    tok.truncation_side = "right"
+    msgs = tokenize_dialogue(("abcdef", "ghijkl"), tok, max_length=8)
+    total = sum(len(m.tokens) for m in msgs)
+    assert total <= 8
+    # right truncation keeps the prompt prefix
+    assert bytes(msgs[0].tokens[:6]).decode() == "abcdef"
+
+
+def test_tokenize_dialogue_left_truncation(tok):
+    tok.truncation_side = "left"
+    msgs = tokenize_dialogue(("abcdef", "ghijkl"), tok, max_length=8)
+    total = sum(len(m.tokens) for m in msgs)
+    assert total <= 8
+    # left truncation keeps the tail: full output ("ghijkl"+eos = 7
+    # tokens) plus the prompt's last token 'f'
+    assert msgs[0].tokens == (ord("f"),)
+    assert bytes(msgs[1].tokens[:-1]).decode() == "ghijkl"
+    assert msgs[-1].tokens[-1] == tok.eos_token_id
+
+    # when the prompt is cut entirely, a BOS is reinserted
+    msgs = tokenize_dialogue(("abcdef", "ghijklm"), tok, max_length=8)
+    assert msgs[0].tokens == (tok.bos_token_id,)
+    assert sum(len(m.tokens) for m in msgs) <= 8 + 1  # bos rides on top
+
+
+def test_tokenize_dialogue_odd_phrases_raises(tok):
+    with pytest.raises(ValueError):
+        tokenize_dialogue(("a", "b", "c"), tok, max_length=8)
+
+
+def test_prompt_pipeline_metadata_passthrough(tok):
+    prompts = [{"prompt": "hi", "score": 1}, {"prompt": "yo", "score": 2}]
+    pipe = PromptPipeline(prompts, 8, tok)
+    batch = next(iter(pipe.create_loader(2)))
+    assert isinstance(batch, PromptBatch)
+    assert batch.input_ids.shape == (2, 8)
+    assert batch.metadata == {"score": [1, 2]}
+    # left padding puts real tokens at the end
+    assert batch.attention_mask[0].tolist()[-2:] == [1, 1]
+
+
+def test_prompt_pipeline_truncates_to_max_length(tok):
+    pipe = PromptPipeline(["x" * 100], 8, tok)
+    assert len(pipe[0]["input_ids"]) == 8
+
+
+def test_dialog_store_labels(tok):
+    store = DialogStore([tokenize_dialogue(("ab", "cd"), tok, 32)], tok, max_length=12)
+    batch = next(iter(store.create_loader(1)))
+    assert isinstance(batch, SFTBatch)
+    labels = batch.labels[0]
+    ids = batch.input_ids[0]
+    mask = batch.attention_mask[0]
+    # prompt tokens masked with -100; output tokens labeled; pads masked
+    assert (labels[:2] == -100).all()
+    assert (labels[2:5] == ids[2:5]).all()  # "cd" + eos
+    assert (labels[mask == 0] == -100).all()
+
+
+def test_ppo_rollout_storage_roundtrip():
+    import jax
+
+    store = PPORolloutStorage(pad_token_id=0)
+    from trlx_tpu.data import PPORolloutBatch
+
+    def mk(n):
+        return PPORolloutBatch(
+            query_tensors=np.ones((n, 3), np.int32),
+            response_tensors=np.ones((n, 2), np.int32),
+            logprobs=np.zeros((n, 2), np.float32),
+            values=np.zeros((n, 2), np.float32),
+            rewards=np.zeros((n, 2), np.float32),
+            response_mask=np.ones((n, 2), np.float32),
+        )
+
+    store.push(mk(4))
+    store.push(mk(2))
+    assert len(store) == 6
+    loader = store.create_loader(3, shuffle=True, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert batches[0].query_tensors.shape == (3, 3)
+    store.clear_history()
+    assert len(store) == 0
+
+
+def test_ilql_make_experience_indices(tok):
+    from trlx_tpu.trainer.ilql import make_experience
+
+    store = make_experience(
+        [("ab", "cd"), ("x", "yz")], [1.0, -1.0], tok, max_length=32, verbose=False
+    )
+    batch = next(iter(store.create_loader(2, shuffle=False, drop_last=False)))
+    assert isinstance(batch, ILQLBatch)
+    # reward lands on the LAST action of each sample, normalized
+    rewards = np.asarray(batch.rewards)
+    nonzero = rewards[rewards != 0]
+    assert len(nonzero) == 2
+    np.testing.assert_allclose(nonzero.sum(), 0.0, atol=1e-5)
+    # dones: 1 everywhere except terminal state
+    dones = np.asarray(batch.dones)
+    assert dones[0, -1] in (0, 1)  # padded or terminal zero
+    # states = actions + final state
+    assert batch.states_ixs.shape[1] == batch.actions_ixs.shape[1] + 1
+
+
+def test_minibatch_iterator():
+    batch = {"a": np.arange(12).reshape(6, 2)}
+    loader = [batch]
+    mbs = next(iter(MiniBatchIterator(iter(loader), mb_size=2, num_mb=3)))
+    assert len(mbs) == 3
+    assert mbs[0]["a"].shape == (2, 2)
+    np.testing.assert_array_equal(mbs[2]["a"], batch["a"][4:6])

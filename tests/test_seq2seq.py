@@ -1,0 +1,76 @@
+"""Seq2seq (T5) tests: logit parity vs HF torch on tiny random models,
+cached decode consistency (reference analog: seq2seq coverage inside
+tests/test_models.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.hf import seq2seq_config_from_hf, t5_params_from_state_dict
+from trlx_tpu.models.seq2seq import T5LM, generate_seq2seq
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def tiny_t5(feed_forward_proj="relu", tie=True):
+    cfg = transformers.T5Config(
+        vocab_size=97, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_decoder_layers=2, num_heads=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=20, feed_forward_proj=feed_forward_proj,
+        tie_word_embeddings=tie, decoder_start_token_id=0,
+    )
+    return transformers.T5ForConditionalGeneration(cfg)
+
+
+@pytest.mark.parametrize("ff,tie", [("relu", True), ("gated-gelu", False)])
+def test_t5_logit_parity(ff, tie):
+    hf_model = tiny_t5(ff, tie).eval()
+    cfg = seq2seq_config_from_hf(hf_model.config, dtype=jnp.float32)
+    params = t5_params_from_state_dict(hf_model.state_dict(), cfg)
+    model = T5LM(cfg)
+
+    B, S, T = 2, 7, 5
+    rng = np.random.default_rng(0)
+    enc_ids = rng.integers(0, 97, (B, S))
+    enc_mask = np.ones((B, S), np.int64)
+    enc_mask[0, -2:] = 0
+    dec_ids = rng.integers(0, 97, (B, T))
+    dec_ids[:, 0] = 0
+
+    with torch.no_grad():
+        ref = hf_model(
+            input_ids=torch.tensor(enc_ids),
+            attention_mask=torch.tensor(enc_mask),
+            decoder_input_ids=torch.tensor(dec_ids),
+        ).logits.numpy()
+
+    out = model(
+        params, jnp.asarray(enc_ids), jnp.asarray(enc_mask), jnp.asarray(dec_ids)
+    )
+    np.testing.assert_allclose(np.asarray(out["logits"]), ref, atol=2e-3, rtol=2e-2)
+
+
+def test_t5_greedy_decode_matches_teacher_forced():
+    hf_model = tiny_t5().eval()
+    cfg = seq2seq_config_from_hf(hf_model.config, dtype=jnp.float32)
+    params = t5_params_from_state_dict(hf_model.state_dict(), cfg)
+    model = T5LM(cfg)
+
+    from trlx_tpu.models.generation import SamplerSettings
+
+    B, S, N = 2, 6, 5
+    rng = np.random.default_rng(1)
+    enc_ids = jnp.asarray(rng.integers(0, 97, (B, S)))
+    enc_mask = jnp.ones((B, S), jnp.int32)
+    settings = SamplerSettings(max_new_tokens=N, do_sample=False)
+    out = generate_seq2seq(
+        model, params, enc_ids, enc_mask, jax.random.PRNGKey(0), settings
+    )
+    # teacher-forced re-run over the emitted decoder sequence
+    full = model(params, enc_ids, enc_mask, out["sequences"])
+    for b in range(B):
+        for t in range(N):
+            pred = int(jnp.argmax(full["logits"][b, t]))
+            assert pred == int(out["sequences"][b, t + 1]), (b, t)

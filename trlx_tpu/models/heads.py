@@ -69,7 +69,9 @@ def init_ilql_heads(
     q_heads = [init_head(keys[i], hidden_size, vocab_size) for i in range(n_qs)]
     return {
         "q_heads": q_heads,
-        "target_q_heads": jax.tree_util.tree_map(lambda x: x, q_heads),
+        # deep copy: aliased leaves would break buffer donation in the
+        # trainers (f(donate(a), donate(a)))
+        "target_q_heads": jax.tree_util.tree_map(jnp.copy, q_heads),
         "v_head": init_head(keys[-1], hidden_size, 1),
     }
 

@@ -124,6 +124,113 @@ def config_from_hf(hf_config: Any, dtype=None, param_dtype=None) -> TransformerC
     raise ValueError(f"unsupported model_type {mt!r} (supported: gpt2, gptj, gpt_neox, llama)")
 
 
+def seq2seq_config_from_hf(hf_config: Any, dtype=None, param_dtype=None):
+    """Translate an HF T5Config into a Seq2SeqConfig."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig
+
+    if hf_config.model_type not in ("t5", "mt5"):
+        raise ValueError(f"unsupported seq2seq model_type {hf_config.model_type!r}")
+    ff = getattr(hf_config, "feed_forward_proj", "relu")
+    return Seq2SeqConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.d_model,
+        n_layer=hf_config.num_layers,
+        n_decoder_layer=getattr(hf_config, "num_decoder_layers", hf_config.num_layers),
+        n_head=hf_config.num_heads,
+        d_kv=hf_config.d_kv,
+        d_ff=hf_config.d_ff,
+        relative_attention_num_buckets=hf_config.relative_attention_num_buckets,
+        relative_attention_max_distance=getattr(
+            hf_config, "relative_attention_max_distance", 128
+        ),
+        layer_norm_epsilon=hf_config.layer_norm_epsilon,
+        activation="gated-gelu" if "gated" in ff else "relu",
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+        decoder_start_token_id=hf_config.decoder_start_token_id or 0,
+        dtype=dtype or jnp.bfloat16,
+        param_dtype=param_dtype or jnp.float32,
+    )
+
+
+def t5_params_from_state_dict(sd: Dict[str, Any], cfg) -> Dict:
+    """Convert an HF T5 torch state_dict into the T5LM param tree."""
+    H, Dk, D = cfg.n_head, cfg.d_kv, cfg.d_model
+
+    def attn(prefix: str) -> Dict[str, Any]:
+        return {
+            "q": {"kernel": _np(sd[prefix + ".q.weight"]).T.reshape(D, H, Dk)},
+            "k": {"kernel": _np(sd[prefix + ".k.weight"]).T.reshape(D, H, Dk)},
+            "v": {"kernel": _np(sd[prefix + ".v.weight"]).T.reshape(D, H, Dk)},
+            "o": {"kernel": _np(sd[prefix + ".o.weight"]).T.reshape(H, Dk, D)},
+        }
+
+    def mlp(prefix: str) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "fc_out": {"kernel": _np(sd[prefix + ".wo.weight"]).T}
+        }
+        if prefix + ".wi.weight" in sd:
+            out["fc_in"] = {"kernel": _np(sd[prefix + ".wi.weight"]).T}
+        else:  # gated (v1.1): wi_0 activated, wi_1 linear
+            out["fc_in"] = {"kernel": _np(sd[prefix + ".wi_0.weight"]).T}
+            out["fc_gate"] = {"kernel": _np(sd[prefix + ".wi_1.weight"]).T}
+        return out
+
+    def stack(side: str, n: int, is_decoder: bool) -> Dict[str, Any]:
+        layers = []
+        for i in range(n):
+            b = f"{side}.block.{i}.layer"
+            layer = {
+                "ln_1": {"scale": _np(sd[f"{b}.0.layer_norm.weight"])},
+                "self_attn": attn(f"{b}.0.SelfAttention"),
+            }
+            if is_decoder:
+                layer["ln_cross"] = {"scale": _np(sd[f"{b}.1.layer_norm.weight"])}
+                layer["cross_attn"] = attn(f"{b}.1.EncDecAttention")
+                ff = 2
+            else:
+                ff = 1
+            layer["ln_2"] = {"scale": _np(sd[f"{b}.{ff}.layer_norm.weight"])}
+            layer["mlp"] = mlp(f"{b}.{ff}.DenseReluDense")
+            layers.append(layer)
+        return _stack(layers)
+
+    params = {
+        "shared": {"wte": _np(sd["shared.weight"])},
+        "encoder": {
+            "blocks": stack("encoder", cfg.n_layer, False),
+            "ln_f": {"scale": _np(sd["encoder.final_layer_norm.weight"])},
+            "rel_bias": _np(
+                sd["encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
+            ),
+        },
+        "decoder": {
+            "blocks": stack("decoder", cfg.n_decoder_layer, True),
+            "ln_f": {"scale": _np(sd["decoder.final_layer_norm.weight"])},
+            "rel_bias": _np(
+                sd["decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
+            ),
+        },
+    }
+    if not cfg.tie_word_embeddings and "lm_head.weight" in sd:
+        params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
+    return params
+
+
+def load_pretrained_seq2seq(path: str, dtype=None, param_dtype=None):
+    """Load an HF-layout T5 checkpoint directory -> (T5LM, params)."""
+    import transformers
+
+    from trlx_tpu.models.seq2seq import T5LM
+
+    hf_config = transformers.AutoConfig.from_pretrained(path)
+    cfg = seq2seq_config_from_hf(hf_config, dtype=dtype, param_dtype=param_dtype)
+    sd = _read_state_dict(path)
+    params = t5_params_from_state_dict(sd, cfg)
+    return T5LM(cfg), params, hf_config.model_type
+
+
 # ---------------------------------------------------------------------------
 # weight conversion: torch state_dict -> stacked functional param tree
 # ---------------------------------------------------------------------------

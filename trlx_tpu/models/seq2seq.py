@@ -1,0 +1,460 @@
+"""TPU-native encoder-decoder (T5-family) model.
+
+Parity: the reference's seq2seq support — value-head wrappers
+(/root/reference/trlx/models/modeling_ppo.py:1242-1480), the frozen `T5Branch`
+(modeling_ppo.py:1483-1592) and ILQL seq2seq (modeling_ilql.py:481-666) all
+wrap HF T5. Here the model itself is first-party: one functional
+encoder/decoder with scan-stacked layers, mirroring
+trlx_tpu.models.transformer's design (static shapes, explicit param
+trees, KV-cache decode, branch capture for the hydra reference).
+
+T5 specifics honored: RMS layer norm without bias, no attention scaling
+(folded into init), relative position bias shared across layers (a
+single [n_buckets, n_head] table per stack), optional gated-GELU MLP
+(v1.1), logits scaled by d_model^-0.5 when embeddings are tied.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.transformer import NEG_INF
+
+Array = jnp.ndarray
+
+
+@dataclass(frozen=True)
+class Seq2SeqConfig:
+    vocab_size: int
+    d_model: int
+    n_layer: int  # encoder layers
+    n_decoder_layer: Optional[int] = None  # default n_layer
+    n_head: int = 8
+    d_kv: int = 64
+    d_ff: int = 2048
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    activation: str = "relu"  # "relu" | "gated-gelu"
+    tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.n_decoder_layer is None:
+            object.__setattr__(self, "n_decoder_layer", self.n_layer)
+
+    def replace(self, **kw) -> "Seq2SeqConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def relative_position_bucket(
+    relative_position: Array, bidirectional: bool, num_buckets: int, max_distance: int
+) -> Array:
+    """T5's log-binned relative position bucketing."""
+    ret = jnp.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def compute_position_bias(
+    rel_bias_table: Array,  # [n_buckets, n_head]
+    q_pos: Array,  # [T]
+    k_pos: Array,  # [S]
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> Array:
+    """[1, n_head, T, S] additive attention bias."""
+    rel = k_pos[None, :] - q_pos[:, None]  # [T, S]
+    buckets = relative_position_bucket(rel, bidirectional, num_buckets, max_distance)
+    bias = jnp.take(rel_bias_table, buckets, axis=0)  # [T, S, H]
+    return bias.transpose(2, 0, 1)[None].astype(jnp.float32)
+
+
+class T5Norm(nn.Module):
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x32 = x.astype(jnp.float32)
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.cfg.d_model,), self.cfg.param_dtype
+        )
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + self.cfg.layer_norm_epsilon) * scale).astype(
+            x.dtype
+        )
+
+
+class T5Attention(nn.Module):
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,  # [B, T, D] queries
+        kv: Array,  # [B, S, D] keys/values source
+        bias: Array,  # [B or 1, H, T, S] additive (position bias + masking)
+        cache: Optional[Dict[str, Array]] = None,
+    ) -> Tuple[Array, Optional[Dict[str, Array]]]:
+        cfg = self.cfg
+        H, Dk = cfg.n_head, cfg.d_kv
+        dense = partial(
+            nn.DenseGeneral,
+            axis=-1,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            use_bias=False,
+            kernel_init=nn.initializers.normal(cfg.d_model**-0.5),
+        )
+        q = dense(features=(H, Dk), name="q")(x)
+        k = dense(features=(H, Dk), name="k")(kv)
+        v = dense(features=(H, Dk), name="v")(kv)
+
+        new_kv = None
+        if cache is not None:
+            idx = cache["index"]
+            k_all = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0)
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0)
+            )
+            new_kv = {"k": k_all, "v": v_all}
+            k, v = k_all.astype(cfg.dtype), v_all.astype(cfg.dtype)
+
+        # NOTE: no 1/sqrt(d) — T5 folds the scale into initialization
+        scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+        scores = scores + bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        proj = nn.DenseGeneral(
+            features=cfg.d_model,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            use_bias=False,
+            kernel_init=nn.initializers.normal((H * Dk) ** -0.5),
+            name="o",
+        )
+        return proj(out), new_kv
+
+
+class T5MLP(nn.Module):
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        cfg = self.cfg
+        dense = partial(
+            nn.DenseGeneral,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            use_bias=False,
+            kernel_init=nn.initializers.normal(cfg.d_model**-0.5),
+        )
+        if cfg.activation == "gated-gelu":
+            h = jax.nn.gelu(dense(features=cfg.d_ff, name="fc_in")(x), approximate=True)
+            h = h * dense(features=cfg.d_ff, name="fc_gate")(x)
+        else:
+            h = jax.nn.relu(dense(features=cfg.d_ff, name="fc_in")(x))
+        return dense(features=cfg.d_model, name="fc_out",
+                     kernel_init=nn.initializers.normal(cfg.d_ff**-0.5))(h)
+
+
+class T5Block(nn.Module):
+    cfg: Seq2SeqConfig
+    is_decoder: bool
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        self_bias: Array,
+        enc_out: Optional[Array] = None,
+        cross_bias: Optional[Array] = None,
+        cache: Optional[Dict[str, Array]] = None,
+    ) -> Tuple[Array, Optional[Dict[str, Array]]]:
+        cfg = self.cfg
+        h = T5Norm(cfg, name="ln_1")(x)
+        attn_out, new_kv = T5Attention(cfg, name="self_attn")(h, h, self_bias, cache)
+        x = x + attn_out
+        if self.is_decoder and enc_out is not None:
+            h = T5Norm(cfg, name="ln_cross")(x)
+            cross_out, _ = T5Attention(cfg, name="cross_attn")(h, enc_out, cross_bias)
+            x = x + cross_out
+        x = x + T5MLP(cfg, name="mlp")(T5Norm(cfg, name="ln_2")(x))
+        return x, new_kv
+
+
+class T5LM:
+    """Functional encoder-decoder LM with stacked-layer scan stacks.
+
+    params:
+      shared:  {wte [V, D]}
+      encoder: {blocks (stacked), ln_f, rel_bias [n_buckets, H]}
+      decoder: {blocks (stacked), ln_f, rel_bias [n_buckets, H]}
+      [lm_head: {kernel [D, V]}]
+    """
+
+    def __init__(self, cfg: Seq2SeqConfig):
+        self.cfg = cfg
+        self.enc_block = T5Block(cfg, is_decoder=False)
+        self.dec_block = T5Block(cfg, is_decoder=True)
+        self.norm = T5Norm(cfg)
+
+    # -- init ------------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> Dict:
+        cfg = self.cfg
+        B, T = 1, 4
+        x = jnp.zeros((B, T, cfg.d_model), cfg.dtype)
+        bias = jnp.zeros((1, cfg.n_head, T, T), jnp.float32)
+        keys = jax.random.split(rng, 6)
+
+        enc_blocks = jax.vmap(lambda k: self.enc_block.init(k, x, bias)["params"])(
+            jax.random.split(keys[0], cfg.n_layer)
+        )
+        dec_blocks = jax.vmap(
+            lambda k: self.dec_block.init(k, x, bias, x, bias)["params"]
+        )(jax.random.split(keys[1], cfg.n_decoder_layer))
+
+        n_b = cfg.relative_attention_num_buckets
+        params = {
+            "shared": {
+                "wte": jax.random.normal(keys[2], (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+                * 1.0
+            },
+            "encoder": {
+                "blocks": enc_blocks,
+                "ln_f": self.norm.init(keys[3], x)["params"],
+                "rel_bias": jax.random.normal(keys[4], (n_b, cfg.n_head), cfg.param_dtype) * 0.1,
+            },
+            "decoder": {
+                "blocks": dec_blocks,
+                "ln_f": self.norm.init(keys[3], x)["params"],
+                "rel_bias": jax.random.normal(keys[5], (n_b, cfg.n_head), cfg.param_dtype) * 0.1,
+            },
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {
+                "kernel": jax.random.normal(keys[4], (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
+                * cfg.d_model**-0.5
+            }
+        return params
+
+    # -- helpers ---------------------------------------------------------
+
+    def _embed(self, params: Dict, ids: Array) -> Array:
+        return jnp.take(params["shared"]["wte"], ids, axis=0).astype(self.cfg.dtype)
+
+    def _scan(self, block: nn.Module, stacked: Dict, h: Array, *args, cache=None):
+        def body(hidden, layer):
+            if cache is not None:
+                lp, layer_kv = layer
+                layer_cache = dict(layer_kv, index=cache["index"])
+            else:
+                lp, layer_cache = layer, None
+            out, new_kv = block.apply({"params": lp}, hidden, *args, cache=layer_cache)
+            return out, new_kv
+
+        xs = (stacked, {"k": cache["k"], "v": cache["v"]}) if cache is not None else stacked
+        h, new_kvs = jax.lax.scan(body, h, xs)
+        new_cache = None
+        if cache is not None:
+            new_cache = dict(new_kvs, index=cache["index"] + 1)
+        return h, new_cache
+
+    def _logits(self, params: Dict, hidden: Array) -> Array:
+        if "lm_head" in params:
+            kernel = params["lm_head"]["kernel"]
+        else:
+            kernel = params["shared"]["wte"].T
+            hidden = hidden * (self.cfg.d_model**-0.5)  # tied-embedding scale
+        return jnp.einsum(
+            "btd,dv->btv", hidden, kernel.astype(hidden.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    # -- forward ---------------------------------------------------------
+
+    def encode(self, params: Dict, input_ids: Array, attention_mask: Array) -> Array:
+        cfg = self.cfg
+        T = input_ids.shape[1]
+        pos = jnp.arange(T)
+        bias = compute_position_bias(
+            params["encoder"]["rel_bias"], pos, pos, True,
+            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        )
+        bias = bias + jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+        h = self._embed(params, input_ids)
+        h, _ = self._scan(self.enc_block, params["encoder"]["blocks"], h, bias)
+        return self.norm.apply({"params": params["encoder"]["ln_f"]}, h)
+
+    def __call__(
+        self,
+        params: Dict,
+        input_ids: Array,  # [B, S_enc]
+        attention_mask: Array,  # [B, S_enc]
+        decoder_input_ids: Array,  # [B, T]
+        decoder_attention_mask: Optional[Array] = None,
+        encoder_hidden: Optional[Array] = None,
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        """Teacher-forced forward. `encoder_hidden` may be reused across
+        calls (e.g. computed once during rollout generation)."""
+        del remat  # seq2seq remat hooks follow in a later pass
+        cfg = self.cfg
+        if encoder_hidden is None:
+            encoder_hidden = self.encode(params, input_ids, attention_mask)
+        B, T = decoder_input_ids.shape
+        pos = jnp.arange(T)
+        self_bias = compute_position_bias(
+            params["decoder"]["rel_bias"], pos, pos, False,
+            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        )
+        causal = pos[:, None] >= pos[None, :]
+        self_bias = self_bias + jnp.where(causal[None, None], 0.0, NEG_INF)
+        if decoder_attention_mask is not None:
+            self_bias = self_bias + jnp.where(
+                decoder_attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
+            )
+        cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+
+        h = self._embed(params, decoder_input_ids)
+        h, _ = self._scan(
+            self.dec_block, params["decoder"]["blocks"], h, self_bias,
+            encoder_hidden, cross_bias,
+        )
+        hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h)
+        return {
+            "logits": self._logits(params, hidden),
+            "hidden_states": hidden,
+            "encoder_hidden": encoder_hidden,
+        }
+
+    # -- decoding --------------------------------------------------------
+
+    def init_cache(self, batch: int, max_len: int) -> Dict:
+        cfg = self.cfg
+        shape = (cfg.n_decoder_layer, batch, max_len, cfg.n_head, cfg.d_kv)
+        return {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "index": jnp.int32(0),
+        }
+
+    def decode_step(
+        self,
+        params: Dict,
+        token: Array,  # [B, 1]
+        encoder_hidden: Array,
+        attention_mask: Array,  # [B, S_enc]
+        cache: Dict,
+    ) -> Tuple[Dict[str, Array], Dict]:
+        """One decoder step at cache position `cache['index']`."""
+        cfg = self.cfg
+        S = cache["k"].shape[2]
+        t = cache["index"]
+        k_pos = jnp.arange(S)
+        self_bias = compute_position_bias(
+            params["decoder"]["rel_bias"], t[None], k_pos, False,
+            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        )
+        visible = k_pos[None, None, None, :] <= t
+        self_bias = jnp.where(visible, self_bias, NEG_INF)
+        cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+
+        h = self._embed(params, token)
+        h, new_cache = self._scan(
+            self.dec_block, params["decoder"]["blocks"], h, self_bias,
+            encoder_hidden, cross_bias, cache=cache,
+        )
+        hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h)
+        return {"logits": self._logits(params, hidden), "hidden_states": hidden}, new_cache
+
+
+def generate_seq2seq(
+    model: T5LM,
+    params: Dict,
+    input_ids: Array,
+    attention_mask: Array,
+    rng: jax.Array,
+    settings,
+    logits_processor=None,
+) -> Dict[str, Array]:
+    """Sample decoder continuations (analog of models.generation.generate
+    for the encoder-decoder path). Output starts with
+    `decoder_start_token_id` (the <pad> HF T5 convention)."""
+    from trlx_tpu.models.generation import sample_token
+
+    cfg = model.cfg
+    B = input_ids.shape[0]
+    N = settings.max_new_tokens
+    enc = model.encode(params, input_ids, attention_mask)
+    cache = model.init_cache(B, N + 1)
+    start = jnp.full((B, 1), cfg.decoder_start_token_id, jnp.int32)
+
+    def pick(rng_t, hidden_last, logits_last, finished):
+        if logits_processor is not None:
+            logits_last = logits_processor(hidden_last, logits_last)
+        tok = sample_token(rng_t, logits_last, settings)
+        tok = jnp.where(finished, jnp.int32(settings.pad_token_id), tok)
+        return tok, finished | (tok == settings.eos_token_id)
+
+    out, cache = model.decode_step(params, start, enc, attention_mask, cache)
+    rng, sub = jax.random.split(rng)
+    tok0, fin0 = pick(sub, out["hidden_states"][:, -1], out["logits"][:, -1],
+                      jnp.zeros((B,), bool))
+
+    def step(carry, rng_t):
+        cache, tok, finished, was_real = carry
+        step_out, cache = model.decode_step(
+            params, tok[:, None], enc, attention_mask, cache
+        )
+        nxt, now_fin = pick(
+            rng_t, step_out["hidden_states"][:, -1], step_out["logits"][:, -1], finished
+        )
+        return (cache, nxt, now_fin, ~finished), (tok, was_real)
+
+    if N > 1:
+        carry0 = (cache, tok0, fin0, jnp.ones((B,), bool))
+        (cache, tok_last, fin, last_real), (toks, reals) = jax.lax.scan(
+            step, carry0, jax.random.split(rng, N - 1)
+        )
+        response_ids = jnp.concatenate([toks.T, tok_last[:, None]], axis=1)
+        response_mask = jnp.concatenate([reals.T, last_real[:, None]], axis=1)
+    else:
+        response_ids = tok0[:, None]
+        response_mask = jnp.ones((B, 1), bool)
+
+    decoder_ids = jnp.concatenate([start, response_ids], axis=1)  # with start token
+    return {
+        "sequences": decoder_ids,
+        "response_ids": response_ids,
+        "response_mask": response_mask.astype(jnp.int32),
+        "encoder_hidden": enc,
+    }

@@ -36,6 +36,28 @@ from trlx_tpu.models.transformer import (
 Array = jnp.ndarray
 
 
+class CausalLM:
+    """Bare causal LM wrapper (SFT/RFT path — no auxiliary heads)."""
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+        self.lm = TransformerLM(cfg)
+
+    def init_params(self, rng: jax.Array, base_params: Optional[Dict] = None) -> Dict:
+        if base_params is None:
+            base_params = self.lm.init(rng)
+        return {"base": base_params}
+
+    def forward(
+        self,
+        params: Dict,
+        input_ids: Array,
+        attention_mask: Optional[Array] = None,
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        return self.lm(params["base"], input_ids, attention_mask, remat=remat)
+
+
 class CausalLMWithValueHead:
     """Policy LM + scalar value head; optional hydra reference branch.
 
@@ -62,10 +84,15 @@ class CausalLMWithValueHead:
         }
 
     def make_ref_params(self, params: Dict) -> Dict:
-        """Frozen reference: the top branch only (hydra) or the full tree."""
+        """Frozen reference: the top branch only (hydra) or the full tree.
+
+        Deep-copied: the trainer donates `params` buffers every step, so
+        the reference must not alias them."""
         if self.branch_at is not None:
-            return extract_branch_params(params["base"], self.branch_at)
-        return jax.lax.stop_gradient(params["base"])
+            branch = extract_branch_params(params["base"], self.branch_at)
+        else:
+            branch = jax.lax.stop_gradient(params["base"])
+        return jax.tree_util.tree_map(jnp.copy, branch)
 
     # -- forwards --------------------------------------------------------
 

@@ -47,11 +47,22 @@ def make_mesh(
         if n % fixed:
             raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
         sizes[fill[0]] = n // fixed
-    elif fixed != n:
+    elif fixed > n:
         raise ValueError(f"mesh {sizes} needs {fixed} devices, have {n}")
 
     shape = tuple(sizes[ax] for ax in MeshAxes)
-    dev_array = np.asarray(devices).reshape(shape)
+    # a mesh smaller than the host's device count is allowed (tests pin
+    # dp=1 on an 8-device CPU host); the first prod(shape) devices serve
+    used = int(np.prod(shape))
+    if used < n:
+        import warnings
+
+        warnings.warn(
+            f"mesh {sizes} uses {used} of {n} available devices; "
+            "set one axis to -1 to absorb the rest",
+            stacklevel=2,
+        )
+    dev_array = np.asarray(devices[:used]).reshape(shape)
     return Mesh(dev_array, MeshAxes)
 
 

@@ -1,0 +1,87 @@
+"""Branch benchmarking: `python -m trlx_tpu.reference <ref> --against <ref2>`.
+
+Parity: /root/reference/trlx/reference.py:1-103 + scripts/benchmark.sh —
+the reference clones a fork:branch, runs its benchmark matrix and diffs
+metrics in a W&B report. Here each git ref is checked out into a
+temporary worktree, `bench.py` runs in each, and the JSON metrics are
+diffed locally (no W&B dependency; works air-gapped).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+def run_ref(repo_root: str, ref: str, bench_cmd: str) -> dict:
+    """Run `bench_cmd` for `ref` inside a temporary git worktree."""
+    with tempfile.TemporaryDirectory(prefix=f"trlx_bench_{ref.replace('/', '_')}_") as tmp:
+        subprocess.run(
+            ["git", "worktree", "add", "--detach", tmp, ref],
+            cwd=repo_root, check=True, capture_output=True,
+        )
+        try:
+            out = subprocess.run(
+                bench_cmd, shell=True, cwd=tmp, capture_output=True, text=True,
+                timeout=3600,
+            )
+            for line in reversed(out.stdout.strip().splitlines()):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+            raise RuntimeError(
+                f"no JSON metric line in bench output for {ref}:\n{out.stdout}\n{out.stderr}"
+            )
+        finally:
+            subprocess.run(
+                ["git", "worktree", "remove", "--force", tmp],
+                cwd=repo_root, capture_output=True,
+            )
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("ref", help="git ref (branch/commit) to benchmark")
+    parser.add_argument("--against", default="main", help="baseline git ref")
+    parser.add_argument(
+        "--bench-cmd", default=f"{sys.executable} bench.py",
+        help="command printing one JSON metric line",
+    )
+    parser.add_argument("--output", default=None, help="optional report path")
+    args = parser.parse_args()
+
+    repo_root = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"], capture_output=True, text=True, check=True
+    ).stdout.strip()
+
+    logger.info("benchmarking %s against %s", args.ref, args.against)
+    candidate = run_ref(repo_root, args.ref, args.bench_cmd)
+    baseline = run_ref(repo_root, args.against, args.bench_cmd)
+
+    speedup = (
+        candidate["value"] / baseline["value"] if baseline.get("value") else None
+    )
+    report = {
+        "ref": args.ref,
+        "against": args.against,
+        "candidate": candidate,
+        "baseline": baseline,
+        "ratio": round(speedup, 4) if speedup else None,
+    }
+    print(json.dumps(report, indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(report, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

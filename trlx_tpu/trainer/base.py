@@ -1,0 +1,706 @@
+"""TPU trainer base: model/optimizer setup, generation, evaluation, the
+`learn()` loop, and checkpointing.
+
+Parity: /root/reference/trlx/trainer/accelerate_base_trainer.py:40-682
+(AccelerateRLTrainer) — same hook surface (`get_arch` / `loss` /
+`prepare_learning` / `create_train_dataloader` / `post_backward_callback`
+/ `post_epoch_callback`), the same loop structure (epochs -> inner epochs
+-> batches with gradient accumulation), the same checkpoint layout
+(`checkpoint_{step}` + `best_checkpoint`, each containing `hf_model/`)
+and the same metric keys (`time/forward`, `time/backward`,
+`reward/mean`, `learning_rate_group_0`, ...).
+
+TPU re-design:
+- One trainer covers what the reference splits across the Accelerate and
+  NeMo backends: DP/FSDP/TP are mesh-axis sizes in `TrainConfig.mesh`.
+- Gradient accumulation is a `lax.scan` over microbatches inside ONE
+  jitted train step (the reference's `_accumulate`/no_sync dance exists
+  to suppress per-microbatch NCCL allreduce — under jit the grads are
+  reduced exactly once by construction).
+- The optimizer step, freeze masking and LR schedule live in the same
+  jitted function; params/opt-state are donated (no HBM copies).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from abc import abstractmethod
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models.generation import SamplerSettings, generate
+from trlx_tpu.models.hf import load_pretrained, save_pretrained_hf
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.parallel import data_sharding, make_mesh, shard_params
+from trlx_tpu.trainer import BaseRLTrainer
+from trlx_tpu.utils import Clock, build_optimizer, logging, significant, to_scalar
+from trlx_tpu.utils.tokenizers import load_tokenizer
+from trlx_tpu.utils.trackers import Tracker
+
+logger = logging.get_logger(__name__)
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+class TPUBaseTrainer(BaseRLTrainer):
+    """Shared trainer machinery; subclasses provide the algorithm."""
+
+    def __init__(
+        self,
+        config: TRLConfig,
+        reward_fn: Optional[Callable] = None,
+        metric_fn: Optional[Callable] = None,
+        stop_sequences: Optional[List[str]] = None,
+        **kwargs: Any,
+    ):
+        super().__init__(config, reward_fn, metric_fn, stop_sequences)
+        train = config.train
+        self.mesh = make_mesh(train.mesh)
+        self.compute_dtype = _DTYPES[train.compute_dtype]
+        self.param_dtype = _DTYPES[train.param_dtype]
+        self.tokenizer = load_tokenizer(config.tokenizer)
+        self.rng = jax.random.PRNGKey(train.seed)
+
+        # subclass hook: builds self.model (wrapper), self.params and any
+        # auxiliary trees (e.g. PPO's frozen reference branch)
+        self.setup_model()
+
+        tx, self.schedule = build_optimizer(config.optimizer, config.scheduler)
+        mask = self.trainable_mask()
+        if mask is not None:
+            tx = optax.chain(tx, _mask_updates(mask))
+        self.tx = tx
+        with self.mesh:
+            self.opt_state = jax.jit(self.tx.init)(self.params)
+
+        gen_kwargs = dict(config.method.gen_kwargs)
+        self.generate_sweep_kwarg = None
+        for k, v in gen_kwargs.items():
+            if isinstance(v, list):
+                self.generate_sweep_kwarg = (k, v)
+        if self.generate_sweep_kwarg:
+            gen_kwargs.pop(self.generate_sweep_kwarg[0])
+        eos = getattr(self.tokenizer, "eos_token_id", None)
+        pad = getattr(self.tokenizer, "pad_token_id", None)
+        if pad is None:  # NOT `or`: pad_token_id == 0 is legitimate (T5)
+            pad = eos
+        self.generate_settings = SamplerSettings.from_gen_kwargs(
+            gen_kwargs, eos_token_id=eos, pad_token_id=pad
+        )
+        exp_kwargs = getattr(config.method, "gen_experience_kwargs", None)
+        self.generate_experience_settings = (
+            SamplerSettings.from_gen_kwargs(exp_kwargs, eos_token_id=eos, pad_token_id=pad)
+            if exp_kwargs
+            else self.generate_settings
+        )
+
+        self.tracker = Tracker(config)
+        self.iter_count = 0
+        self.nth_evaluation = 0
+        self.total_steps = train.total_steps
+
+        mb_size = train.minibatch_size or train.batch_size
+        if train.batch_size % mb_size:
+            raise ValueError("batch_size must be divisible by minibatch_size")
+        self.mb_size = mb_size
+        self.num_mb = train.batch_size // mb_size
+        data_ways = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        if mb_size % data_ways:
+            raise ValueError(
+                f"minibatch_size {mb_size} must be divisible by dp*fsdp={data_ways} "
+                f"(mesh {dict(self.mesh.shape)})"
+            )
+
+        self._train_step = None  # built lazily (jitted)
+        self._generate_fns: Dict[Tuple, Callable] = {}
+
+    # ------------------------------------------------------------------
+    # model setup
+    # ------------------------------------------------------------------
+
+    def load_base_model(self) -> Tuple[TransformerConfig, Dict, Optional[str]]:
+        """Resolve ModelConfig -> (transformer config, base params, model_type).
+
+        `model_path="random"` (or a "transformer" dict in
+        model_extra_configs) random-initializes — the zero-egress path
+        used by tests and benchmarks; otherwise an HF-layout checkpoint
+        directory is loaded (parity: reference modeling_base.py:124-326).
+        """
+        mc = self.config.model
+        extra = mc.model_extra_configs or {}
+        native_cfg_fp = os.path.join(mc.model_path, "trlx_tpu_config.json")
+        if os.path.isdir(mc.model_path) and os.path.exists(native_cfg_fp):
+            # native checkpoint (orbax params + architecture json), the
+            # deploy artifact save_pretrained writes for random-init runs
+            import orbax.checkpoint as ocp
+
+            with open(native_cfg_fp) as f:
+                meta = json.load(f)
+            tcfg = TransformerConfig(
+                dtype=self.compute_dtype, param_dtype=self.param_dtype,
+                **meta["transformer"],
+            )
+            params = ocp.PyTreeCheckpointer().restore(
+                os.path.join(os.path.abspath(mc.model_path), "params")
+            )
+            aux_dir = os.path.join(os.path.abspath(mc.model_path), "aux")
+            if os.path.isdir(aux_dir):
+                self._loaded_aux = ocp.PyTreeCheckpointer().restore(aux_dir)
+            return tcfg, params, meta.get("model_type")
+        if mc.model_path == "random" or "transformer" in extra:
+            tdict = dict(extra.get("transformer", {}))
+            tdict.setdefault("vocab_size", getattr(self.tokenizer, "vocab_size", 258))
+            tcfg = TransformerConfig(
+                dtype=self.compute_dtype, param_dtype=self.param_dtype, **tdict
+            )
+            self.rng, key = jax.random.split(self.rng)
+            params = TransformerLM(tcfg).init(key)
+            return tcfg, params, extra.get("model_type")
+        lm, params, model_type = load_pretrained(
+            mc.model_path, dtype=self.compute_dtype, param_dtype=self.param_dtype
+        )
+        self._hf_config_path = mc.model_path
+        return lm.cfg, params, model_type
+
+    @abstractmethod
+    def setup_model(self) -> None:
+        """Set self.model / self.params (sharded) and auxiliaries."""
+
+    def trainable_mask(self):
+        """Pytree of {0,1} update multipliers (None = all trainable).
+
+        Freezing must mask the *updates*, not the grads: AdamW applies
+        weight decay even at zero gradient (parity with
+        `freeze_bottom_causal_layers`, reference
+        accelerate_base_trainer.py:159-161 + utils/modeling.py:106-140).
+        """
+        return None
+
+    def branch_at(self) -> Optional[int]:
+        """Layer index where the trainable top starts (None = all)."""
+        k = self.config.model.num_layers_unfrozen
+        if k is None or k < 0:
+            return None
+        n_layer = self.model.cfg.n_layer
+        return max(n_layer - k, 0)
+
+    def make_freeze_mask(self, params: Dict) -> Optional[Dict]:
+        """Standard causal-LM freeze mask: embeddings + bottom layers
+        frozen, top-k layers + final norm + lm_head + aux heads train."""
+        at = self.branch_at()
+        if at is None or at == 0:
+            return None
+        n_layer = self.model.cfg.n_layer
+        layer_mask = (jnp.arange(n_layer) >= at).astype(jnp.float32)
+
+        def mask_leaf(path, leaf):
+            keys = [getattr(p, "key", getattr(p, "idx", None)) for p in path]
+            if "blocks" in keys:
+                return layer_mask.reshape((n_layer,) + (1,) * (np.ndim(leaf) - 1))
+            if "embed" in keys:
+                return np.float32(0.0)
+            return np.float32(1.0)
+
+        return jax.tree_util.tree_map_with_path(mask_leaf, params)
+
+    # ------------------------------------------------------------------
+    # data placement
+    # ------------------------------------------------------------------
+
+    def place_batch(self, batch):
+        """Host batch -> device arrays sharded batch-dim over (dp, fsdp)."""
+        sharding = data_sharding(self.mesh)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), sharding), batch
+        )
+
+    def data_ways(self) -> int:
+        return self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+
+    @staticmethod
+    def pad_rows(arr: np.ndarray, target_rows: int) -> np.ndarray:
+        """Pad the leading dim to `target_rows` by repeating the last row."""
+        n = target_rows - len(arr)
+        if n <= 0:
+            return arr
+        return np.concatenate([arr, np.repeat(arr[-1:], n, axis=0)])
+
+    # ------------------------------------------------------------------
+    # generation
+    # ------------------------------------------------------------------
+
+    def _lm(self) -> TransformerLM:
+        return self.model.lm
+
+    def _get_generate_fn(self, settings: SamplerSettings, shape: Tuple[int, int]):
+        key = (settings, shape)
+        if key not in self._generate_fns:
+            lm = self._lm()
+            make_processor = self.generation_logits_processor
+
+            def fn(params, input_ids, attention_mask, rng):
+                # the processor is built from the LIVE param tree at trace
+                # time (ILQL shapes logits with its current Q/V heads)
+                return generate(
+                    lm, params["base"], input_ids, attention_mask, rng, settings,
+                    logits_processor=make_processor(params),
+                )
+
+            self._generate_fns[key] = jax.jit(fn)
+        return self._generate_fns[key]
+
+    def generation_logits_processor(self, params):
+        """Optional logits hook for sampling, given the full param tree."""
+        return None
+
+    def generate(self, input_ids, attention_mask=None, settings=None, **kwargs):
+        """Sample continuations for experience collection (parity:
+        reference generate/generate_eval :256-288)."""
+        settings = settings or self.generate_experience_settings
+        if kwargs:
+            settings = SamplerSettings.from_gen_kwargs(
+                {**settings.__dict__, **kwargs}
+            )
+        input_ids = np.asarray(input_ids, np.int32)
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        attention_mask = np.asarray(attention_mask, np.int32)
+
+        # pad the batch rows for sharding divisibility AND up to the widest
+        # row count this sampler has already compiled for — a ragged final
+        # eval batch then reuses the cached executable instead of
+        # recompiling the whole decode loop
+        B, P = input_ids.shape
+        target = B + (-B) % self.data_ways()
+        compiled = [
+            shape[0]
+            for (s, shape) in self._generate_fns
+            if s == settings and shape[1] == P and shape[0] >= target
+        ]
+        if compiled:
+            target = min(compiled)
+        if target != B:
+            input_ids = self.pad_rows(input_ids, target)
+            attention_mask = self.pad_rows(attention_mask, target)
+        with self.mesh:
+            fn = self._get_generate_fn(settings, input_ids.shape)
+            self.rng, key = jax.random.split(self.rng)
+            sharding = data_sharding(self.mesh)
+            out = fn(
+                self.params,
+                jax.device_put(input_ids, sharding),
+                jax.device_put(attention_mask, sharding),
+                key,
+            )
+        if target != B:
+            out = jax.tree_util.tree_map(lambda x: x[:B], out)
+        return out
+
+    def generate_eval(self, input_ids, attention_mask=None, **kwargs):
+        return self.generate(
+            input_ids, attention_mask, settings=self.generate_settings, **kwargs
+        )
+
+    # ------------------------------------------------------------------
+    # decode
+    # ------------------------------------------------------------------
+
+    def decode(
+        self,
+        prompts,
+        samples,
+        prompt_sizes=None,
+        append_eos_token: bool = False,
+    ) -> Tuple[List[str], List[str], List[str]]:
+        """Token arrays -> (str_samples, str_prompts, str_outputs), with
+        stop-sequence trimming and EOS recovery (parity: reference
+        accelerate_base_trainer.py:203-255)."""
+        if prompt_sizes is None:
+            prompt_sizes = [np.shape(p)[-1] for p in prompts]
+
+        str_samples, str_prompts, str_outputs = [], [], []
+        eos_id = getattr(self.tokenizer, "eos_token_id", None)
+        pad_id = getattr(self.tokenizer, "pad_token_id", None)
+        eos_token = getattr(self.tokenizer, "eos_token", "") or ""
+        for prompt, sample, prompt_size in zip(prompts, samples, prompt_sizes):
+            prompt, sample = np.asarray(prompt), np.asarray(sample)
+            output_start = 0 if self.config.model.model_arch_type == "seq2seq" else int(prompt_size)
+            str_prompt = self.tokenizer.decode(
+                prompt[: int(prompt_size)], skip_special_tokens=True
+            )
+            str_output = self.tokenizer.decode(
+                sample[output_start:], skip_special_tokens=True
+            )
+            trimmed = False
+            for stop in self.stop_sequences:
+                stop_ix = str_output.find(stop)
+                if stop_ix >= 0:
+                    str_output = str_output[:stop_ix].rstrip()
+                    trimmed = True
+            if append_eos_token and (
+                trimmed or sample[-1] == eos_id or sample[-1] == pad_id
+            ):
+                str_output += eos_token
+            str_prompts.append(str_prompt)
+            str_outputs.append(str_output)
+            if self.config.model.model_arch_type == "seq2seq":
+                sep = getattr(self.tokenizer, "sep_token", "") or ""
+                str_samples.append(str_prompt + sep + str_output)
+            else:
+                str_samples.append(str_prompt + str_output)
+        return str_samples, str_prompts, str_outputs
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Sample eval prompts; score with reward_fn/metric_fn (parity:
+        reference evaluate :339-505, incl. gen-kwarg sweeping)."""
+        logger.info("Evaluating model")
+        import time as _time
+
+        if self.generate_sweep_kwarg is not None:
+            sweep_arg, sweep_values = self.generate_sweep_kwarg
+        else:
+            sweep_arg, sweep_values = None, [None]
+
+        stats: Dict[str, Any] = {}
+        table_rows = []
+        for sweep_value in sweep_values:
+            suffix = f"@{sweep_arg}={sweep_value}" if sweep_value is not None else ""
+            all_samples, all_prompts, all_sizes = [], [], []
+            all_metadata: Dict[str, list] = {}
+            generate_time = _time.time()
+            for batch in self.eval_dataloader:
+                kwargs = {sweep_arg: sweep_value} if sweep_value is not None else {}
+                out = self.generate_eval(batch.input_ids, batch.attention_mask, **kwargs)
+                sequences = np.asarray(out["sequences"])
+                all_samples.extend(sequences)
+                all_prompts.extend(np.asarray(batch.input_ids))
+                all_sizes.extend([batch.input_ids.shape[1]] * len(sequences))
+                for k, v in (batch.metadata or {}).items():
+                    all_metadata.setdefault(k, []).extend(v)
+            stats["time/generate"] = _time.time() - generate_time
+
+            str_samples, str_prompts, str_outputs = self.decode(
+                all_prompts, all_samples, all_sizes
+            )
+            columns = ["prompt", "output"]
+            columns_data = [str_prompts, str_outputs]
+
+            if self.reward_fn:
+                rewards = self.reward_fn(
+                    samples=str_samples,
+                    prompts=str_prompts,
+                    outputs=str_outputs,
+                    tokenizer=self.tokenizer,
+                    **all_metadata,
+                )
+                rewards = [
+                    float(np.sum(r)) if np.ndim(r) else float(r) for r in rewards
+                ]
+                columns.append("reward")
+                columns_data.append(rewards)
+                stats[f"reward/mean{suffix}"] = float(np.mean(rewards))
+            if self.metric_fn:
+                metric_time = _time.time()
+                metrics = self.metric_fn(
+                    samples=str_samples, prompts=str_prompts, outputs=str_outputs,
+                    **all_metadata,
+                )
+                stats["time/metric"] = _time.time() - metric_time
+                stats.update(
+                    {
+                        f"metrics/{k}{suffix}": float(np.mean(xs))
+                        for k, xs in metrics.items()
+                    }
+                )
+                for metric, values in metrics.items():
+                    if isinstance(values, float):
+                        continue
+                    columns.append(metric)
+                    columns_data.append(list(values))
+            if sweep_arg is not None:
+                columns.insert(0, sweep_arg)
+                columns_data.insert(0, [sweep_value] * len(str_prompts))
+            table_rows.extend(list(zip(*columns_data)))
+
+        title = f"Evaluation #{self.nth_evaluation}"
+        for k, x in stats.items():
+            if k.startswith("reward") or k.startswith("metrics"):
+                title += f" {k}: {significant(x)}"
+        logger.info(title)
+        for row in table_rows[: max(3, len(sweep_values))]:
+            logger.info(" | ".join(str(significant(x))[:64] for x in row))
+
+        self.nth_evaluation += 1
+        return stats
+
+    # ------------------------------------------------------------------
+    # the training loop
+    # ------------------------------------------------------------------
+
+    def make_train_step(self):
+        """One jitted function: microbatch scan -> mean grads -> masked
+        optimizer update. Donates params/opt_state."""
+        loss_fn = self.loss
+        num_mb, mb_size = self.num_mb, self.mb_size
+        tx = self.tx
+
+        def train_step(params, opt_state, batch):
+            def compute(p, b):
+                return jax.value_and_grad(loss_fn, has_aux=True)(p, b)
+
+            if num_mb == 1:
+                (loss, stats), grads = compute(params, batch)
+            else:
+                mbs = jax.tree_util.tree_map(
+                    lambda x: x.reshape((num_mb, mb_size) + x.shape[1:]), batch
+                )
+                first = jax.tree_util.tree_map(lambda x: x[0], mbs)
+                (l_shape, s_shape), g_shape = jax.eval_shape(compute, params, first)
+                zeros = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), (g_shape, l_shape, s_shape)
+                )
+
+                def body(acc, mb):
+                    (l, s), g = compute(params, mb)
+                    return jax.tree_util.tree_map(jnp.add, acc, (g, l, s)), None
+
+                (g_sum, l_sum, s_sum), _ = jax.lax.scan(body, zeros, mbs)
+                grads = jax.tree_util.tree_map(lambda x: x / num_mb, g_sum)
+                loss = l_sum / num_mb
+                stats = jax.tree_util.tree_map(lambda x: x / num_mb, s_sum)
+
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt_state, loss, stats
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    @abstractmethod
+    def loss(self, params, batch) -> Tuple[jnp.ndarray, Dict]:
+        """Pure jittable loss: (params, device batch) -> (loss, stats)."""
+
+    @abstractmethod
+    def prepare_learning(self) -> None:
+        """Build train/eval dataloaders, set self.n_inner_epochs/total_steps."""
+
+    @abstractmethod
+    def create_train_dataloader(self):
+        """Fresh (reshuffled) training dataloader."""
+
+    def post_backward_callback(self) -> None:
+        pass
+
+    def post_epoch_callback(self) -> None:
+        pass
+
+    def add_prompt_pipeline(self, pipeline) -> None:
+        raise NotImplementedError
+
+    def learn(self):
+        """The training loop (parity: reference learn() :518-651)."""
+        logger.info("Starting training")
+        self.prepare_learning()
+        self.iter_count = 0
+        self.nth_evaluation = 0
+
+        results = self.evaluate()
+        self.tracker.log(results, step=self.iter_count)
+
+        best_reward = -float("inf")
+        if self._train_step is None:
+            self._train_step = self.make_train_step()
+
+        clock = Clock()
+        for _ in range(self.config.train.epochs):
+            for _ in range(self.n_inner_epochs):
+                train_dataloader = self.create_train_dataloader()
+                for batch in train_dataloader:
+                    device_batch = self.place_batch(batch)
+                    forward_time = clock.tick()
+                    with self.mesh:
+                        self.params, self.opt_state, loss, stats = self._train_step(
+                            self.params, self.opt_state, device_batch
+                        )
+                    loss = to_scalar(loss)  # sync point: step is done
+                    step_time = clock.tick()
+                    stats = {
+                        k: to_scalar(v)
+                        for k, v in stats.items()
+                        if np.ndim(v) == 0
+                    }
+                    # jit fuses fwd+bwd+update: report the fused step time
+                    # under both keys the reference emits
+                    stats["time/forward"] = step_time
+                    stats["time/backward"] = 0.0
+                    stats["time/step"] = step_time
+                    stats["learning_rate_group_0"] = float(
+                        self.schedule(self.iter_count)
+                    )
+                    self.iter_count += 1
+
+                    if (
+                        self.iter_count % self.config.train.checkpoint_interval == 0
+                        or self.iter_count >= self.total_steps
+                    ):
+                        subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
+                        directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
+                        logger.info("Saving checkpoint into %s", directory)
+                        if self.config.train.save_optimizer:
+                            self.save(directory)
+                        self.save_pretrained(os.path.join(directory, "hf_model"))
+
+                    if (
+                        self.iter_count % self.config.train.eval_interval == 0
+                        or self.iter_count >= self.total_steps
+                    ):
+                        results = self.evaluate()
+                        stats.update(results)
+
+                        if self.config.train.save_best:
+                            reward = stats.get(
+                                "reward/mean", stats.get("metrics/reward", -float("inf"))
+                            )
+                            if reward > best_reward:
+                                best_reward = reward
+                                directory = os.path.join(
+                                    self.config.train.checkpoint_dir, "best_checkpoint"
+                                )
+                                logger.info("Saving best checkpoint into %s", directory)
+                                if self.config.train.save_optimizer:
+                                    self.save(directory)
+                                self.save_pretrained(os.path.join(directory, "hf_model"))
+
+                    desc = " | ".join(
+                        f"{k}: {v:.2f}"
+                        for k, v in stats.items()
+                        if k.startswith("losses/") or k == "loss"
+                    )
+                    logger.info("[step %d/%d] %s", self.iter_count, self.total_steps, desc)
+                    self.tracker.log(stats, step=self.iter_count)
+
+                    if self.iter_count >= self.total_steps:
+                        return results
+                self.post_backward_callback()
+            self.post_epoch_callback()
+        return results
+
+    # ------------------------------------------------------------------
+    # checkpointing
+    # ------------------------------------------------------------------
+
+    def _state_tree(self) -> Dict:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def save(self, directory: Optional[str] = None) -> None:
+        """Full training state via Orbax + state.json (parity: reference
+        save :309-326 / accelerator.save_state)."""
+        import orbax.checkpoint as ocp
+
+        directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(
+            os.path.join(directory, "state"), self._state_tree(), force=True
+        )
+        with open(os.path.join(directory, "state.json"), "w") as f:
+            json.dump({"iter_count": self.iter_count}, f)
+
+    def load(self, directory: Optional[str] = None) -> None:
+        import orbax.checkpoint as ocp
+
+        directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(
+            os.path.join(directory, "state"), item=self._state_tree()
+        )
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
+        state_fp = os.path.join(directory, "state.json")
+        if os.path.exists(state_fp):
+            with open(state_fp) as f:
+                self.iter_count = json.load(f).get("iter_count", 0)
+
+    def save_pretrained(self, directory: Optional[str] = None) -> None:
+        """Deploy artifact: HF-format export of the base model when the
+        architecture supports it, else an Orbax params dump (parity:
+        reference save_pretrained :285-307)."""
+        directory = os.path.abspath(
+            directory
+            or os.path.join(self.config.train.checkpoint_dir, "hf_model")
+        )
+        os.makedirs(directory, exist_ok=True)
+        base = self.params.get("base", self.params)
+        base = jax.device_get(base)
+        # auxiliary heads (value / Q) ride alongside the deploy artifact so
+        # an ILQL/PPO policy reloads losslessly (the HF export itself stays
+        # base-only for from_pretrained parity, reference :526-553)
+        aux = {k: v for k, v in self.params.items() if k != "base"}
+        if aux:
+            import orbax.checkpoint as ocp
+
+            ocp.PyTreeCheckpointer().save(
+                os.path.join(directory, "aux"), jax.device_get(aux), force=True
+            )
+        model_type = getattr(self, "model_type", None)
+        exported = False
+        if model_type is not None and getattr(self, "_hf_config_path", None):
+            try:
+                import transformers
+
+                hf_config = transformers.AutoConfig.from_pretrained(self._hf_config_path)
+                save_pretrained_hf(base, self.model.cfg, model_type, hf_config, directory)
+                exported = True
+            except Exception as e:
+                logger.warning("HF export failed (%s); saving orbax params", e)
+        if not exported:
+            import dataclasses
+
+            import orbax.checkpoint as ocp
+
+            ocp.PyTreeCheckpointer().save(
+                os.path.join(directory, "params"), base, force=True
+            )
+            tcfg = {
+                k: v
+                for k, v in dataclasses.asdict(self.model.cfg).items()
+                if k not in ("dtype", "param_dtype") and v is not None
+            }
+            with open(os.path.join(directory, "trlx_tpu_config.json"), "w") as f:
+                json.dump({"transformer": tcfg, "model_type": model_type}, f)
+        if hasattr(self.tokenizer, "save_pretrained"):
+            self.tokenizer.save_pretrained(directory)
+
+
+# ---------------------------------------------------------------------------
+# update masking (layer freezing)
+# ---------------------------------------------------------------------------
+
+
+def _mask_updates(mask_tree) -> optax.GradientTransformation:
+    """Multiply updates elementwise by a broadcastable {0,1} mask."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        masked = jax.tree_util.tree_map(
+            lambda u, m: u * jnp.asarray(m, u.dtype), updates, mask_tree
+        )
+        return masked, state
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,164 @@
+"""ILQL trainer: offline Q-learning from reward-labeled samples.
+
+Parity: /root/reference/trlx/trainer/accelerate_ilql_trainer.py:30-255
+(module-level `make_experience` tokenizing samples into an
+ILQLRolloutStorage with the normalized return on the final action token)
+and modeling_ilql.py (loss via ILQLConfig, target-Q Polyak sync every
+`steps_for_target_q_sync` steps, advantage-shaped generation).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import numpy as np
+
+from trlx_tpu.data import ILQLBatch
+from trlx_tpu.data.method_configs import ILQLConfig
+from trlx_tpu.models.wrappers import CausalLMWithILQLHeads
+from trlx_tpu.ops.ilql import ilql_loss
+from trlx_tpu.parallel import shard_params
+from trlx_tpu.pipeline.offline_pipeline import ILQLRolloutStorage, tokenize_dialogue
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base import TPUBaseTrainer
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+def make_experience(
+    samples: Union[List[str], List[tuple]],
+    rewards: List[float],
+    tokenizer=None,
+    max_length: int = 2048,
+    verbose: bool = True,
+) -> ILQLRolloutStorage:
+    """Tokenize dialogues, compute state/action indices and place the
+    normalized return on the final action token (parity: reference
+    accelerate_ilql_trainer.py:30-100)."""
+    if verbose:
+        logger.info("Collecting rollouts")
+    if tokenizer is not None:
+        samples = [tokenize_dialogue(s, tokenizer, max_length) for s in samples]
+
+    all_input_ids, all_actions_ixs, all_states_ixs, all_dones = [], [], [], []
+    for sample in samples:
+        length = 0
+        input_ids = [t for m in sample for t in m.tokens]
+        all_input_ids.append(input_ids)
+        actions_ixs: List[np.ndarray] = []
+        for dm in sample:
+            if dm.is_output:
+                actions_ixs.append(np.arange(length - 1, length + len(dm.tokens) - 1))
+            length += len(dm.tokens)
+        if not actions_ixs:
+            raise ValueError("sample has no output tokens")
+        acts = np.concatenate(actions_ixs)
+        states = np.concatenate([acts, [length - 1]])
+        all_actions_ixs.append(acts.tolist())
+        all_states_ixs.append(states.tolist())
+        all_dones.append([1] * (len(states) - 1) + [0])
+
+    returns = np.asarray(rewards, np.float64)
+    returns = returns - returns.mean()
+    std = returns.std()
+    if not np.isnan(std) and std > 0:
+        returns = returns / (std + np.finfo(returns.dtype).eps)
+    rewards_per_sample = []
+    for acts, ret in zip(all_actions_ixs, returns):
+        rs = [0.0] * len(acts)
+        rs[-1] = float(ret)
+        rewards_per_sample.append(rs)
+
+    attention_masks = [[1] * len(ids) for ids in all_input_ids]
+    return ILQLRolloutStorage(
+        all_input_ids, attention_masks, rewards_per_sample,
+        all_states_ixs, all_actions_ixs, all_dones,
+    )
+
+
+@register_trainer("TPUILQLTrainer")
+class TPUILQLTrainer(TPUBaseTrainer):
+    def __init__(self, config, **kwargs):
+        if not isinstance(config.method, ILQLConfig):
+            raise ValueError("config.method must be ILQLConfig")
+        super().__init__(config, **kwargs)
+        self._sync_fn = None
+
+    def setup_model(self) -> None:
+        cfg, base_params, self.model_type = self.load_base_model()
+        method = self.config.method
+        self.model = CausalLMWithILQLHeads(
+            cfg, two_qs=method.two_qs, alpha=method.alpha
+        )
+        self.rng, key = jax.random.split(self.rng)
+        params = self.model.init_params(key, base_params)
+        aux = getattr(self, "_loaded_aux", None) or {}
+        if "heads" in aux:
+            heads = dict(aux["heads"])
+            for k in ("q_heads", "target_q_heads"):
+                if isinstance(heads.get(k), dict):
+                    # orbax round-trips lists as {"0": ..., "1": ...}
+                    heads[k] = [heads[k][i] for i in sorted(heads[k], key=int)]
+            aux = dict(aux, heads=heads)
+        params.update(aux)
+        self.params = shard_params(self.mesh, params)
+
+    def trainable_mask(self):
+        mask = self.make_freeze_mask(self.params)
+        if mask is None:
+            # target heads only ever move through Polyak sync
+            mask = jax.tree_util.tree_map(lambda _: np.float32(1.0), self.params)
+        mask["heads"]["target_q_heads"] = jax.tree_util.tree_map(
+            lambda _: np.float32(0.0), mask["heads"]["target_q_heads"]
+        )
+        return mask
+
+    def loss(self, params, batch: ILQLBatch):
+        logits, qvs = self.model.forward(
+            params, batch.input_ids, batch.attention_mask,
+            batch.states_ixs, batch.actions_ixs,
+            remat=self.config.train.remat_policy != "none",
+        )
+        method = self.config.method
+        return ilql_loss(
+            logits, *qvs[:2], qvs[2], batch,
+            tau=method.tau, gamma=method.gamma, cql_scale=method.cql_scale,
+            awac_scale=method.awac_scale, beta=method.beta, two_qs=method.two_qs,
+        )
+
+    def generation_logits_processor(self, params):
+        beta = float(self.config.method.gen_kwargs.get("beta", 1.0))
+        return self.model.make_logits_processor(params["heads"], beta)
+
+    def make_experience(self, samples, rewards, seq_length: int = 1024) -> None:
+        self.store = make_experience(samples, rewards, self.tokenizer, seq_length)
+
+    def prepare_learning(self) -> None:
+        self.eval_dataloader = self.eval_pipeline.create_loader(
+            self.config.train.batch_size
+        )
+        self.n_inner_epochs = 1
+        n_batches = len(self.store) // self.config.train.batch_size
+        self.total_steps = min(
+            self.config.train.epochs * max(n_batches, 1),
+            self.config.train.total_steps,
+        )
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, drop_last=True,
+            seed=self.config.train.seed + self.iter_count,
+        )
+
+    def post_backward_callback(self) -> None:
+        method = self.config.method
+        if self.iter_count % method.steps_for_target_q_sync == 0:
+            if self._sync_fn is None:
+                self._sync_fn = jax.jit(
+                    lambda p: self.model.sync_target(p, method.alpha),
+                    donate_argnums=0,
+                )
+            with self.mesh:
+                self.params = self._sync_fn(self.params)

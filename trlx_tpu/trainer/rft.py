@@ -1,0 +1,154 @@
+"""Rejection-sampling fine-tuning (RFT) trainer.
+
+Parity: /root/reference/trlx/trainer/accelerate_rft_trainer.py:46-197 —
+every `n_improve_steps` epochs, sample `n_generations_per_prompt`
+continuations per prompt, score them with the reward_fn, keep samples
+above a per-prompt score percentile that rises from `start_percentile`
+to `end_percentile` across the improve window, dedup, and fine-tune on
+the survivors with full-sequence LM loss.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+from trlx_tpu.data import SFTBatch
+from trlx_tpu.data.method_configs import RFTConfig
+from trlx_tpu.models.wrappers import CausalLM
+from trlx_tpu.parallel import shard_params
+from trlx_tpu.pipeline.offline_pipeline import DialogStore, tokenize_dialogue
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base import TPUBaseTrainer
+from trlx_tpu.trainer.sft import sft_loss
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+@register_trainer("TPURFTTrainer")
+class TPURFTTrainer(TPUBaseTrainer):
+    def __init__(self, config, **kwargs):
+        if not isinstance(config.method, RFTConfig):
+            raise ValueError("config.method must be RFTConfig")
+        super().__init__(config, **kwargs)
+        self.generations_per_prompt: Dict[str, List[dict]] = defaultdict(list)
+        self.epoch_count = 0
+
+    def setup_model(self) -> None:
+        cfg, base_params, self.model_type = self.load_base_model()
+        self.model = CausalLM(cfg)
+        self.rng, key = jax.random.split(self.rng)
+        self.params = shard_params(self.mesh, self.model.init_params(key, base_params))
+
+    def trainable_mask(self):
+        return self.make_freeze_mask(self.params)
+
+    def loss(self, params, batch: SFTBatch):
+        # full-sequence LM loss: every non-pad token is a label (parity:
+        # reference loss :82-87 labels=input_ids)
+        import jax.numpy as jnp
+
+        out = self.model.forward(
+            params, batch.input_ids, batch.attention_mask,
+            remat=self.config.train.remat_policy != "none",
+        )
+        labels = jnp.where(batch.attention_mask > 0, batch.input_ids, -100)
+        return sft_loss(out["logits"], labels)
+
+    def add_prompt_pipeline(self, pipeline) -> None:
+        self.prompt_dataloader = pipeline.create_loader(self.config.train.batch_size)
+
+    def make_experience(self, samples=None, rewards=None, seq_length=None) -> None:
+        """Regenerate + rescore + reselect the training set (parity:
+        reference make_experience :117-197)."""
+        method = self.config.method
+        if self.epoch_count % method.n_improve_steps == 0:
+            generations = []
+            for batch in self.prompt_dataloader:
+                for _ in range(method.n_generations_per_prompt):
+                    out = self.generate(batch.input_ids, batch.attention_mask)
+                    sequences = np.asarray(out["sequences"])
+                    _, str_prompts, str_outputs = self.decode(
+                        np.asarray(batch.input_ids), sequences,
+                        [batch.input_ids.shape[1]] * len(sequences),
+                        append_eos_token=True,
+                    )
+                    generations.extend(
+                        {"prompt": p, "output": o}
+                        for p, o in zip(str_prompts, str_outputs)
+                    )
+
+            scores = self.reward_fn(
+                samples=[g["prompt"] + g["output"] for g in generations],
+                prompts=[g["prompt"] for g in generations],
+                outputs=[g["output"] for g in generations],
+            )
+            for g, s in zip(generations, scores):
+                self.generations_per_prompt[g["prompt"]].append(
+                    {"output": g["output"], "score": float(s)}
+                )
+
+        per_prompt_scores = [
+            [x["score"] for x in self.generations_per_prompt[p]]
+            for p in self.generations_per_prompt
+        ]
+        percentile_delta = (
+            method.end_percentile - method.start_percentile
+        ) / method.n_improve_steps
+        percentile = method.start_percentile + percentile_delta * (
+            self.epoch_count % method.n_improve_steps
+        )
+        thresholds = np.array(
+            [np.quantile(np.asarray(s), percentile) for s in per_prompt_scores]
+        )
+        # quantized rewards: exclude min values but never the max
+        thresholds = np.clip(thresholds, thresholds.min() + 1e-3, thresholds.max() - 1e-3)
+
+        samples_selected = []
+        for prompt, threshold in zip(self.generations_per_prompt, thresholds):
+            for x in self.generations_per_prompt[prompt]:
+                if x["score"] >= threshold:
+                    samples_selected.append((prompt, x["output"]))
+        samples_selected = sorted(set(samples_selected))
+
+        self.tracker.log(
+            {
+                "scores_mean": float(np.mean(np.hstack(per_prompt_scores))),
+                "len_samples_selected": len(samples_selected),
+                "percentile": float(percentile),
+            },
+            step=self.iter_count,
+        )
+
+        if samples_selected:
+            dialogs = [
+                tokenize_dialogue(list(pair), self.tokenizer, self.config.train.seq_length)
+                for pair in samples_selected
+            ]
+            # fixed width across improve rounds: one compiled train step
+            self.store = DialogStore(
+                dialogs, self.tokenizer, max_length=self.config.train.seq_length
+            )
+
+    def prepare_learning(self) -> None:
+        self.eval_dataloader = self.eval_pipeline.create_loader(
+            self.config.train.batch_size
+        )
+        self.n_inner_epochs = 1
+        self.total_steps = self.config.train.total_steps
+        self.epoch_count = 0
+        self.make_experience()
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True,
+            seed=self.config.train.seed + self.iter_count,
+        )
+
+    def post_epoch_callback(self) -> None:
+        self.epoch_count += 1
+        self.make_experience()

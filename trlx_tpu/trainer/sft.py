@@ -1,0 +1,88 @@
+"""Supervised fine-tuning trainer.
+
+Parity: /root/reference/trlx/trainer/accelerate_sft_trainer.py:29-97 —
+causal-LM cross-entropy with -100 masking of prompt/padding tokens; the
+store is a DialogStore over (prompt, output) pairs or plain strings.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data import SFTBatch
+from trlx_tpu.data.method_configs import SFTConfig
+from trlx_tpu.models.wrappers import CausalLM
+from trlx_tpu.parallel import shard_params
+from trlx_tpu.pipeline.offline_pipeline import DialogStore, tokenize_dialogue
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base import TPUBaseTrainer
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+def sft_loss(logits: jnp.ndarray, labels: jnp.ndarray):
+    """Shifted cross-entropy; label -100 = ignored (HF convention)."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    labels = labels[:, 1:]
+    mask = (labels != -100).astype(jnp.float32)
+    safe_labels = jnp.where(labels == -100, 0, labels)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    n = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / n
+    return loss, {"losses/loss": loss, "perplexity": jnp.exp(loss)}
+
+
+@register_trainer("TPUSFTTrainer")
+class TPUSFTTrainer(TPUBaseTrainer):
+    def __init__(self, config, **kwargs):
+        if not isinstance(config.method, SFTConfig):
+            raise ValueError("config.method must be SFTConfig")
+        super().__init__(config, **kwargs)
+
+    def setup_model(self) -> None:
+        cfg, base_params, self.model_type = self.load_base_model()
+        self.model = CausalLM(cfg)
+        self.rng, key = jax.random.split(self.rng)
+        self.params = shard_params(self.mesh, self.model.init_params(key, base_params))
+
+    def trainable_mask(self):
+        return self.make_freeze_mask(self.params)
+
+    def loss(self, params, batch: SFTBatch):
+        out = self.model.forward(
+            params, batch.input_ids, batch.attention_mask,
+            remat=self.config.train.remat_policy != "none",
+        )
+        return sft_loss(out["logits"], batch.labels)
+
+    def make_experience(
+        self,
+        samples: Union[List[str], List[tuple], List[list]],
+        rewards: Optional[List[float]] = None,
+        seq_length: int = 1024,
+    ) -> None:
+        del rewards  # SFT ignores rewards (parity: reference :80-88)
+        dialogs = [tokenize_dialogue(s, self.tokenizer, seq_length) for s in samples]
+        self.store = DialogStore(dialogs, self.tokenizer, max_length=seq_length)
+
+    def prepare_learning(self) -> None:
+        self.eval_dataloader = self.eval_pipeline.create_loader(
+            self.config.train.batch_size
+        )
+        self.n_inner_epochs = 1
+        n_batches = len(self.store) // self.config.train.batch_size
+        self.total_steps = min(
+            self.config.train.epochs * max(n_batches, 1),
+            self.config.train.total_steps,
+        )
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True,
+            seed=self.config.train.seed + self.iter_count,
+        )

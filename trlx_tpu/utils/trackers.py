@@ -1,0 +1,93 @@
+"""Metric trackers: tensorboard / wandb / jsonl / console.
+
+Parity: the reference routes metrics through
+`accelerator.init_trackers`/`accelerator.log`
+(/root/reference/trlx/trainer/accelerate_base_trainer.py:95-136) with
+wandb or tensorboard backends and auto-composed run names. Here a thin
+`Tracker` owns the same role; a JSONL file is always written under
+`logging_dir` so benchmark tooling can scrape metrics without a tracker
+dependency (reference scripts/benchmark.sh scrapes W&B instead).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from numbers import Number
+from typing import Any, Dict, Optional
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+def _run_name(config) -> str:
+    script = os.path.basename(getattr(sys.modules.get("__main__"), "__file__", "run") or "run")
+    model = config.model.model_path.rstrip("/").split("/")[-1]
+    import jax
+
+    return config.train.run_name or f"{script}/{model}/{len(jax.devices())}dev"
+
+
+class Tracker:
+    """Dispatches scalar stats to the configured backend + a JSONL log."""
+
+    def __init__(self, config):
+        train = config.train
+        self.backend = train.tracker
+        self.run_name = _run_name(config)
+        self.logging_dir = train.logging_dir or os.path.join(
+            train.checkpoint_dir, "logs"
+        )
+        os.makedirs(self.logging_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(self.logging_dir, "metrics.jsonl"), "a")
+        self._tb = None
+        self._wandb = None
+
+        if self.backend == "tensorboard":
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(
+                    log_dir=os.path.join(self.logging_dir, self.run_name.replace("/", "_"))
+                )
+            except Exception as e:  # tensorboard is optional
+                logger.warning("tensorboard unavailable (%s); falling back to jsonl", e)
+        elif self.backend == "wandb":
+            try:
+                import wandb
+
+                self._wandb = wandb.init(
+                    project=train.project_name,
+                    name=self.run_name,
+                    entity=train.entity_name,
+                    group=train.group_name,
+                    tags=train.tags,
+                    config=config.to_dict(),
+                )
+            except Exception as e:
+                logger.warning("wandb unavailable (%s); falling back to jsonl", e)
+        elif self.backend not in (None, "jsonl"):
+            raise ValueError(
+                f"unknown tracker {self.backend!r} (tensorboard | wandb | jsonl | None)"
+            )
+
+    def log(self, stats: Dict[str, Any], step: int) -> None:
+        scalars = {k: float(v) for k, v in stats.items() if isinstance(v, Number)}
+        rec = dict(scalars, _step=step, _time=time.time())
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, v, step)
+        if self._wandb is not None:
+            self._wandb.log(stats, step=step)
+
+    def close(self) -> None:
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+        if self._wandb is not None:
+            self._wandb.finish()
