@@ -105,6 +105,34 @@ def test_ilql_learn(tmp_path):
     assert trainer.iter_count == 2
 
 
+@pytest.mark.slow
+def test_ppo_seq2seq_learn(tmp_path):
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=2, checkpoint_interval=10,
+            seq_length=16, epochs=2, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=dict(
+            model_path="random", model_arch_type="seq2seq", num_layers_unfrozen=1,
+            model_extra_configs={
+                "seq2seq": dict(d_model=16, n_layer=2, n_head=2, d_kv=8, d_ff=32,
+                                relative_attention_num_buckets=8)
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    prompts = ["hello world", "the cat", "a b", "xyz", "what is", "I am", "go", "ok"]
+    trainer = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=prompts, config=config
+    )
+    assert trainer.iter_count == 2
+
+
 def test_trainer_registry_aliases():
     from trlx_tpu.utils.loading import get_trainer
 

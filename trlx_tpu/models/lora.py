@@ -1,0 +1,126 @@
+"""LoRA: low-rank adapter overlays on the stacked param tree.
+
+Parity: the reference delegates to the `peft` library
+(/root/reference/trlx/models/modeling_base.py:124-275 wires
+peft_config through from_pretrained; tests/test_peft.py is the contract).
+Here adapters are first-party and TPU-shaped: one (A, B) pair per
+*stacked* kernel — a rank-r overlay for ALL layers at once with a leading
+L axis — merged into the base weights by einsum inside jit, so the base
+forward is unchanged and XLA fuses the merge into the surrounding matmul
+schedule.
+
+The adapter tree is flat: {path: {"a": [L?, in, r], "b": [L?, r, out]}}.
+`merge_lora` adds scaling * A@B (reshaped) onto each targeted kernel;
+gradients flow through the merge to A/B only when the base is wrapped in
+stop_gradient by the caller.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+# (path regex, n leading stack dims, n input dims, n output dims)
+_SPLITS: List[Tuple[str, int, int, int]] = [
+    (r"blocks/attn/[qkv]/kernel$", 1, 1, 2),   # [L, E, H, D]
+    (r"blocks/attn/o/kernel$", 1, 2, 1),       # [L, H, D, E]
+    (r"blocks/mlp/fc_(in|gate|out)/kernel$", 1, 1, 1),  # [L, in, out]
+    (r"lm_head/kernel$", 0, 1, 1),             # [E, V]
+]
+
+DEFAULT_TARGETS = r"blocks/attn/[qkv]/kernel$|blocks/attn/o/kernel$"
+
+
+def normalize_peft_config(peft_config: Any) -> Dict[str, Any]:
+    """Accept a dict in the HF peft style ({"peft_type": "LORA", "r": 8,
+    "lora_alpha": 16, ...}) and normalize to our fields."""
+    if peft_config is None:
+        return None
+    cfg = dict(peft_config)
+    peft_type = str(cfg.get("peft_type", "LORA")).upper()
+    if peft_type != "LORA":
+        raise ValueError(
+            f"peft_type {peft_type!r} not supported (LORA only); the reference's "
+            "PROMPT_TUNING/PREFIX_TUNING variants are not implemented"
+        )
+    return {
+        "r": int(cfg.get("r", 8)),
+        "alpha": float(cfg.get("lora_alpha", cfg.get("alpha", 16))),
+        "targets": cfg.get("target_modules") or DEFAULT_TARGETS,
+    }
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return "/".join(parts)
+
+
+def _split_for(path_str: str):
+    for pattern, n_stack, n_in, n_out in _SPLITS:
+        if re.search(pattern, path_str):
+            return n_stack, n_in, n_out
+    return None
+
+
+def _target_match(path_str: str, targets) -> bool:
+    if isinstance(targets, str):
+        return re.search(targets, path_str) is not None
+    # HF-style list of module names ("q", "fc_in", "q_proj"...)
+    leaf_module = path_str.split("/")[-2] if "/" in path_str else path_str
+    aliases = {"q_proj": "q", "k_proj": "k", "v_proj": "v", "o_proj": "o",
+               "c_attn": "q", "out_proj": "o"}
+    names = {aliases.get(t, t) for t in targets}
+    return leaf_module in names
+
+
+def init_lora_params(
+    rng: jax.Array, base_params: Dict, r: int, targets=DEFAULT_TARGETS
+) -> Dict[str, Dict[str, Array]]:
+    """{path: {a, b}} for every targeted kernel. A ~ N(0, 0.02), B = 0 so
+    the overlay starts as a no-op (standard LoRA init)."""
+    lora: Dict[str, Dict[str, Array]] = {}
+    flat = jax.tree_util.tree_flatten_with_path(base_params)[0]
+    keys = iter(jax.random.split(rng, len(flat)))
+    for path, leaf in flat:
+        ps = _path_str(path)
+        key = next(keys)
+        split = _split_for(ps)
+        if split is None or not _target_match(ps, targets):
+            continue
+        n_stack, n_in, n_out = split
+        shape = np.shape(leaf)
+        stack = shape[:n_stack]
+        d_in = int(np.prod(shape[n_stack : n_stack + n_in]))
+        d_out = int(np.prod(shape[n_stack + n_in :]))
+        lora[ps] = {
+            "a": jax.random.normal(key, stack + (d_in, r), jnp.float32) * 0.02,
+            "b": jnp.zeros(stack + (r, d_out), jnp.float32),
+        }
+    if not lora:
+        raise ValueError(f"no LoRA targets matched {targets!r}")
+    return lora
+
+
+def merge_lora(base_params: Dict, lora: Dict[str, Dict[str, Array]], scaling: float) -> Dict:
+    """base + scaling * A@B on every adapted kernel (pure; jit-friendly)."""
+
+    def merge_leaf(path, leaf):
+        ps = _path_str(path)
+        ab = lora.get(ps)
+        if ab is None:
+            return leaf
+        delta = jnp.einsum(
+            "...ir,...ro->...io", ab["a"], ab["b"],
+            preferred_element_type=jnp.float32,
+        ) * scaling
+        return leaf + delta.reshape(leaf.shape).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(merge_leaf, base_params)

@@ -356,6 +356,73 @@ class T5LM:
             "encoder_hidden": encoder_hidden,
         }
 
+    # -- hydra support ---------------------------------------------------
+
+    def forward_with_branch_capture(
+        self,
+        params: Dict,
+        input_ids: Array,
+        attention_mask: Array,
+        decoder_input_ids: Array,
+        decoder_attention_mask: Optional[Array],
+        branch_at: int,
+    ) -> Dict[str, Array]:
+        """Teacher-forced forward that also returns the decoder hidden
+        state entering layer `branch_at` plus the biases needed to re-run
+        the top branch (parity: the reference's frozen `T5Branch`,
+        modeling_ppo.py:1483-1592, which re-runs top decoder blocks)."""
+        cfg = self.cfg
+        encoder_hidden = self.encode(params, input_ids, attention_mask)
+        B, T = decoder_input_ids.shape
+        pos = jnp.arange(T)
+        self_bias = compute_position_bias(
+            params["decoder"]["rel_bias"], pos, pos, False,
+            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        )
+        causal = pos[:, None] >= pos[None, :]
+        self_bias = self_bias + jnp.where(causal[None, None], 0.0, NEG_INF)
+        if decoder_attention_mask is not None:
+            self_bias = self_bias + jnp.where(
+                decoder_attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
+            )
+        cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+
+        bottom = jax.tree_util.tree_map(
+            lambda x: x[:branch_at], params["decoder"]["blocks"]
+        )
+        top = jax.tree_util.tree_map(
+            lambda x: x[branch_at:], params["decoder"]["blocks"]
+        )
+        h = self._embed(params, decoder_input_ids)
+        h_branch, _ = self._scan(self.dec_block, bottom, h, self_bias, encoder_hidden, cross_bias)
+        h_top, _ = self._scan(self.dec_block, top, h_branch, self_bias, encoder_hidden, cross_bias)
+        hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h_top)
+        return {
+            "logits": self._logits(params, hidden),
+            "hidden_states": hidden,
+            "branch_hidden": h_branch,
+            "self_bias": self_bias,
+            "cross_bias": cross_bias,
+            "encoder_hidden": encoder_hidden,
+        }
+
+    def forward_from_layer(
+        self,
+        branch_params: Dict,
+        branch_hidden: Array,
+        self_bias: Array,
+        encoder_hidden: Array,
+        cross_bias: Array,
+    ) -> Dict[str, Array]:
+        """Run a frozen top-k decoder branch from a captured hidden state."""
+        h, _ = self._scan(
+            self.dec_block, branch_params["blocks"], branch_hidden, self_bias,
+            encoder_hidden, cross_bias,
+        )
+        hidden = self.norm.apply({"params": branch_params["ln_f"]}, h)
+        return {"logits": self._logits(branch_params, hidden)}
+
+
     # -- decoding --------------------------------------------------------
 
     def init_cache(self, batch: int, max_len: int) -> Dict:
@@ -395,6 +462,21 @@ class T5LM:
         )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h)
         return {"logits": self._logits(params, hidden), "hidden_states": hidden}, new_cache
+
+
+def extract_t5_branch_params(params: Dict, branch_at: int) -> Dict:
+    """Frozen top decoder branch + final norm + logit head (deep-copied:
+    trainers donate the policy buffers)."""
+    branch = {
+        "blocks": jax.tree_util.tree_map(
+            lambda x: x[branch_at:], params["decoder"]["blocks"]
+        ),
+        "ln_f": params["decoder"]["ln_f"],
+        "shared": params["shared"],
+    }
+    if "lm_head" in params:
+        branch["lm_head"] = params["lm_head"]
+    return jax.tree_util.tree_map(jnp.copy, jax.lax.stop_gradient(branch))
 
 
 def generate_seq2seq(

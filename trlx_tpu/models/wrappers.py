@@ -11,6 +11,12 @@ layer stack re-run from the captured hidden state
 
 Wrappers are functional: `params` trees in, activation dicts out, so the
 trainers can jit/shard/donate them directly.
+
+LoRA: when a params tree carries a "lora" overlay ({path: {a, b}}, see
+trlx_tpu.models.lora), `_effective_base` merges it onto a
+gradient-stopped base — so only the adapters (and heads) train, matching
+the reference's peft contract (tests/test_peft.py: backprop touches
+adapters only; the reference model is the disabled-adapter forward).
 """
 
 from __future__ import annotations
@@ -36,6 +42,18 @@ from trlx_tpu.models.transformer import (
 Array = jnp.ndarray
 
 
+def _effective_base(wrapper, params: Dict) -> Dict:
+    """Resolve the base param tree, merging a LoRA overlay if present."""
+    if "lora" in params:
+        from trlx_tpu.models.lora import merge_lora
+
+        return merge_lora(
+            jax.lax.stop_gradient(params["base"]), params["lora"],
+            getattr(wrapper, "lora_scaling", 1.0),
+        )
+    return params["base"]
+
+
 class CausalLM:
     """Bare causal LM wrapper (SFT/RFT path — no auxiliary heads)."""
 
@@ -55,7 +73,7 @@ class CausalLM:
         attention_mask: Optional[Array] = None,
         remat: bool = False,
     ) -> Dict[str, Array]:
-        return self.lm(params["base"], input_ids, attention_mask, remat=remat)
+        return self.lm(_effective_base(self, params), input_ids, attention_mask, remat=remat)
 
 
 class CausalLMWithValueHead:
@@ -103,7 +121,7 @@ class CausalLMWithValueHead:
         attention_mask: Optional[Array] = None,
         remat: bool = False,
     ) -> Dict[str, Array]:
-        out = self.lm(params["base"], input_ids, attention_mask, remat=remat)
+        out = self.lm(_effective_base(self, params), input_ids, attention_mask, remat=remat)
         values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
         return dict(out, values=values)
 
@@ -128,7 +146,7 @@ class CausalLMWithValueHead:
             return dict(out, ref_logits=jax.lax.stop_gradient(ref_out["logits"]))
 
         out = self.lm.forward_with_branch_capture(
-            params["base"], input_ids, attention_mask, self.branch_at, remat=remat
+            _effective_base(self, params), input_ids, attention_mask, self.branch_at, remat=remat
         )
         values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
         ref_out = self.lm.forward_from_layer(
@@ -137,6 +155,92 @@ class CausalLMWithValueHead:
             out["attn_bias"],
             out["positions"],
             remat=remat,
+        )
+        return dict(
+            out, values=values, ref_logits=jax.lax.stop_gradient(ref_out["logits"])
+        )
+
+
+class Seq2SeqLMWithValueHead:
+    """Encoder-decoder policy + value head over decoder hidden states;
+    optional frozen top-decoder reference branch.
+
+    Parity: reference `AutoModelForSeq2SeqLMWith{Value,HydraValue}Head`
+    (modeling_ppo.py:1242-1480) + the frozen `T5Branch` (:1483-1592).
+    """
+
+    def __init__(self, cfg, branch_at: Optional[int] = None):
+        from trlx_tpu.models.seq2seq import T5LM
+
+        self.cfg = cfg
+        self.lm = T5LM(cfg)
+        self.branch_at = branch_at
+
+    def init_params(self, rng: jax.Array, base_params: Optional[Dict] = None) -> Dict:
+        r_base, r_head = jax.random.split(rng)
+        if base_params is None:
+            base_params = self.lm.init(r_base)
+        return {
+            "base": base_params,
+            "v_head": init_head(r_head, self.cfg.d_model, 1),
+        }
+
+    def make_ref_params(self, params: Dict) -> Dict:
+        from trlx_tpu.models.seq2seq import extract_t5_branch_params
+
+        if self.branch_at is not None:
+            return extract_t5_branch_params(params["base"], self.branch_at)
+        return jax.tree_util.tree_map(
+            jnp.copy, jax.lax.stop_gradient(params["base"])
+        )
+
+    def forward(
+        self,
+        params: Dict,
+        input_ids: Array,
+        attention_mask: Array,
+        decoder_input_ids: Array,
+        decoder_attention_mask: Optional[Array] = None,
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        out = self.lm(
+            params["base"], input_ids, attention_mask, decoder_input_ids,
+            decoder_attention_mask, remat=remat,
+        )
+        values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
+        return dict(out, values=values)
+
+    def forward_train(
+        self,
+        params: Dict,
+        ref_params: Dict,
+        input_ids: Array,
+        attention_mask: Array,
+        decoder_input_ids: Array,
+        decoder_attention_mask: Optional[Array] = None,
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        if self.branch_at is None:
+            out = self.forward(
+                params, input_ids, attention_mask, decoder_input_ids,
+                decoder_attention_mask, remat=remat,
+            )
+            ref_out = self.lm(
+                ref_params, input_ids, attention_mask, decoder_input_ids,
+                decoder_attention_mask,
+            )
+            return dict(out, ref_logits=jax.lax.stop_gradient(ref_out["logits"]))
+        out = self.lm.forward_with_branch_capture(
+            params["base"], input_ids, attention_mask, decoder_input_ids,
+            decoder_attention_mask, self.branch_at,
+        )
+        values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
+        ref_out = self.lm.forward_from_layer(
+            ref_params,
+            jax.lax.stop_gradient(out["branch_hidden"]),
+            out["self_bias"],
+            jax.lax.stop_gradient(out["encoder_hidden"]),
+            out["cross_bias"],
         )
         return dict(
             out, values=values, ref_logits=jax.lax.stop_gradient(ref_out["logits"])
@@ -181,7 +285,7 @@ class CausalLMWithILQLHeads:
         ILQL loss consumes (trlx_tpu.ops.ilql.ilql_loss)."""
         from trlx_tpu.ops.common import batched_index_select
 
-        out = self.lm(params["base"], input_ids, attention_mask, remat=remat)
+        out = self.lm(_effective_base(self, params), input_ids, attention_mask, remat=remat)
         qs, target_qs, vs = apply_ilql_heads(
             params["heads"], out["hidden_states"], states_ixs, actions_ixs
         )

@@ -138,6 +138,8 @@ class TPUBaseTrainer(BaseRLTrainer):
         """
         mc = self.config.model
         extra = mc.model_extra_configs or {}
+        if mc.model_arch_type == "seq2seq":
+            return self._load_seq2seq_base(mc, extra)
         native_cfg_fp = os.path.join(mc.model_path, "trlx_tpu_config.json")
         if os.path.isdir(mc.model_path) and os.path.exists(native_cfg_fp):
             # native checkpoint (orbax params + architecture json), the
@@ -167,6 +169,45 @@ class TPUBaseTrainer(BaseRLTrainer):
             params = TransformerLM(tcfg).init(key)
             return tcfg, params, extra.get("model_type")
         lm, params, model_type = load_pretrained(
+            mc.model_path, dtype=self.compute_dtype, param_dtype=self.param_dtype
+        )
+        self._hf_config_path = mc.model_path
+        return lm.cfg, params, model_type
+
+    def _load_seq2seq_base(self, mc, extra):
+        from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM
+
+        native_cfg_fp = os.path.join(mc.model_path, "trlx_tpu_config.json")
+        if os.path.isdir(mc.model_path) and os.path.exists(native_cfg_fp):
+            import orbax.checkpoint as ocp
+
+            with open(native_cfg_fp) as f:
+                meta = json.load(f)
+            scfg = Seq2SeqConfig(
+                dtype=self.compute_dtype, param_dtype=self.param_dtype,
+                **meta["seq2seq"],
+            )
+            params = ocp.PyTreeCheckpointer().restore(
+                os.path.join(os.path.abspath(mc.model_path), "params")
+            )
+            aux_dir = os.path.join(os.path.abspath(mc.model_path), "aux")
+            if os.path.isdir(aux_dir):
+                self._loaded_aux = ocp.PyTreeCheckpointer().restore(aux_dir)
+            return scfg, params, meta.get("model_type", "t5")
+        if mc.model_path == "random" or "seq2seq" in extra:
+            sdict = dict(extra.get("seq2seq", {}))
+            sdict.setdefault("vocab_size", getattr(self.tokenizer, "vocab_size", 258))
+            pad = getattr(self.tokenizer, "pad_token_id", None)
+            if pad is not None:
+                sdict.setdefault("decoder_start_token_id", int(pad))
+            scfg = Seq2SeqConfig(
+                dtype=self.compute_dtype, param_dtype=self.param_dtype, **sdict
+            )
+            self.rng, key = jax.random.split(self.rng)
+            return scfg, T5LM(scfg).init(key), extra.get("model_type", "t5")
+        from trlx_tpu.models.hf import load_pretrained_seq2seq
+
+        lm, params, model_type = load_pretrained_seq2seq(
             mc.model_path, dtype=self.compute_dtype, param_dtype=self.param_dtype
         )
         self._hf_config_path = mc.model_path
@@ -213,6 +254,55 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         return jax.tree_util.tree_map_with_path(mask_leaf, params)
 
+    def attach_lora(self, params: Dict) -> Dict:
+        """Add a LoRA overlay to a {"base": ...} params tree when
+        model.peft_config asks for one; sets the wrapper's merge scaling."""
+        from trlx_tpu.models.lora import init_lora_params, normalize_peft_config
+
+        pc = normalize_peft_config(self.config.model.peft_config)
+        if pc is None:
+            return params
+        self.rng, key = jax.random.split(self.rng)
+        params["lora"] = init_lora_params(key, params["base"], pc["r"], pc["targets"])
+        self.model.lora_scaling = pc["alpha"] / pc["r"]
+        return params
+
+    def lora_freeze_mask(self, params: Dict) -> Optional[Dict]:
+        """With LoRA: base frozen entirely, adapters + heads train."""
+        if "lora" not in params:
+            return None
+        mask = jax.tree_util.tree_map(lambda _: np.float32(1.0), params)
+        mask["base"] = jax.tree_util.tree_map(
+            lambda _: np.float32(0.0), params["base"]
+        )
+        return mask
+
+    def make_seq2seq_freeze_mask(self, params: Dict) -> Optional[Dict]:
+        """Seq2seq freeze: encoder + shared embedding + decoder rel-bias +
+        bottom decoder layers frozen; top decoder layers, final norm,
+        lm_head and aux heads train (parity: reference
+        freeze_bottom_seq2seq_layers, utils/modeling.py)."""
+        k = self.config.model.num_layers_unfrozen
+        if k is None or k < 0:
+            return None
+        n_dec = self.model.cfg.n_decoder_layer
+        at = max(n_dec - k, 0)
+        if at == 0:
+            return None
+        layer_mask = (jnp.arange(n_dec) >= at).astype(jnp.float32)
+
+        def mask_leaf(path, leaf):
+            keys = [getattr(p, "key", getattr(p, "idx", None)) for p in path]
+            if "encoder" in keys or "shared" in keys:
+                return np.float32(0.0)
+            if "rel_bias" in keys:
+                return np.float32(0.0)
+            if "blocks" in keys:
+                return layer_mask.reshape((n_dec,) + (1,) * (np.ndim(leaf) - 1))
+            return np.float32(1.0)
+
+        return jax.tree_util.tree_map_with_path(mask_leaf, params)
+
     # ------------------------------------------------------------------
     # data placement
     # ------------------------------------------------------------------
@@ -247,12 +337,27 @@ class TPUBaseTrainer(BaseRLTrainer):
         if key not in self._generate_fns:
             lm = self._lm()
             make_processor = self.generation_logits_processor
+            seq2seq = self.config.model.model_arch_type == "seq2seq"
+
+            model = self.model
 
             def fn(params, input_ids, attention_mask, rng):
+                from trlx_tpu.models.wrappers import _effective_base
+
                 # the processor is built from the LIVE param tree at trace
-                # time (ILQL shapes logits with its current Q/V heads)
+                # time (ILQL shapes logits with its current Q/V heads);
+                # _effective_base merges any LoRA overlay so sampling uses
+                # the ADAPTED policy, not the frozen base
+                base = _effective_base(model, params)
+                if seq2seq:
+                    from trlx_tpu.models.seq2seq import generate_seq2seq
+
+                    return generate_seq2seq(
+                        lm, base, input_ids, attention_mask, rng,
+                        settings, logits_processor=make_processor(params),
+                    )
                 return generate(
-                    lm, params["base"], input_ids, attention_mask, rng, settings,
+                    lm, base, input_ids, attention_mask, rng, settings,
                     logits_processor=make_processor(params),
                 )
 
@@ -678,8 +783,13 @@ class TPUBaseTrainer(BaseRLTrainer):
                 for k, v in dataclasses.asdict(self.model.cfg).items()
                 if k not in ("dtype", "param_dtype") and v is not None
             }
+            arch_key = (
+                "seq2seq"
+                if self.config.model.model_arch_type == "seq2seq"
+                else "transformer"
+            )
             with open(os.path.join(directory, "trlx_tpu_config.json"), "w") as f:
-                json.dump({"transformer": tcfg, "model_type": model_type}, f)
+                json.dump({arch_key: tcfg, "model_type": model_type}, f)
         if hasattr(self.tokenizer, "save_pretrained"):
             self.tokenizer.save_pretrained(directory)
 

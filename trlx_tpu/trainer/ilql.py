@@ -87,6 +87,10 @@ class TPUILQLTrainer(TPUBaseTrainer):
         self._sync_fn = None
 
     def setup_model(self) -> None:
+        if self.config.model.model_arch_type == "seq2seq":
+            raise NotImplementedError(
+                "seq2seq ILQL is not implemented yet (causal only)"
+            )
         cfg, base_params, self.model_type = self.load_base_model()
         method = self.config.method
         self.model = CausalLMWithILQLHeads(
@@ -103,10 +107,11 @@ class TPUILQLTrainer(TPUBaseTrainer):
                     heads[k] = [heads[k][i] for i in sorted(heads[k], key=int)]
             aux = dict(aux, heads=heads)
         params.update(aux)
+        params = self.attach_lora(params)
         self.params = shard_params(self.mesh, params)
 
     def trainable_mask(self):
-        mask = self.make_freeze_mask(self.params)
+        mask = self.lora_freeze_mask(self.params) or self.make_freeze_mask(self.params)
         if mask is None:
             # target heads only ever move through Polyak sync
             mask = jax.tree_util.tree_map(lambda _: np.float32(1.0), self.params)

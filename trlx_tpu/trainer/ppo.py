@@ -30,7 +30,7 @@ import numpy as np
 
 from trlx_tpu.data import PPORolloutBatch, PromptBatch
 from trlx_tpu.data.method_configs import PPOConfig
-from trlx_tpu.models.wrappers import CausalLMWithValueHead
+from trlx_tpu.models.wrappers import CausalLMWithValueHead, Seq2SeqLMWithValueHead
 from trlx_tpu.ops.common import (
     logprobs_of_labels,
     running_moments_init,
@@ -106,20 +106,42 @@ class TPUPPOTrainer(TPUBaseTrainer):
 
     def setup_model(self) -> None:
         cfg, base_params, self.model_type = self.load_base_model()
-        at = None
+        self.seq2seq = self.config.model.model_arch_type == "seq2seq"
         k = self.config.model.num_layers_unfrozen
-        if k is not None and 0 < k < cfg.n_layer:
-            at = cfg.n_layer - k
-        self.model = CausalLMWithValueHead(cfg, branch_at=at)
+        if self.config.model.peft_config is not None:
+            if self.seq2seq:
+                raise NotImplementedError(
+                    "peft_config with model_arch_type='seq2seq' is not supported yet"
+                )
+            # with adapters the reference model is the disabled-adapter
+            # base, not a hydra branch (reference peft contract)
+            k = -1
+        at = None
+        if self.seq2seq:
+            if k is not None and 0 < k < cfg.n_decoder_layer:
+                at = cfg.n_decoder_layer - k
+            self.model = Seq2SeqLMWithValueHead(cfg, branch_at=at)
+        else:
+            if k is not None and 0 < k < cfg.n_layer:
+                at = cfg.n_layer - k
+            self.model = CausalLMWithValueHead(cfg, branch_at=at)
         self.rng, key = jax.random.split(self.rng)
         params = self.model.init_params(key, base_params)
         params.update(getattr(self, "_loaded_aux", None) or {})
+        if not self.seq2seq:
+            params = self.attach_lora(params)
         self.params = shard_params(self.mesh, params)
         # frozen in-process reference: the top-k branch (hydra) or a full
-        # copy when everything is trainable (reference :74-77)
+        # copy when everything is trainable (reference :74-77); with LoRA
+        # the disabled-adapter base IS the reference (peft parity)
         self.ref_params = shard_params(self.mesh, self.model.make_ref_params(self.params))
 
     def trainable_mask(self):
+        lora_mask = self.lora_freeze_mask(self.params)
+        if lora_mask is not None:
+            return lora_mask
+        if self.seq2seq:
+            return self.make_seq2seq_freeze_mask(self.params)
         return self.make_freeze_mask(self.params)
 
     # -- loss ------------------------------------------------------------
@@ -131,18 +153,45 @@ class TPUPPOTrainer(TPUBaseTrainer):
         advantages, returns = gae_advantages_and_returns(
             batch.values, batch.rewards, gamma=method.gamma, lam=method.lam
         )
+        pad = self.generate_settings.pad_token_id
+        remat = self.config.train.remat_policy != "none"
+        if self.seq2seq:
+            # query = encoder prompt; response = decoder ids (start token
+            # + sampled tokens), parity: reference loss :146-173
+            dec = batch.response_tensors
+            enc_mask = (batch.query_tensors != pad).astype(jnp.int32)
+            dec_mask = jnp.concatenate(
+                [jnp.ones_like(dec[:, :1]), batch.response_mask.astype(jnp.int32)],
+                axis=1,
+            )
+            out = self.model.forward_train(
+                params, self.ref_params, batch.query_tensors, enc_mask, dec,
+                dec_mask, remat=remat,
+            )
+            logprobs = logprobs_of_labels(out["logits"][:, :-1], dec[:, 1:])
+            values_pred = out["values"][:, :-1]
+            return ppo_loss(
+                logprobs=logprobs,
+                values=values_pred,
+                old_logprobs=batch.logprobs,
+                old_values=batch.values,
+                advantages=advantages,
+                returns=returns,
+                mask=batch.response_mask,
+                cliprange=method.cliprange,
+                cliprange_value=method.cliprange_value,
+                vf_coef=method.vf_coef,
+            )
         P = batch.query_tensors.shape[1]
         N = batch.response_tensors.shape[1]
         tokens = jnp.concatenate([batch.query_tensors, batch.response_tensors], axis=1)
-        pad = self.generate_settings.pad_token_id
         attention_mask = (tokens != pad).astype(jnp.int32)
         # response positions count even where response==pad (mask handles it)
         attention_mask = attention_mask.at[:, P:].set(
             jnp.maximum(attention_mask[:, P:], batch.response_mask.astype(jnp.int32))
         )
         out = self.model.forward_train(
-            params, self.ref_params, tokens, attention_mask,
-            remat=self.config.train.remat_policy != "none",
+            params, self.ref_params, tokens, attention_mask, remat=remat,
         )
         logprobs = logprobs_of_labels(out["logits"][:, P - 1 : P + N - 1], tokens[:, P : P + N])
         values_pred = out["values"][:, P - 1 : P + N - 1]
@@ -168,6 +217,44 @@ class TPUPPOTrainer(TPUBaseTrainer):
         if key in self._experience_fns:
             return self._experience_fns[key]
         model = self.model
+
+        def seq2seq_fn(params, ref_params, enc_ids, enc_mask, dec_ids, response_mask, scores, scores_mask, kl_coef):
+            mask = response_mask.astype(jnp.float32)
+            dec_mask = jnp.concatenate(
+                [jnp.ones_like(dec_ids[:, :1]), response_mask.astype(jnp.int32)], axis=1
+            )
+            out = model.forward_train(params, ref_params, enc_ids, enc_mask, dec_ids, dec_mask)
+            logprobs = logprobs_of_labels(out["logits"][:, :-1], dec_ids[:, 1:]) * mask
+            ref_logprobs = logprobs_of_labels(out["ref_logits"][:, :-1], dec_ids[:, 1:]) * mask
+            log_ratio = logprobs - ref_logprobs
+            kl = jnp.exp(log_ratio) - 1 - log_ratio
+            mean_kl_per_token = kl.mean()
+            mean_kl = kl.sum(axis=1).mean()
+            values = out["values"][:, :-1] * mask
+
+            rewards = -kl_coef * log_ratio
+            if S == 1:
+                last = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+                rewards = rewards + scores[:, 0:1] * jax.nn.one_hot(last, N, dtype=rewards.dtype)
+            else:
+                padded = jnp.zeros_like(rewards)
+                padded = padded.at[:, :S].set(scores * scores_mask)
+                rewards = rewards + padded
+            rewards = rewards * mask
+
+            batch_out = PPORolloutBatch(
+                query_tensors=enc_ids,
+                response_tensors=dec_ids,
+                logprobs=logprobs,
+                values=values,
+                rewards=rewards,
+                response_mask=mask,
+            )
+            return batch_out, {"mean_kl": mean_kl, "mean_kl_per_token": mean_kl_per_token}
+
+        if self.seq2seq:
+            self._experience_fns[key] = jax.jit(seq2seq_fn)
+            return self._experience_fns[key]
 
         def fn(params, ref_params, tokens, attention_mask, response_mask, scores, scores_mask, kl_coef):
             out = model.forward_train(params, ref_params, tokens, attention_mask)
@@ -272,7 +359,11 @@ class TPUPPOTrainer(TPUBaseTrainer):
                     o = o[:N]
                     response_ids[i, : len(o)] = o
                     response_mask[i, : len(o)] = 1
-                sequences = np.concatenate([prompt_tensors, response_ids], axis=1)
+                if self.seq2seq:
+                    start = sequences[:, :1]  # decoder start token column
+                    sequences = np.concatenate([start, response_ids], axis=1)
+                else:
+                    sequences = np.concatenate([prompt_tensors, response_ids], axis=1)
 
             if method.cliprange_reward:
                 scores = np.clip(scores, -method.cliprange_reward, method.cliprange_reward)
@@ -294,10 +385,6 @@ class TPUPPOTrainer(TPUBaseTrainer):
             elif method.scale_reward == "ref":
                 scores /= max(self.ref_std, 1e-8)
 
-            attention_mask = np.concatenate(
-                [np.asarray(batch.attention_mask, np.int32), response_mask], axis=1
-            )
-
             # pad rows to the data-parallel multiple for sharding; the
             # extra rows are trimmed off the rollout batch afterwards
             B = len(sequences)
@@ -308,12 +395,25 @@ class TPUPPOTrainer(TPUBaseTrainer):
 
             exp_fn = self._get_experience_fn(P, N, S)
             sharding = data_sharding(self.mesh)
+            if self.seq2seq:
+                args = (
+                    rpad(prompt_tensors.astype(np.int32)),
+                    rpad(np.asarray(batch.attention_mask, np.int32)),
+                    rpad(sequences.astype(np.int32)),
+                )
+            else:
+                attention_mask = np.concatenate(
+                    [np.asarray(batch.attention_mask, np.int32), response_mask], axis=1
+                )
+                args = (
+                    rpad(sequences.astype(np.int32)),
+                    rpad(attention_mask),
+                )
             with self.mesh:
                 rollout_batch, kl_stats = exp_fn(
                     self.params,
                     self.ref_params,
-                    jax.device_put(rpad(sequences.astype(np.int32)), sharding),
-                    jax.device_put(rpad(attention_mask), sharding),
+                    *[jax.device_put(a, sharding) for a in args],
                     jax.device_put(rpad(response_mask), sharding),
                     jax.device_put(rpad(scores), sharding),
                     jax.device_put(rpad(scores_mask), sharding),

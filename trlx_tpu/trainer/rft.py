@@ -39,13 +39,16 @@ class TPURFTTrainer(TPUBaseTrainer):
         self.epoch_count = 0
 
     def setup_model(self) -> None:
+        if self.config.model.model_arch_type == "seq2seq":
+            raise NotImplementedError("seq2seq RFT is not implemented (causal only)")
         cfg, base_params, self.model_type = self.load_base_model()
         self.model = CausalLM(cfg)
         self.rng, key = jax.random.split(self.rng)
-        self.params = shard_params(self.mesh, self.model.init_params(key, base_params))
+        params = self.attach_lora(self.model.init_params(key, base_params))
+        self.params = shard_params(self.mesh, params)
 
     def trainable_mask(self):
-        return self.make_freeze_mask(self.params)
+        return self.lora_freeze_mask(self.params) or self.make_freeze_mask(self.params)
 
     def loss(self, params, batch: SFTBatch):
         # full-sequence LM loss: every non-pad token is a label (parity:
