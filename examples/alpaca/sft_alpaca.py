@@ -1,0 +1,56 @@
+"""Instruction SFT on Alpaca (parity:
+/root/reference/examples/alpaca/sft_alpaca.py)."""
+
+from typing import Dict, List
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_sft_config
+
+
+def preprocess(instruction: str, input_text: str, output: str):
+    """Alpaca prompt template -> (prompt, output) pair."""
+    if input_text:
+        prefix = (
+            "Below is an instruction that describes a task, paired with an input "
+            "that provides further context. Write a response that appropriately "
+            f"completes the request.\n\n### Instruction:\n{instruction}\n\n"
+            f"### Input:\n{input_text}\n\n### Response:\n"
+        )
+    else:
+        prefix = (
+            "Below is an instruction that describes a task. Write a response "
+            "that appropriately completes the request.\n\n### Instruction:\n"
+            f"{instruction}\n\n### Response:\n"
+        )
+    return (prefix, output)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(
+        default_sft_config().evolve(
+            train=dict(total_steps=2400, batch_size=16, seq_length=512,
+                       checkpoint_dir="ckpts/sft_alpaca"),
+        ).to_dict(),
+        hparams,
+    )
+
+    from datasets import load_dataset
+
+    alpaca = load_dataset("tatsu-lab/alpaca", split="train")
+    samples = [
+        preprocess(x["instruction"], x["input"], x["output"]) for x in alpaca
+    ]
+
+    return trlx_tpu.train(
+        samples=samples,
+        eval_prompts=[preprocess("Tell me a joke.", "", "")[0]] * 32,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

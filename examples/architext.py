@@ -1,0 +1,42 @@
+"""Toy PPO example: optimize textual interior designs toward the fewest
+rooms (parity: /root/reference/examples/architext.py)."""
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import default_ppo_config
+
+
+def reward_fn(samples, **kwargs):
+    "Gives a negative count of rooms for each sample"
+    return [-sample.count(":") for sample in samples]
+
+
+prompts = [
+    "[prompt] the bedroom is adjacent to the living room [layout]",
+    "[prompt] a bedroom is adjacent to the living room [layout]",
+    "[prompt] the bedroom is adjacent to the kitchen [layout]",
+    "[prompt] a bedroom is adjacent to the kitchen [layout]",
+    "[prompt] the kitchen is adjacent to the bathroom [layout]",
+    "[prompt] a bathroom is adjacent to the living room [layout]",
+    "[prompt] the bathroom is adjacent to the living room [layout]",
+    "[prompt] the bedroom is not adjacent to the living room [layout]",
+    "[prompt] a bedroom is not adjacent to the living room [layout]",
+    "[prompt] the bedroom is not adjacent to the kitchen [layout]",
+    "[prompt] the kitchen is not adjacent to the bathroom [layout]",
+]
+
+
+def main(hparams={}):
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.update(default_ppo_config().to_dict(), hparams)
+    return trlx_tpu.train(
+        model_path="architext/gptj-162M", reward_fn=reward_fn,
+        prompts=prompts, config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main({} if len(sys.argv) == 1 else json.loads(sys.argv[1]))

@@ -1,0 +1,151 @@
+"""Toy list-manipulation DSL + interpreter for grounded program synthesis
+(parity: /root/reference/examples/experiments/grounded_program_synthesis/lang.py
+— same task: given an input list and a target output, the model writes a
+DSL program; the reward grounds generated programs in the interpreter).
+
+The implementation is first-party: a recursive-descent parser over the
+`fn(arg, ...)` call syntax instead of the reference's token-template
+interpreter, and a depth-bounded random program sampler for the
+synthetic dataset.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+CONSTANTS = [-5, -4, -3, -2, -1, 1, 2, 3, 4, 5]
+
+DSL: Dict[str, Tuple[Callable, int]] = {
+    # name -> (fn, arity) where arity counts (list, int?) arguments
+    "take": (lambda xs, n: xs[:n], 2),
+    "drop": (lambda xs, n: xs[n:], 2),
+    "reverse": (lambda xs: xs[::-1], 1),
+    "sort_asc": (lambda xs: sorted(xs), 1),
+    "sort_des": (lambda xs: sorted(xs, reverse=True), 1),
+    "add_n": (lambda xs, n: [x + n for x in xs], 2),
+    "sub_n": (lambda xs, n: [x - n for x in xs], 2),
+    "mul_n": (lambda xs, n: [x * n for x in xs], 2),
+    "expand_copy": (lambda xs: xs + xs, 1),
+}
+
+
+class Interpreter:
+    """Evaluate programs like `add_n(reverse(x), 2)` against input `x`."""
+
+    def __call__(self, program: str, x: List[int]) -> Any:
+        self.text = program.strip()
+        self.pos = 0
+        self.x = x
+        try:
+            result = self._expr()
+            if self.pos != len(self.text):
+                return "ERROR"
+            return result
+        except Exception:
+            return "ERROR"
+
+    def _expr(self):
+        self._ws()
+        if self.text[self.pos] in "-0123456789":
+            start = self.pos
+            self.pos += 1
+            while self.pos < len(self.text) and self.text[self.pos].isdigit():
+                self.pos += 1
+            return int(self.text[start : self.pos])
+        start = self.pos
+        while self.pos < len(self.text) and (
+            self.text[self.pos].isalnum() or self.text[self.pos] == "_"
+        ):
+            self.pos += 1
+        name = self.text[start : self.pos]
+        if name == "x":
+            return list(self.x)
+        if name not in DSL:
+            raise ValueError(name)
+        fn, arity = DSL[name]
+        self._consume("(")
+        args = [self._expr()]
+        for _ in range(arity - 1):
+            self._consume(",")
+            args.append(self._expr())
+        self._consume(")")
+        return fn(*args)
+
+    def _ws(self):
+        while self.pos < len(self.text) and self.text[self.pos] == " ":
+            self.pos += 1
+
+    def _consume(self, ch: str):
+        self._ws()
+        if self.text[self.pos] != ch:
+            raise ValueError(f"expected {ch!r}")
+        self.pos += 1
+
+
+interpreter = Interpreter()
+
+
+def random_program(rng: random.Random, depth: int = 2) -> str:
+    """Sample a random composition of DSL calls applied to `x`."""
+    expr = "x"
+    for _ in range(rng.randint(1, depth)):
+        name = rng.choice(list(DSL))
+        _, arity = DSL[name]
+        if arity == 1:
+            expr = f"{name}({expr})"
+        else:
+            expr = f"{name}({expr},{rng.choice(CONSTANTS)})"
+    return expr
+
+
+def random_input(rng: random.Random, max_len: int = 5, value: int = 5) -> List[int]:
+    return [rng.randint(-value, value) for _ in range(rng.randint(2, max_len))]
+
+
+def create_synthetic_dataset(size: int, seed: int = 0) -> List[dict]:
+    """[{input, output, program}] with prompts in the reference's
+    'Input: ... Output: ... Function:' grounding format."""
+    rng = random.Random(seed)
+    out = []
+    while len(out) < size:
+        program = random_program(rng)
+        x = random_input(rng)
+        y = interpreter(program, x)
+        if y == "ERROR" or y == [] or y == x:
+            continue
+        out.append(
+            {
+                "input": x,
+                "output": y,
+                "program": program,
+                "prompt": f"Input: {x} Output: {y} Function:",
+                "completion": f" {program}",
+            }
+        )
+    return out
+
+
+def reward_fn(samples: List[str], prompts: List[str], outputs: List[str], **kwargs) -> List[float]:
+    """+1 exact functional match, partial credit for list overlap, -1 for
+    uninterpretable programs (grounding, parity with the reference's
+    reward shape)."""
+    rewards = []
+    for prompt, output in zip(prompts, outputs):
+        try:
+            x = eval(prompt.split("Input:")[1].split("Output:")[0].strip())
+            y = eval(prompt.split("Output:")[1].split("Function:")[0].strip())
+        except Exception:
+            rewards.append(-1.0)
+            continue
+        pred = interpreter(output.strip(), x)
+        if pred == "ERROR":
+            rewards.append(-1.0)
+        elif pred == y:
+            rewards.append(1.0)
+        elif isinstance(pred, list) and isinstance(y, list) and pred:
+            common = sum(1 for a, b in zip(pred, y) if a == b)
+            rewards.append(common / max(len(y), len(pred)) - 0.5)
+        else:
+            rewards.append(-0.5)
+    return rewards

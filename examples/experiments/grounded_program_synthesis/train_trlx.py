@@ -1,0 +1,77 @@
+"""PPO on the toy DSL program synthesis task (parity:
+/root/reference/examples/experiments/grounded_program_synthesis/train_trlx.py).
+Runs air-gapped: byte tokenizer + random-init model, with an SFT warmup
+on the synthetic dataset (standing in for the reference's pretrained
+codegen checkpoint)."""
+
+import trlx_tpu
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+from examples.experiments.grounded_program_synthesis.lang import (
+    create_synthetic_dataset,
+    reward_fn,
+)
+
+default_config = default_ppo_config().evolve(
+    train=dict(
+        seq_length=128,
+        batch_size=32,
+        epochs=100,
+        total_steps=2000,
+        checkpoint_dir="ckpts/program_synthesis",
+    ),
+    model=dict(
+        model_path="random",
+        num_layers_unfrozen=-1,
+        model_extra_configs={
+            "transformer": dict(hidden_size=192, n_layer=6, n_head=6, n_positions=256)
+        },
+    ),
+    tokenizer=dict(tokenizer_path="byte", truncation_side="right"),
+    method=dict(
+        num_rollouts=32, chunk_size=32,
+        gen_kwargs=dict(max_new_tokens=48, top_k=0, top_p=1.0, do_sample=True),
+    ),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+    dataset = create_synthetic_dataset(2000, seed=config.train.seed)
+
+    # SFT warmup on (prompt, program) pairs, then PPO against the interpreter
+    import os
+
+    from trlx_tpu.data.method_configs import SFTConfig
+
+    sft_dir = os.path.join(config.train.checkpoint_dir, "sft_warmup")
+    model_dir = os.path.join(sft_dir, "hf_model")
+    if not os.path.exists(os.path.join(model_dir, "trlx_tpu_config.json")):
+        sft_config = TRLConfig.from_dict(
+            dict(config.to_dict(), method=SFTConfig(name="sftconfig").to_dict())
+        ).evolve(
+            train=dict(trainer="TPUSFTTrainer", total_steps=500, epochs=20,
+                       eval_interval=1000, checkpoint_interval=1000,
+                       checkpoint_dir=sft_dir),
+        )
+        trainer = trlx_tpu.train(
+            samples=[(d["prompt"], d["completion"]) for d in dataset],
+            config=sft_config,
+        )
+        trainer.save_pretrained(model_dir)
+    config.model.model_path = model_dir
+
+    return trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=[d["prompt"] for d in dataset],
+        eval_prompts=[d["prompt"] for d in dataset[:64]],
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main({} if len(sys.argv) == 1 else json.loads(sys.argv[1]))

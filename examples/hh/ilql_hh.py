@@ -1,0 +1,58 @@
+"""ILQL GPT-J-6B on Anthropic HH (parity:
+/root/reference/examples/hh/ilql_hh.py): offline training on
+chosen/rejected pairs with +1/-1 rewards."""
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_ilql_config
+
+default_config = default_ilql_config().evolve(
+    train=dict(
+        seq_length=1024,
+        batch_size=32,
+        total_steps=20000,
+        checkpoint_interval=10000,
+        eval_interval=1000,
+        checkpoint_dir="ckpts/ilql_hh",
+        mesh={"dp": -1, "fsdp": 8, "tp": 1, "sp": 1},
+        compute_dtype="bfloat16",
+    ),
+    model=dict(model_path="EleutherAI/gpt-j-6B"),
+    tokenizer=dict(tokenizer_path="EleutherAI/gpt-j-6B", truncation_side="left"),
+    method=dict(
+        gen_kwargs=dict(max_new_tokens=128, top_k=20, beta=[1, 4], temperature=1.0)
+    ),
+)
+
+
+def preprocess(sample):
+    sample["prompt"] += "Assistant:"
+    return sample
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+
+    from datasets import load_dataset
+
+    dataset = load_dataset("Dahoas/full-hh-rlhf").map(preprocess)
+    samples, rewards = [], []
+    for x in dataset["train"]:
+        samples += [(x["prompt"], x["chosen"]), (x["prompt"], x["rejected"])]
+        rewards += [1.0, -1.0]
+    eval_prompts = [{"prompt": x["prompt"]} for x in dataset["test"]][:280]
+
+    return trlx_tpu.train(
+        samples=samples,
+        rewards=rewards,
+        eval_prompts=eval_prompts,
+        config=config,
+        stop_sequences=["Human:", "human:", "Assistant:", "assistant:"],
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,136 @@
+"""PPO GPT-J-6B on Anthropic HH (parity:
+/root/reference/examples/hh/ppo_hh.py). The reward model is served
+remotely — the reference uses a Triton gRPC client; here the client is
+transport-agnostic (HTTP JSON via HH_RM_URL, or an in-process HF reward
+model via HH_RM_PATH) since reward serving is host-side I/O, not TPU
+compute (SURVEY.md §2.8 last row).
+
+Scale preset: GPT-J-class fits a v3-32 with fsdp=8 (mesh_preset_6b_v3_32)
+— the counterpart of the reference's 7-train-GPU + 1-RM-GPU layout.
+"""
+
+import os
+from typing import List
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.method_configs import PPOConfig
+
+default_config = TRLConfig(
+    train=TrainConfig(
+        seq_length=1024,
+        epochs=10000,
+        total_steps=10000,
+        batch_size=32,
+        checkpoint_interval=10000,
+        eval_interval=500,
+        pipeline="PromptPipeline",
+        trainer="TPUPPOTrainer",
+        checkpoint_dir="ckpts/ppo_hh",
+        mesh={"dp": -1, "fsdp": 8, "tp": 1, "sp": 1},
+        compute_dtype="bfloat16",
+    ),
+    model=ModelConfig(model_path="EleutherAI/gpt-j-6B", num_layers_unfrozen=2),
+    tokenizer=TokenizerConfig(tokenizer_path="EleutherAI/gpt-j-6B", truncation_side="left"),
+    optimizer=OptimizerConfig(
+        name="adamw", kwargs=dict(lr=8e-6, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6)
+    ),
+    scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=8e-6)),
+    method=PPOConfig(
+        name="PPOConfig",
+        num_rollouts=64,
+        chunk_size=16,
+        ppo_epochs=4,
+        init_kl_coef=0.05,
+        target=6,
+        horizon=10000,
+        gamma=1,
+        lam=0.95,
+        cliprange=0.2,
+        cliprange_value=0.2,
+        vf_coef=1,
+        scale_reward="running",
+        ref_mean=None,
+        ref_std=None,
+        cliprange_reward=10,
+        gen_kwargs=dict(max_new_tokens=128, top_k=0, top_p=1.0, do_sample=True),
+    ),
+)
+
+
+def make_reward_fn():
+    """Remote (HTTP JSON) or local (HF torch) HH reward model."""
+    rm_url = os.environ.get("HH_RM_URL")
+    if rm_url:
+        import json
+        import urllib.request
+
+        def reward_fn(samples: List[str], **kwargs) -> List[float]:
+            req = urllib.request.Request(
+                rm_url,
+                data=json.dumps({"samples": samples}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                return json.load(resp)["rewards"]
+
+        return reward_fn
+
+    rm_path = os.environ.get("HH_RM_PATH", "Dahoas/gptj-rm-static")
+    import torch
+    from transformers import AutoModelForSequenceClassification, AutoTokenizer
+
+    rm_tokenizer = AutoTokenizer.from_pretrained(rm_path)
+    rm = AutoModelForSequenceClassification.from_pretrained(rm_path)
+    rm.eval()
+
+    @torch.no_grad()
+    def reward_fn(samples: List[str], **kwargs) -> List[float]:
+        out = []
+        for i in range(0, len(samples), 8):
+            enc = rm_tokenizer(
+                samples[i : i + 8], truncation=True, max_length=1024,
+                padding=True, return_tensors="pt",
+            )
+            out.extend(rm(**enc).logits[:, 0].tolist())
+        return out
+
+    return reward_fn
+
+
+def preprocess(sample):
+    sample["prompt"] += "Assistant:"
+    return sample
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+
+    from datasets import load_dataset
+
+    dataset = load_dataset("Dahoas/rm-static").map(preprocess)
+    prompts = [{"prompt": x["prompt"]} for x in dataset["train"]]
+    eval_prompts = [{"prompt": x["prompt"]} for x in dataset["test"]][:280]
+
+    return trlx_tpu.train(
+        reward_fn=make_reward_fn(),
+        prompts=prompts,
+        eval_prompts=eval_prompts,
+        config=config,
+        stop_sequences=["Human:", "human:", "Assistant:", "assistant:"],
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,51 @@
+"""SFT GPT-J-6B on Anthropic HH chosen responses (parity:
+/root/reference/examples/hh/sft_hh.py)."""
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_sft_config
+
+default_config = default_sft_config().evolve(
+    train=dict(
+        seq_length=1024,
+        batch_size=32,
+        total_steps=8000,
+        checkpoint_interval=10000,
+        eval_interval=1000,
+        checkpoint_dir="ckpts/sft_hh",
+        mesh={"dp": -1, "fsdp": 8, "tp": 1, "sp": 1},
+        compute_dtype="bfloat16",
+    ),
+    model=dict(model_path="EleutherAI/gpt-j-6B"),
+    tokenizer=dict(tokenizer_path="EleutherAI/gpt-j-6B", truncation_side="left"),
+    method=dict(gen_kwargs=dict(max_new_tokens=128, top_k=20, temperature=1.0)),
+)
+
+
+def preprocess(sample):
+    sample["prompt"] += "Assistant:"
+    return sample
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+
+    from datasets import load_dataset
+
+    dataset = load_dataset("Dahoas/full-hh-rlhf").map(preprocess)
+    samples = [(x["prompt"], x["chosen"]) for x in dataset["train"]]
+    eval_prompts = [{"prompt": x["prompt"]} for x in dataset["test"]][:280]
+
+    return trlx_tpu.train(
+        samples=samples,
+        eval_prompts=eval_prompts,
+        config=config,
+        stop_sequences=["Human:", "human:", "Assistant:", "assistant:"],
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

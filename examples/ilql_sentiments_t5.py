@@ -1,0 +1,60 @@
+"""ILQL on T5 for IMDB sentiment (parity:
+/root/reference/examples/ilql_sentiments_t5.py — the seq2seq offline
+path)."""
+
+from typing import Dict, List
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_ilql_config
+
+default_config = default_ilql_config().evolve(
+    train=dict(
+        batch_size=32, seq_length=128, checkpoint_dir="ckpts/ilql_sentiments_t5"
+    ),
+    model=dict(model_path="lvwerra/t5-imdb", model_arch_type="seq2seq"),
+    tokenizer=dict(tokenizer_path="lvwerra/t5-imdb", padding_side="right"),
+    method=dict(gen_kwargs=dict(max_new_tokens=56, top_k=20, beta=[1, 2], temperature=1.0)),
+)
+
+
+def get_positive_score(scores) -> float:
+    return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+
+    from datasets import load_dataset
+    from transformers import pipeline as hf_pipeline
+
+    sentiment_fn = hf_pipeline(
+        "sentiment-analysis", "lvwerra/distilbert-imdb", top_k=2,
+        truncation=True, batch_size=256,
+    )
+
+    def metric_fn(samples: List[str], **kwargs) -> Dict[str, List[float]]:
+        return {"sentiments": list(map(get_positive_score, sentiment_fn(samples)))}
+
+    imdb = load_dataset("imdb", split="train+test")
+    # split each review into a (prompt, continuation) pair for the
+    # encoder/decoder sides
+    samples = [
+        (" ".join(text.split()[:4]), " ".join(text.split()[4:64]))
+        for text in imdb["text"]
+    ]
+    rewards = metric_fn([p + " " + o for p, o in samples])["sentiments"]
+
+    return trlx_tpu.train(
+        samples=samples,
+        rewards=rewards,
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main({} if len(sys.argv) == 1 else json.loads(sys.argv[1]))

@@ -1,0 +1,58 @@
+"""PPO with DENSE (per-token) rewards on IMDB sentiment (parity:
+/root/reference/examples/ppo_dense_sentiments.py): the reward_fn returns a
+list of per-token reward deltas per sample instead of one scalar —
+exercising the dense path of the rollout engine."""
+
+from typing import List
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_ppo_config
+
+
+def get_positive_score(scores) -> float:
+    return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_ppo_config().to_dict(), hparams)
+
+    from datasets import load_dataset
+    from transformers import pipeline as hf_pipeline
+
+    sentiment_fn = hf_pipeline(
+        "sentiment-analysis", "lvwerra/distilbert-imdb", top_k=2,
+        truncation=True, batch_size=256,
+    )
+
+    def dense_reward_fn(samples: List[str], prompts: List[str], outputs: List[str],
+                        tokenizer=None, **kwargs) -> List[List[float]]:
+        # score the sample prefix ending at each output token; reward at
+        # token t is the delta of the sentiment score between prefixes
+        rewards = []
+        for prompt, output in zip(prompts, outputs):
+            tokens = tokenizer(output, add_special_tokens=False)["input_ids"]
+            prefixes = [
+                prompt + tokenizer.decode(tokens[: i + 1]) for i in range(len(tokens))
+            ]
+            scores = [get_positive_score(s) for s in sentiment_fn(prefixes)]
+            deltas = [scores[0]] + [b - a for a, b in zip(scores, scores[1:])]
+            rewards.append(deltas)
+        return rewards
+
+    imdb = load_dataset("imdb", split="train+test")
+    prompts = [" ".join(review.split()[:4]) for review in imdb["text"]]
+
+    return trlx_tpu.train(
+        reward_fn=dense_reward_fn,
+        prompts=prompts,
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

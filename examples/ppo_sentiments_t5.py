@@ -1,0 +1,96 @@
+"""PPO on T5 for IMDB review completion (parity:
+/root/reference/examples/ppo_sentiments_t5.py — the seq2seq PPO path)."""
+
+from typing import List
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.method_configs import PPOConfig
+
+default_config = TRLConfig(
+    train=TrainConfig(
+        seq_length=128,
+        epochs=100,
+        total_steps=100000,
+        batch_size=12,
+        checkpoint_interval=10000,
+        eval_interval=100,
+        pipeline="PromptPipeline",
+        trainer="TPUPPOTrainer",
+        save_best=False,
+        checkpoint_dir="ckpts/ppo_sentiments_t5",
+    ),
+    model=ModelConfig(
+        model_path="lvwerra/t5-imdb", num_layers_unfrozen=-1, model_arch_type="seq2seq"
+    ),
+    tokenizer=TokenizerConfig(
+        tokenizer_path="lvwerra/t5-imdb", padding_side="right", truncation_side="right"
+    ),
+    optimizer=OptimizerConfig(
+        name="adamw", kwargs=dict(lr=5.0e-5, betas=(0.9, 0.999), eps=1.0e-8, weight_decay=1.0e-6)
+    ),
+    scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=5.0e-5)),
+    method=PPOConfig(
+        name="PPOConfig",
+        num_rollouts=128,
+        chunk_size=12,
+        ppo_epochs=4,
+        init_kl_coef=0.05,
+        target=6,
+        horizon=10000,
+        gamma=0.99,
+        lam=0.95,
+        cliprange=0.2,
+        cliprange_value=0.2,
+        vf_coef=1.0,
+        scale_reward=None,
+        ref_mean=None,
+        ref_std=None,
+        cliprange_reward=10,
+        gen_kwargs=dict(max_new_tokens=64, do_sample=True, top_k=0, top_p=1.0),
+    ),
+)
+
+
+def get_positive_score(scores) -> float:
+    return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+
+    from datasets import load_dataset
+    from transformers import pipeline as hf_pipeline
+
+    sentiment_fn = hf_pipeline(
+        "sentiment-analysis", "lvwerra/distilbert-imdb", top_k=2,
+        truncation=True, batch_size=256,
+    )
+
+    def reward_fn(samples: List[str], **kwargs) -> List[float]:
+        return list(map(get_positive_score, sentiment_fn(samples)))
+
+    imdb = load_dataset("imdb", split="train+test")
+    prompts = [" ".join(review.split()[:4]) for review in imdb["text"]]
+
+    return trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,41 @@
+"""ILQL on prompt/aesthetic-rating pairs from simulacra-aesthetic-captions
+(parity: /root/reference/examples/simulacra.py)."""
+
+import os
+import sqlite3
+from urllib.request import urlretrieve
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import default_ilql_config
+
+URL = (
+    "https://raw.githubusercontent.com/JD-P/simulacra-aesthetic-captions/"
+    "main/sac_public_2022_06_29.sqlite"
+)
+DBPATH = "sac_public_2022_06_29.sqlite"
+
+
+def main():
+    if not os.path.exists(DBPATH):
+        print(f"fetching {DBPATH}")
+        urlretrieve(URL, DBPATH)
+
+    conn = sqlite3.connect(DBPATH)
+    c = conn.cursor()
+    c.execute(
+        "SELECT prompt, rating FROM ratings "
+        "JOIN images ON images.id=ratings.iid "
+        "JOIN generations ON images.gid=generations.id "
+        "WHERE rating IS NOT NULL;"
+    )
+    prompts, ratings = tuple(map(list, zip(*c.fetchall())))
+    return trlx_tpu.train(
+        config=default_ilql_config(),
+        samples=prompts,
+        rewards=ratings,
+        eval_prompts=["An astronaut riding a horse"] * 64,
+    )
+
+
+if __name__ == "__main__":
+    main()

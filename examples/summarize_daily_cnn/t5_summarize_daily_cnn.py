@@ -1,0 +1,96 @@
+"""PPO T5 summarization on CNN/DailyMail with a ROUGE reward (parity:
+/root/reference/examples/summarize_daily_cnn/t5_summarize_daily_cnn.py)."""
+
+from typing import List
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.method_configs import PPOConfig
+
+default_config = TRLConfig(
+    train=TrainConfig(
+        seq_length=612,
+        epochs=100,
+        total_steps=100000,
+        batch_size=12,
+        checkpoint_interval=10000,
+        eval_interval=500,
+        pipeline="PromptPipeline",
+        trainer="TPUPPOTrainer",
+        checkpoint_dir="ckpts/t5_summarize",
+    ),
+    model=ModelConfig(
+        model_path="google/flan-t5-large", model_arch_type="seq2seq",
+        num_layers_unfrozen=2,
+    ),
+    tokenizer=TokenizerConfig(
+        tokenizer_path="google/flan-t5-large", padding_side="right",
+        truncation_side="right",
+    ),
+    optimizer=OptimizerConfig(
+        name="adamw", kwargs=dict(lr=1.0e-5, betas=(0.9, 0.999), eps=1.0e-8, weight_decay=1.0e-6)
+    ),
+    scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=1.0e-6)),
+    method=PPOConfig(
+        name="PPOConfig",
+        num_rollouts=512,
+        chunk_size=12,
+        ppo_epochs=4,
+        init_kl_coef=0.05,
+        target=6,
+        horizon=10000,
+        gamma=0.99,
+        lam=0.95,
+        cliprange=0.2,
+        cliprange_value=0.2,
+        vf_coef=1.0,
+        scale_reward=None,
+        ref_mean=None,
+        ref_std=None,
+        cliprange_reward=10,
+        gen_kwargs=dict(max_new_tokens=100, do_sample=True, top_k=0, top_p=1.0),
+    ),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+
+    import evaluate
+    from datasets import load_dataset
+
+    rouge = evaluate.load("rouge")
+    dataset = load_dataset("cnn_dailymail", "3.0.0")
+    prompt_summary = {
+        ("Summarize: " + x["article"])[:2000]: x["highlights"]
+        for split in ("train", "validation")
+        for x in dataset[split]
+    }
+
+    def reward_fn(samples: List[str], prompts: List[str], outputs: List[str], **kwargs):
+        refs = [prompt_summary.get(p, "") for p in prompts]
+        scores = rouge.compute(
+            predictions=outputs, references=refs, use_aggregator=False
+        )["rouge1"]
+        return list(scores)
+
+    prompts = list(prompt_summary)[:20000]
+    eval_prompts = list(prompt_summary)[20000:20256]
+
+    return trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=eval_prompts, config=config
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main({} if len(sys.argv) == 1 else json.loads(sys.argv[1]))

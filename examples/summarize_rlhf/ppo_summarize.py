@@ -1,0 +1,104 @@
+"""Stage 3: PPO against the trained reward model on TL;DR (parity:
+/root/reference/examples/summarize_rlhf/trlx_gptj_text_summarization.py).
+Reward = RM(sample) - RM(original human summary for that prompt)."""
+
+import os
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_ppo_config
+
+default_config = default_ppo_config().evolve(
+    train=dict(
+        seq_length=550,
+        batch_size=16,
+        total_steps=100000,
+        eval_interval=200,
+        checkpoint_interval=1000,
+        checkpoint_dir="ckpts/ppo_summarize",
+        mesh={"dp": -1, "fsdp": 8, "tp": 1, "sp": 1},
+        compute_dtype="bfloat16",
+    ),
+    model=dict(
+        model_path="ckpts/sft_summarize/best_checkpoint/hf_model",
+        num_layers_unfrozen=8,
+    ),
+    tokenizer=dict(tokenizer_path="EleutherAI/gpt-j-6B", truncation_side="right"),
+    optimizer=dict(kwargs=dict(lr=5e-6, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01)),
+    method=dict(
+        num_rollouts=128,
+        chunk_size=16,
+        ppo_epochs=4,
+        init_kl_coef=0.1,
+        target=6,
+        horizon=10000,
+        cliprange_reward=10,
+        gen_kwargs=dict(max_new_tokens=50, do_sample=True, top_k=0, top_p=1.0),
+    ),
+)
+
+
+def make_rm_reward_fn(rm_dir: str, max_length: int = 550):
+    """Load the stage-2 reward model and score text on device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from examples.summarize_rlhf.reward_model.train_reward_model import rm_forward
+    from trlx_tpu.data.configs import TokenizerConfig
+    from trlx_tpu.models.hf import load_pretrained
+    from trlx_tpu.utils.tokenizers import load_tokenizer
+
+    sft_dir = default_config.model.model_path
+    lm, _, _ = load_pretrained(sft_dir)
+    params = ocp.PyTreeCheckpointer().restore(
+        os.path.join(os.path.abspath(rm_dir), "params")
+    )
+    tokenizer = load_tokenizer(TokenizerConfig(tokenizer_path=sft_dir))
+    score = jax.jit(lambda ids, mask: rm_forward(lm, params, ids, mask))
+
+    def rm_score(texts):
+        enc = tokenizer(list(texts), truncation=True, padding="max_length",
+                        max_length=max_length)
+        out = score(
+            jnp.asarray(enc["input_ids"], jnp.int32),
+            jnp.asarray(enc["attention_mask"], jnp.int32),
+        )
+        return np.asarray(out)
+
+    return rm_score
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+
+    from datasets import load_dataset
+
+    dataset = load_dataset("CarperAI/openai_summarize_tldr")
+    prompt_label = {
+        x["prompt"].strip(): x["label"] for split in ("train", "valid")
+        for x in dataset[split]
+    }
+    rm_score = make_rm_reward_fn(os.environ.get("RM_DIR", "ckpts/reward_model"))
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        # normalize against the human-written summary for the same prompt
+        originals = [
+            p.strip() + " " + prompt_label.get(p.strip(), "") for p in prompts
+        ]
+        return (rm_score(samples) - rm_score(originals)).tolist()
+
+    prompts = [x["prompt"] for x in dataset["train"]]
+    eval_prompts = [x["prompt"] for x in dataset["valid"]][:256]
+
+    return trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=eval_prompts, config=config
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

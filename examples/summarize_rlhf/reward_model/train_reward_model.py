@@ -1,0 +1,119 @@
+"""Stage 2: pairwise reward model for TL;DR (parity:
+/root/reference/examples/summarize_rlhf/reward_model/train_reward_model_gptj.py).
+
+A scalar head over the SFT model trained with the pairwise ranking loss
+-log sigmoid(r_chosen - r_rejected) on comparison data — built on the
+same trlx_tpu stack (jit + mesh + optax) rather than torch, so it runs
+on the same TPU slice as stages 1 and 3.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from trlx_tpu.data.configs import TokenizerConfig
+from trlx_tpu.models.heads import apply_head, init_head
+from trlx_tpu.models.hf import load_pretrained
+from trlx_tpu.parallel import data_sharding, make_mesh, shard_params
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.tokenizers import load_tokenizer
+
+logger = logging.get_logger(__name__)
+
+
+def rm_forward(lm, params, input_ids, attention_mask):
+    """Reward = scalar head on the last real token's hidden state."""
+    out = lm(params["base"], input_ids, attention_mask)
+    last = jnp.maximum(attention_mask.sum(axis=1) - 1, 0)
+    hidden = jnp.take_along_axis(
+        out["hidden_states"], last[:, None, None], axis=1
+    )[:, 0]
+    return apply_head(params["rm_head"], hidden)[:, 0]
+
+
+def pairwise_loss(lm, params, chosen, chosen_mask, rejected, rejected_mask):
+    r_chosen = rm_forward(lm, params, chosen, chosen_mask)
+    r_rejected = rm_forward(lm, params, rejected, rejected_mask)
+    loss = -jnp.mean(jax.nn.log_sigmoid(r_chosen - r_rejected))
+    acc = jnp.mean((r_chosen > r_rejected).astype(jnp.float32))
+    return loss, {"loss": loss, "acc": acc}
+
+
+def main(
+    model_path: str = "ckpts/sft_summarize/best_checkpoint/hf_model",
+    out_dir: str = "ckpts/reward_model",
+    max_length: int = 550,
+    batch_size: int = 8,
+    total_steps: int = 5000,
+    lr: float = 1e-5,
+):
+    from datasets import load_dataset
+
+    mesh = make_mesh()
+    tokenizer = load_tokenizer(TokenizerConfig(tokenizer_path=model_path))
+    lm, base_params, _ = load_pretrained(model_path)
+    rng = jax.random.PRNGKey(0)
+    params = {
+        "base": base_params,
+        "rm_head": init_head(rng, lm.cfg.hidden_size, 1),
+    }
+    with mesh:
+        params = shard_params(mesh, params)
+        tx = optax.adamw(lr)
+        opt_state = jax.jit(tx.init)(params)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        (loss, stats), grads = jax.value_and_grad(
+            lambda p: pairwise_loss(lm, p, *batch), has_aux=True
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, stats
+
+    dataset = load_dataset("CarperAI/openai_summarize_comparisons")["train"]
+
+    def encode(texts):
+        enc = tokenizer(list(texts), truncation=True, padding="max_length",
+                        max_length=max_length)
+        return (np.asarray(enc["input_ids"], np.int32),
+                np.asarray(enc["attention_mask"], np.int32))
+
+    sharding = data_sharding(mesh)
+    step = 0
+    while step < total_steps:
+        for start in range(0, len(dataset) - batch_size, batch_size):
+            rows = dataset[start : start + batch_size]
+            c_ids, c_mask = encode(p + s for p, s in zip(rows["prompt"], rows["chosen"]))
+            r_ids, r_mask = encode(p + s for p, s in zip(rows["prompt"], rows["rejected"]))
+            batch = tuple(
+                jax.device_put(x, sharding) for x in (c_ids, c_mask, r_ids, r_mask)
+            )
+            with mesh:
+                params, opt_state, stats = train_step(params, opt_state, batch)
+            step += 1
+            if step % 50 == 0:
+                logger.info("step %d loss %.4f acc %.3f", step,
+                            float(stats["loss"]), float(stats["acc"]))
+            if step >= total_steps:
+                break
+
+    os.makedirs(out_dir, exist_ok=True)
+    import orbax.checkpoint as ocp
+
+    ocp.PyTreeCheckpointer().save(
+        os.path.join(os.path.abspath(out_dir), "params"), jax.device_get(params),
+        force=True,
+    )
+    logger.info("reward model saved to %s", out_dir)
+
+
+if __name__ == "__main__":
+    kwargs = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(**kwargs)

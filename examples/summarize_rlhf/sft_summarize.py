@@ -1,0 +1,44 @@
+"""Stage 1 of the TL;DR summarize RLHF pipeline: SFT on human-written
+summaries (parity: /root/reference/examples/summarize_rlhf/ — the full
+SFT -> reward model -> PPO pipeline behind the reference's published
+TL;DR numbers, README.md:51-61)."""
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_sft_config
+
+default_config = default_sft_config().evolve(
+    train=dict(
+        seq_length=550,
+        batch_size=16,
+        total_steps=8000,
+        eval_interval=1000,
+        checkpoint_interval=2000,
+        checkpoint_dir="ckpts/sft_summarize",
+        mesh={"dp": -1, "fsdp": 8, "tp": 1, "sp": 1},
+        compute_dtype="bfloat16",
+    ),
+    model=dict(model_path="EleutherAI/gpt-j-6B"),
+    tokenizer=dict(tokenizer_path="EleutherAI/gpt-j-6B", truncation_side="right"),
+    optimizer=dict(kwargs=dict(lr=1e-5, betas=(0.9, 0.95), eps=1e-8, weight_decay=1e-6)),
+    method=dict(gen_kwargs=dict(max_new_tokens=50, do_sample=False)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+
+    from datasets import load_dataset
+
+    dataset = load_dataset("CarperAI/openai_summarize_tldr")
+    samples = [(x["prompt"], x["label"]) for x in dataset["train"]]
+    eval_prompts = [x["prompt"] for x in dataset["valid"]][:256]
+
+    return trlx_tpu.train(samples=samples, eval_prompts=eval_prompts, config=config)
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,92 @@
+"""MCTS decoding + value-branch tests (reference analogs: the Peach MCTS
+decoder in trlx/models/mcts.py and make_value_branch in modeling_ppo)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.mcts import mcts_generate
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.models.wrappers import CausalLMWithILQLHeads, CausalLMWithValueHead
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return TransformerConfig(
+        vocab_size=32, hidden_size=16, n_layer=3, n_head=2, n_positions=64,
+        dtype=jnp.float32,
+    )
+
+
+def test_multi_capture_matches_plain_forward(tiny_cfg):
+    lm = TransformerLM(tiny_cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 32)
+    plain = lm(params, ids)["logits"]
+    multi = lm.forward_with_multi_capture(params, ids, None, points=(1, 2))
+    np.testing.assert_allclose(
+        np.asarray(plain), np.asarray(multi["logits"]), atol=1e-5, rtol=1e-5
+    )
+    assert len(multi["captures"]) == 2
+
+
+def test_value_branch_forward_and_gradient(tiny_cfg):
+    model = CausalLMWithValueHead(tiny_cfg, branch_at=2, value_branch_at=1)
+    params = model.init_params(jax.random.PRNGKey(0))
+    assert "v_branch" in params
+    assert params["v_branch"]["blocks"]["ln_1"]["scale"].shape[0] == 2  # top 2 layers
+    ref = model.make_ref_params(params)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 32)
+    out = model.forward_train(params, ref, ids, None)
+    assert out["values"].shape == (2, 8)
+    np.testing.assert_allclose(
+        np.asarray(out["logits"]), np.asarray(out["ref_logits"]), atol=2e-3, rtol=2e-3
+    )
+
+    # gradient flows into the value branch
+    def loss(p):
+        return (model.forward(p, ids, None)["values"] ** 2).mean()
+
+    grads = jax.grad(loss)(params)
+    g = float(
+        sum(jnp.abs(x).sum() for x in jax.tree_util.tree_leaves(grads["v_branch"]))
+    )
+    assert g > 0
+    # but NOT into the base trunk via the value path beyond the fork? The
+    # trunk below the fork still feeds the branch input -> grads flow; the
+    # lm_head does not participate in the value path at all:
+    g_head = float(jnp.abs(grads["base"]["embed"]["wte"]).sum())
+    assert np.isfinite(g_head)
+
+
+def test_mcts_generate_shapes_and_determinism(tiny_cfg):
+    model = CausalLMWithILQLHeads(tiny_cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompts = np.asarray([[0, 0, 3, 4, 5], [1, 2, 3, 4, 5]], np.int32)
+    mask = np.asarray([[0, 0, 1, 1, 1], [1, 1, 1, 1, 1]], np.int32)
+    out1 = mcts_generate(
+        model, params, prompts, mask, max_new_tokens=3, num_simulations=8,
+        eos_token_id=31, pad_token_id=0,
+    )
+    out2 = mcts_generate(
+        model, params, prompts, mask, max_new_tokens=3, num_simulations=8,
+        eos_token_id=31, pad_token_id=0,
+    )
+    assert out1.shape == (2, 8)
+    np.testing.assert_array_equal(out1, out2)  # PUCT with argmax is deterministic
+    np.testing.assert_array_equal(out1[:, :5], prompts)
+
+
+def test_mcts_respects_logit_mask(tiny_cfg):
+    model = CausalLMWithILQLHeads(tiny_cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompts = np.asarray([[1, 2, 3]], np.int32)
+    # ban every token except 7
+    logit_mask = np.full((32,), -np.inf)
+    logit_mask[7] = 0.0
+    out = mcts_generate(
+        model, params, prompts, max_new_tokens=2, num_simulations=4,
+        pad_token_id=0, logit_mask=logit_mask,
+    )
+    assert (out[0, 3:5] == 7).all()

@@ -133,6 +133,33 @@ def test_ppo_seq2seq_learn(tmp_path):
     assert trainer.iter_count == 2
 
 
+@pytest.mark.slow
+def test_ilql_seq2seq_learn(tmp_path):
+    config = default_ilql_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=10, checkpoint_interval=10,
+            seq_length=16, epochs=2, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=dict(
+            model_path="random", model_arch_type="seq2seq",
+            model_extra_configs={
+                "seq2seq": dict(d_model=16, n_layer=2, n_head=2, d_kv=8, d_ff=32,
+                                relative_attention_num_buckets=8)
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            steps_for_target_q_sync=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0),
+        ),
+    )
+    samples = [("q", "good"), ("q", "bad"), ("p", "fine"), ("p", "meh")] * 4
+    rewards = [1.0, -1.0, 0.5, -0.5] * 4
+    trainer = trlx_tpu.train(samples=samples, rewards=rewards, config=config)
+    assert trainer.iter_count == 2
+
+
 def test_trainer_registry_aliases():
     from trlx_tpu.utils.loading import get_trainer
 

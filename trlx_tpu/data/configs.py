@@ -156,6 +156,13 @@ class TrainConfig(_Section):
     # Rematerialization policy for transformer blocks: "none" | "full" |
     # "dots_saveable" (NeMo selective-checkpointing parity).
     remat_policy: str = "none"
+    # When set, a jax.profiler trace of train steps [profile_start,
+    # profile_stop) is written here (the reference exposes Nsight knobs in
+    # its NeMo configs — megatron_20b.yaml:126-131; this is the XLA
+    # equivalent, viewable in TensorBoard / Perfetto).
+    profile_dir: Optional[str] = None
+    profile_start: int = 2
+    profile_stop: int = 5
 
 
 _SECTIONS: Tuple[Tuple[str, type], ...] = (

@@ -546,6 +546,55 @@ def state_dict_from_params(params: Dict, cfg: TransformerConfig, model_type: str
             out["lm_head.weight"] = out["model.embed_tokens.weight"]
         return out
 
+    if model_type == "gptj":
+        out["transformer.wte.weight"] = A(params["embed"]["wte"])
+        for i in range(cfg.n_layer):
+            b = f"transformer.h.{i}."
+            blk = {k: A_tree(v, i) for k, v in blocks.items()}
+            out[b + "ln_1.weight"] = blk["ln_1"]["scale"]
+            out[b + "ln_1.bias"] = blk["ln_1"]["bias"]
+            for ours, theirs in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")):
+                out[b + f"attn.{theirs}.weight"] = (
+                    blk["attn"][ours]["kernel"].reshape(E, H * D).T
+                )
+            out[b + "attn.out_proj.weight"] = blk["attn"]["o"]["kernel"].reshape(H * D, E).T
+            out[b + "mlp.fc_in.weight"] = blk["mlp"]["fc_in"]["kernel"].T
+            out[b + "mlp.fc_in.bias"] = blk["mlp"]["fc_in"]["bias"]
+            out[b + "mlp.fc_out.weight"] = blk["mlp"]["fc_out"]["kernel"].T
+            out[b + "mlp.fc_out.bias"] = blk["mlp"]["fc_out"]["bias"]
+        out["transformer.ln_f.weight"] = A(params["ln_f"]["scale"])
+        out["transformer.ln_f.bias"] = A(params["ln_f"]["bias"])
+        out["lm_head.weight"] = A(params["lm_head"]["kernel"]).T
+        out["lm_head.bias"] = np.zeros(cfg.vocab_size, np.float32)
+        return out
+
+    if model_type == "gpt_neox":
+        out["gpt_neox.embed_in.weight"] = A(params["embed"]["wte"])
+        for i in range(cfg.n_layer):
+            b = f"gpt_neox.layers.{i}."
+            blk = {k: A_tree(v, i) for k, v in blocks.items()}
+            out[b + "input_layernorm.weight"] = blk["ln_1"]["scale"]
+            out[b + "input_layernorm.bias"] = blk["ln_1"]["bias"]
+            # fused qkv [3E, E], interleaved per head: [H, 3, D, E]
+            w = np.stack(
+                [np.moveaxis(blk["attn"][n]["kernel"], 0, -1) for n in "qkv"], axis=1
+            )  # [H, 3, D, E]
+            out[b + "attention.query_key_value.weight"] = w.reshape(3 * E, E)
+            bias = np.stack([blk["attn"][n]["bias"] for n in "qkv"], axis=1)
+            out[b + "attention.query_key_value.bias"] = bias.reshape(3 * E)
+            out[b + "attention.dense.weight"] = blk["attn"]["o"]["kernel"].reshape(H * D, E).T
+            out[b + "attention.dense.bias"] = blk["attn"]["o"]["bias"]
+            out[b + "post_attention_layernorm.weight"] = blk["ln_2"]["scale"]
+            out[b + "post_attention_layernorm.bias"] = blk["ln_2"]["bias"]
+            out[b + "mlp.dense_h_to_4h.weight"] = blk["mlp"]["fc_in"]["kernel"].T
+            out[b + "mlp.dense_h_to_4h.bias"] = blk["mlp"]["fc_in"]["bias"]
+            out[b + "mlp.dense_4h_to_h.weight"] = blk["mlp"]["fc_out"]["kernel"].T
+            out[b + "mlp.dense_4h_to_h.bias"] = blk["mlp"]["fc_out"]["bias"]
+        out["gpt_neox.final_layer_norm.weight"] = A(params["ln_f"]["scale"])
+        out["gpt_neox.final_layer_norm.bias"] = A(params["ln_f"]["bias"])
+        out["embed_out.weight"] = A(params["lm_head"]["kernel"]).T
+        return out
+
     raise ValueError(f"export not implemented for {model_type!r}")
 
 

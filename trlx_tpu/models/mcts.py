@@ -1,0 +1,167 @@
+"""Token-level PUCT MCTS decoding guided by ILQL Q/V heads.
+
+Parity: /root/reference/trlx/models/mcts.py:7-218 (`Peach` / `MCTSNode`,
+fork-specific) — priors are softmax((log pi + beta*(minQ - V)) / temp) at
+each node, node value is V(s), actions are chosen by the PUCT rule and
+finally by root visit count.
+
+TPU split: the tree (visit counts, Q/W tables, children) lives on host —
+it is tiny, sequential bookkeeping — while every node evaluation is ONE
+jitted forward at a static width (sequences padded to prompt_len +
+max_new_tokens). The reference re-forwards the full prefix per node too
+(it deliberately never extends past_key_values inside the tree), so the
+compute shape matches while the host/device boundary is clean.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.models.heads import apply_head
+from trlx_tpu.models.wrappers import CausalLMWithILQLHeads, _effective_base
+
+
+class MCTSNode:
+    __slots__ = ("tokens", "parent", "action", "children", "N", "N_sa",
+                 "W_sa", "Q_sa", "P_sa", "is_terminal", "value")
+
+    def __init__(self, tokens: List[int], parent=None, action: Optional[int] = None):
+        self.tokens = tokens
+        self.parent = parent
+        self.action = action
+        self.children: Dict[int, "MCTSNode"] = {}
+        self.N = 0
+        self.N_sa = None
+        self.W_sa = None
+        self.Q_sa = None
+        self.P_sa = None
+        self.is_terminal = False
+        self.value = None
+
+    def select_action(self, c_puct: float) -> int:
+        sqrt_n = math.sqrt(self.N + 1e-8)
+        u = self.Q_sa + c_puct * self.P_sa * sqrt_n / (1 + self.N_sa)
+        return int(np.argmax(u))
+
+    def backup(self, value: float) -> None:
+        node = self
+        while node is not None:
+            node.N += 1
+            if node.parent is not None:
+                a = node.action
+                node.parent.N_sa[a] += 1
+                node.parent.W_sa[a] += value
+                node.parent.Q_sa[a] = node.parent.W_sa[a] / node.parent.N_sa[a]
+            node = node.parent
+
+
+def _make_eval_fn(model: CausalLMWithILQLHeads, width: int, beta: float, temperature: float):
+    """Jitted (params, ids[1,width], mask[1,width]) -> (priors[V], value)."""
+
+    def eval_fn(params, ids, mask):
+        base = _effective_base(model, params)
+        out = model.lm(base, ids, mask)
+        last = jnp.maximum(mask.sum(axis=1) - 1, 0)
+        hidden = jnp.take_along_axis(
+            out["hidden_states"], last[:, None, None], axis=1
+        )[:, 0]
+        logits = jnp.take_along_axis(out["logits"], last[:, None, None], axis=1)[:, 0]
+        heads = params["heads"]
+        qs = [apply_head(h, hidden) for h in heads["target_q_heads"]]
+        min_q = qs[0] if len(qs) == 1 else jnp.minimum(*qs)
+        v = apply_head(heads["v_head"], hidden)  # [1, 1]
+        adv = min_q - v
+        prior_logits = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1) + beta * adv
+        priors = jax.nn.softmax(prior_logits / max(temperature, 1e-6), axis=-1)
+        return priors[0], v[0, 0]
+
+    return jax.jit(eval_fn)
+
+
+def mcts_generate(
+    model: CausalLMWithILQLHeads,
+    params: Dict,
+    input_ids: np.ndarray,  # [B, P] (left-padded)
+    attention_mask: Optional[np.ndarray] = None,
+    beta: float = 1.0,
+    temperature: float = 1.0,
+    max_new_tokens: int = 32,
+    num_simulations: int = 50,
+    c_puct: float = 1.0,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    logit_mask: Optional[np.ndarray] = None,  # [V] additive, -inf = banned
+) -> np.ndarray:
+    """Decode each sample with PUCT MCTS; returns [B, P + max_new_tokens]."""
+    input_ids = np.asarray(input_ids, np.int32)
+    B, P = input_ids.shape
+    if attention_mask is None:
+        attention_mask = (input_ids != pad_token_id).astype(np.int32)
+    width = P + max_new_tokens
+    eval_fn = _make_eval_fn(model, width, beta, temperature)
+    add_mask = None
+    if logit_mask is not None:
+        add_mask = np.where(np.isfinite(np.asarray(logit_mask, np.float32)), 0.0, -np.inf)
+
+    def evaluate(node: MCTSNode) -> float:
+        if node.is_terminal:
+            return 0.0
+        ids = np.full((1, width), pad_token_id, np.int32)
+        mask = np.zeros((1, width), np.int32)
+        toks = node.tokens[:width]
+        ids[0, : len(toks)] = toks
+        mask[0, : len(toks)] = 1
+        priors, value = eval_fn(params, jnp.asarray(ids), jnp.asarray(mask))
+        priors = np.asarray(priors)
+        if add_mask is not None:
+            priors = priors * np.isfinite(add_mask)
+            priors = priors / max(priors.sum(), 1e-9)
+        node.P_sa = priors
+        vocab = priors.shape[0]
+        node.N_sa = np.zeros(vocab, np.int32)
+        node.W_sa = np.zeros(vocab, np.float32)
+        node.Q_sa = np.zeros(vocab, np.float32)
+        if eos_token_id is not None and toks and toks[-1] == eos_token_id:
+            node.is_terminal = True
+            node.value = 0.0
+        else:
+            node.value = float(value)
+        return node.value
+
+    samples = np.full((B, width), pad_token_id, np.int32)
+    samples[:, :P] = input_ids
+    for b in range(B):
+        prefix = [int(t) for t, m in zip(input_ids[b], attention_mask[b]) if m]
+        for step in range(max_new_tokens):
+            if eos_token_id is not None and prefix and prefix[-1] == eos_token_id:
+                break
+            root = MCTSNode(list(prefix))
+            evaluate(root)
+            for _ in range(num_simulations):
+                node = root
+                while not node.is_terminal:
+                    if node.N == 0:
+                        evaluate(node)
+                        break
+                    action = node.select_action(c_puct)
+                    child = node.children.get(action)
+                    if child is not None:
+                        node = child
+                        continue
+                    child = MCTSNode(node.tokens + [action], parent=node, action=action)
+                    node.children[action] = child
+                    evaluate(child)
+                    node = child
+                    break
+                node.backup(node.value if node.value is not None else 0.0)
+            best = int(np.argmax(root.N_sa))
+            prefix.append(best)
+        # write the decoded continuation right after the (left-padded) prompt
+        cont = prefix[int(attention_mask[b].sum()):]
+        samples[b, P : P + len(cont)] = cont[:max_new_tokens]
+    return samples

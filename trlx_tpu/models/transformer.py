@@ -71,6 +71,9 @@ class TransformerConfig:
     # numerics
     dtype: Any = jnp.bfloat16  # compute dtype inside blocks
     param_dtype: Any = jnp.float32
+    # "xla" (let the compiler fuse) | "pallas" (first-party fused kernel
+    # for full teacher-forced forwards; decode steps always use XLA)
+    attention_impl: str = "xla"
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -164,6 +167,7 @@ class Attention(nn.Module):
         attn_bias: Array,  # [B, 1, T, S] additive fp32
         positions: Array,  # [B, T] absolute positions (for rope)
         cache: Optional[Dict[str, Array]] = None,  # {"k","v"}: [B, S, Hkv, D], "index"
+        key_mask: Optional[Array] = None,  # [B, T]; enables the pallas path
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
         cfg = self.cfg
         B, T, E = x.shape
@@ -203,14 +207,24 @@ class Attention(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        scale = 1.0 / math.sqrt(D)
-        # [B, H, T, S]; accumulate scores in fp32 for stability
-        scores = jnp.einsum(
-            "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
-        ) * scale
-        scores = scores + attn_bias
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        if cfg.attention_impl == "pallas" and cache is None and key_mask is not None:
+            from trlx_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                key_mask,
+            ).transpose(0, 2, 1, 3)
+        else:
+            scale = 1.0 / math.sqrt(D)
+            # [B, H, T, S]; accumulate scores in fp32 for stability
+            scores = jnp.einsum(
+                "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+            ) * scale
+            scores = scores + attn_bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhts,bshd->bthd", probs, v)
 
         proj = nn.DenseGeneral(
             features=E,
@@ -266,10 +280,13 @@ class Block(nn.Module):
         attn_bias: Array,
         positions: Array,
         cache: Optional[Dict[str, Array]] = None,
+        key_mask: Optional[Array] = None,
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
         cfg = self.cfg
         h = Norm(cfg, name="ln_1")(x)
-        attn_out, new_kv = Attention(cfg, name="attn")(h, attn_bias, positions, cache)
+        attn_out, new_kv = Attention(cfg, name="attn")(
+            h, attn_bias, positions, cache, key_mask
+        )
         if cfg.parallel_residual:
             x = x + attn_out + MLP(cfg, name="mlp")(h)
         else:
@@ -401,6 +418,7 @@ class TransformerLM:
         positions: Array,
         cache: Optional[Dict[str, Array]] = None,
         remat: bool = False,
+        key_mask: Optional[Array] = None,
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
         """lax.scan over the stacked layer params (and cache layers)."""
 
@@ -411,7 +429,7 @@ class TransformerLM:
             else:
                 lp, layer_cache = layer, None
             out, new_kv = self.block.apply(
-                {"params": lp}, hidden, attn_bias, positions, layer_cache
+                {"params": lp}, hidden, attn_bias, positions, layer_cache, key_mask
             )
             return out, new_kv
 
@@ -465,7 +483,8 @@ class TransformerLM:
 
         h = self.embed.apply({"params": params["embed"]}, input_ids, positions)
         h, new_cache = self._scan_blocks(
-            params["blocks"], h, bias, positions, layer_cache, remat=remat
+            params["blocks"], h, bias, positions, layer_cache, remat=remat,
+            key_mask=None if cache is not None else attention_mask,
         )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
         logits = self._logits(params, hidden)
@@ -506,14 +525,61 @@ class TransformerLM:
 
         bottom = jax.tree_util.tree_map(lambda x: x[:branch_at], params["blocks"])
         top = jax.tree_util.tree_map(lambda x: x[branch_at:], params["blocks"])
-        h_branch, _ = self._scan_blocks(bottom, h, bias, positions, remat=remat)
-        h_top, _ = self._scan_blocks(top, h_branch, bias, positions, remat=remat)
+        h_branch, _ = self._scan_blocks(
+            bottom, h, bias, positions, remat=remat, key_mask=attention_mask
+        )
+        h_top, _ = self._scan_blocks(
+            top, h_branch, bias, positions, remat=remat, key_mask=attention_mask
+        )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h_top)
         logits = self._logits(params, hidden)
         return {
             "logits": logits,
             "hidden_states": hidden,
             "branch_hidden": h_branch,
+            "positions": positions,
+            "attn_bias": bias,
+        }
+
+    def forward_with_multi_capture(
+        self,
+        params: Dict,
+        input_ids: Array,
+        attention_mask: Optional[Array],
+        points: Tuple[int, ...],
+        remat: bool = False,
+    ) -> Dict[str, Array]:
+        """Forward capturing the hidden state entering each layer index in
+        `points` (sorted ascending). Generalizes branch capture so the
+        hydra reference branch and the trainable value branch
+        (reference make_value_branch, modeling_ppo.py:255-263) can fork at
+        different depths in ONE trunk pass."""
+        B, T = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, T), jnp.int32)
+        positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+        bias = make_attention_bias(attention_mask, jnp.arange(T), jnp.arange(T))
+        h = self.embed.apply({"params": params["embed"]}, input_ids, positions)
+
+        captures = []
+        prev = 0
+        for point in tuple(points) + (self.cfg.n_layer,):
+            if point > prev:
+                seg = jax.tree_util.tree_map(
+                    lambda x: x[prev:point], params["blocks"]
+                )
+                h, _ = self._scan_blocks(
+                    seg, h, bias, positions, remat=remat, key_mask=attention_mask
+                )
+            if point < self.cfg.n_layer:
+                captures.append(h)
+            prev = point
+        hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
+        logits = self._logits(params, hidden)
+        return {
+            "logits": logits,
+            "hidden_states": hidden,
+            "captures": captures,
             "positions": positions,
             "attn_bias": bias,
         }

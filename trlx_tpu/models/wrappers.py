@@ -85,10 +85,19 @@ class CausalLMWithValueHead:
     the trainer keeps that copy and calls `forward_ref_full`.
     """
 
-    def __init__(self, cfg: TransformerConfig, branch_at: Optional[int] = None):
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        branch_at: Optional[int] = None,
+        value_branch_at: Optional[int] = None,
+    ):
         self.cfg = cfg
         self.lm = TransformerLM(cfg)
         self.branch_at = branch_at
+        # value branch: a separate TRAINABLE copy of the top layers feeding
+        # the value head (reference make_value_branch /
+        # num_value_layers_unfrozen, modeling_ppo.py:255-263)
+        self.value_branch_at = value_branch_at
 
     # -- params ----------------------------------------------------------
 
@@ -96,10 +105,33 @@ class CausalLMWithValueHead:
         r_base, r_head = jax.random.split(rng)
         if base_params is None:
             base_params = self.lm.init(r_base)
-        return {
+        params = {
             "base": base_params,
             "v_head": init_head(r_head, self.cfg.hidden_size, 1),
         }
+        if self.value_branch_at is not None:
+            params["v_branch"] = jax.tree_util.tree_map(
+                jnp.copy,
+                {
+                    "blocks": jax.tree_util.tree_map(
+                        lambda x: x[self.value_branch_at :], base_params["blocks"]
+                    ),
+                    "ln_f": base_params["ln_f"],
+                },
+            )
+        return params
+
+    def _values(self, params: Dict, out: Dict) -> Array:
+        """Value head input: final hidden, or the value branch re-run from
+        its captured fork point."""
+        if self.value_branch_at is None:
+            return apply_head(params["v_head"], out["hidden_states"])[..., 0]
+        h = out["v_branch_hidden"]
+        h, _ = self.lm._scan_blocks(
+            params["v_branch"]["blocks"], h, out["attn_bias"], out["positions"]
+        )
+        hidden = self.lm.ln_f.apply({"params": params["v_branch"]["ln_f"]}, h)
+        return apply_head(params["v_head"], hidden)[..., 0]
 
     def make_ref_params(self, params: Dict) -> Dict:
         """Frozen reference: the top branch only (hydra) or the full tree.
@@ -114,6 +146,28 @@ class CausalLMWithValueHead:
 
     # -- forwards --------------------------------------------------------
 
+    def _capture_points(self):
+        points = set()
+        if self.branch_at is not None:
+            points.add(self.branch_at)
+        if self.value_branch_at is not None:
+            points.add(self.value_branch_at)
+        return tuple(sorted(points))
+
+    def _multi_forward(self, params, input_ids, attention_mask, remat):
+        """Trunk pass capturing hydra and/or value-branch fork hiddens."""
+        base = _effective_base(self, params)
+        points = self._capture_points()
+        out = self.lm.forward_with_multi_capture(
+            base, input_ids, attention_mask, points, remat=remat
+        )
+        named = dict(zip(points, out["captures"]))
+        if self.branch_at is not None:
+            out["branch_hidden"] = named[self.branch_at]
+        if self.value_branch_at is not None:
+            out["v_branch_hidden"] = named[self.value_branch_at]
+        return out
+
     def forward(
         self,
         params: Dict,
@@ -121,9 +175,13 @@ class CausalLMWithValueHead:
         attention_mask: Optional[Array] = None,
         remat: bool = False,
     ) -> Dict[str, Array]:
-        out = self.lm(_effective_base(self, params), input_ids, attention_mask, remat=remat)
-        values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
-        return dict(out, values=values)
+        if self.value_branch_at is None:
+            out = self.lm(
+                _effective_base(self, params), input_ids, attention_mask, remat=remat
+            )
+        else:
+            out = self._multi_forward(params, input_ids, attention_mask, remat)
+        return dict(out, values=self._values(params, out))
 
     def forward_train(
         self,
@@ -145,10 +203,7 @@ class CausalLMWithValueHead:
             ref_out = self.lm(ref_params, input_ids, attention_mask, remat=remat)
             return dict(out, ref_logits=jax.lax.stop_gradient(ref_out["logits"]))
 
-        out = self.lm.forward_with_branch_capture(
-            _effective_base(self, params), input_ids, attention_mask, self.branch_at, remat=remat
-        )
-        values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
+        out = self._multi_forward(params, input_ids, attention_mask, remat)
         ref_out = self.lm.forward_from_layer(
             ref_params,
             jax.lax.stop_gradient(out["branch_hidden"]),
@@ -157,7 +212,9 @@ class CausalLMWithValueHead:
             remat=remat,
         )
         return dict(
-            out, values=values, ref_logits=jax.lax.stop_gradient(ref_out["logits"])
+            out,
+            values=self._values(params, out),
+            ref_logits=jax.lax.stop_gradient(ref_out["logits"]),
         )
 
 
@@ -245,6 +302,71 @@ class Seq2SeqLMWithValueHead:
         return dict(
             out, values=values, ref_logits=jax.lax.stop_gradient(ref_out["logits"])
         )
+
+
+class Seq2SeqLMWithILQLHeads:
+    """Encoder-decoder LM + ILQL head group over DECODER hidden states
+    (parity: reference AutoModelForSeq2SeqLMWithILQLHeads,
+    modeling_ilql.py:481-666)."""
+
+    def __init__(self, cfg, two_qs: bool = True, alpha: float = 0.001):
+        from trlx_tpu.models.seq2seq import T5LM
+
+        self.cfg = cfg
+        self.lm = T5LM(cfg)
+        self.two_qs = two_qs
+        self.alpha = alpha
+
+    def init_params(self, rng: jax.Array, base_params: Optional[Dict] = None) -> Dict:
+        r_base, r_heads = jax.random.split(rng)
+        if base_params is None:
+            base_params = self.lm.init(r_base)
+        return {
+            "base": base_params,
+            "heads": init_ilql_heads(
+                r_heads, self.cfg.d_model, self.cfg.vocab_size, self.two_qs
+            ),
+        }
+
+    def forward(
+        self,
+        params: Dict,
+        input_ids: Array,
+        attention_mask: Array,
+        decoder_input_ids: Array,
+        states_ixs: Array,
+        actions_ixs: Array,
+        remat: bool = False,
+    ) -> Tuple[Array, Tuple]:
+        from trlx_tpu.ops.common import batched_index_select
+
+        out = self.lm(
+            _effective_base(self, params), input_ids, attention_mask,
+            decoder_input_ids, remat=remat,
+        )
+        qs, target_qs, vs = apply_ilql_heads(
+            params["heads"], out["hidden_states"], states_ixs, actions_ixs
+        )
+        logits_at_actions = batched_index_select(out["logits"], actions_ixs, dim=1)
+        return logits_at_actions, (qs, target_qs, vs)
+
+    def sync_target(self, params: Dict, alpha: Optional[float] = None) -> Dict:
+        return dict(
+            params,
+            heads=sync_target_q_heads(
+                params["heads"], self.alpha if alpha is None else alpha
+            ),
+        )
+
+    def make_logits_processor(self, params_heads: Dict, beta: float):
+        from trlx_tpu.ops.ilql import ilql_shape_logits
+
+        def processor(hidden_last: Array, logits_last: Array) -> Array:
+            qs = [apply_head(h, hidden_last) for h in params_heads["target_q_heads"]]
+            vs = apply_head(params_heads["v_head"], hidden_last)
+            return ilql_shape_logits(logits_last, qs, vs, beta)
+
+        return processor
 
 
 class CausalLMWithILQLHeads:

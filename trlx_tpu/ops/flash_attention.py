@@ -1,0 +1,136 @@
+"""Pallas fused causal attention for TPU.
+
+The reference leans on flash/fused attention inside its native deps
+(SURVEY.md §2.9 last row — NeMo/HF kernels). Here the fused kernel is
+first-party Pallas: per (batch*head, q-block) grid cell the scores
+[Bq, S] live only in VMEM — the [B, H, T, S] probability tensor never
+touches HBM, which is the HBM-bandwidth win on TPU (the MXU does the two
+matmuls back to back from VMEM).
+
+Gradient story: the kernel carries a `jax.custom_vjp` whose backward
+recomputes attention with plain XLA ops and differentiates that — the
+training step pays the same FLOPs as the XLA path while every no-grad
+forward (rollout generation prefill, the experience-scoring forward,
+evaluation) runs the fused kernel. Enable with
+`TransformerConfig(attention_impl="pallas")`; CPU tests run the kernel
+in interpreter mode automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attention_reference(q, k, v, key_mask, causal: bool, sm_scale: float):
+    """Plain XLA attention (backward-pass recompute + numerics oracle)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    T, S = s.shape[-2], s.shape[-1]
+    if causal:
+        qi = jnp.arange(T)[:, None] + (S - T)
+        s = jnp.where(qi >= jnp.arange(S)[None, :], s, NEG_INF)
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :] > 0, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, sm_scale, causal, q_offset):
+    q = q_ref[0].astype(jnp.float32)  # [Bq, D]
+    k = k_ref[0].astype(jnp.float32)  # [S, D]
+    v = v_ref[0].astype(jnp.float32)  # [S, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale  # [Bq, S]
+
+    Bq, S = s.shape
+    qi = pl.program_id(1)
+    if causal:
+        rows = qi * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, S), 0) + q_offset
+        cols = jax.lax.broadcasted_iota(jnp.int32, (Bq, S), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    mask = mask_ref[0]  # [S]
+    s = jnp.where(mask[None, :] > 0, s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) / jnp.maximum(l, 1e-30)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, key_mask, causal: bool, sm_scale: float, block_q: int):
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    if key_mask is None:
+        key_mask = jnp.ones((B, S), jnp.int32)
+    bq = min(block_q, T)
+    while T % bq:
+        bq //= 2
+    grid = (B * H, T // bq)
+
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal, q_offset=S - T
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S), lambda bh, qi: (bh // H, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=jax.default_backend() == "cpu",
+    )(qr, kr, vr, key_mask.astype(jnp.int32))
+    return out.reshape(B, H, T, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q, k, v, key_mask, causal=True, sm_scale=None, block_q=128):
+    """Fused attention. q/k/v: [B, H, T|S, D]; key_mask: [B, S] (1=real).
+
+    Causality compares PHYSICAL slots with queries right-aligned against
+    keys (q_offset = S - T), matching the transformer's slot semantics.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q)
+
+
+def _fwd(q, k, v, key_mask, causal, sm_scale, block_q):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    out = _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q)
+    return out, (q, k, v, key_mask)
+
+
+def _bwd(causal, sm_scale, block_q, res, g):
+    q, k, v, key_mask = res
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_reference(q_, k_, v_, key_mask, causal, sm_scale),
+        q, k, v,
+    )
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+flash_attention.defvjp(_fwd, _bwd)

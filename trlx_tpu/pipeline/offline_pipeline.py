@@ -254,6 +254,65 @@ class ILQLRolloutStorage(BaseRolloutStore):
         )
 
 
+class ILQLSeq2SeqRolloutStorage(BaseRolloutStore):
+    """Offline ILQL dataset for encoder-decoder models: encoder prompt +
+    decoder output tokens with indices over DECODER positions (parity:
+    reference offline_pipeline.py:243-289)."""
+
+    def __init__(self, input_ids, attention_mask, decoder_input_ids, rewards,
+                 states_ixs, actions_ixs, dones):
+        super().__init__()
+        self.fields = dict(
+            input_ids=input_ids,
+            attention_mask=attention_mask,
+            decoder_input_ids=decoder_input_ids,
+            rewards=rewards,
+            states_ixs=states_ixs,
+            actions_ixs=actions_ixs,
+            dones=dones,
+        )
+        self.history = input_ids
+        self.enc_width = max(len(x) for x in input_ids)
+        self.dec_width = max(len(x) for x in decoder_input_ids)
+        self.actions_width = max(len(x) for x in actions_ixs)
+        self.states_width = max(len(x) for x in states_ixs)
+
+    def push(self, exps):
+        raise NotImplementedError("ILQL storage is built once from offline data")
+
+    def __getitem__(self, ix: int):
+        return {k: v[ix] for k, v in self.fields.items()}
+
+    def __len__(self) -> int:
+        return len(self.history)
+
+    def collate(self, elems):
+        from trlx_tpu.data import ILQLSeq2SeqBatch
+
+        ids, _ = _pad_right([e["input_ids"] for e in elems], self.enc_width, 0)
+        mask, _ = _pad_right([e["attention_mask"] for e in elems], self.enc_width, 0)
+        dec, _ = _pad_right([e["decoder_input_ids"] for e in elems], self.dec_width, 0)
+        rewards, _ = _pad_right([e["rewards"] for e in elems], self.actions_width, 0.0)
+        actions, _ = _pad_right([e["actions_ixs"] for e in elems], self.actions_width, None, repeat_last=True)
+        states, _ = _pad_right([e["states_ixs"] for e in elems], self.states_width, None, repeat_last=True)
+        dones, _ = _pad_right([e["dones"] for e in elems], self.states_width, 0)
+        return ILQLSeq2SeqBatch(
+            input_ids=np.asarray(ids, np.int32),
+            attention_mask=np.asarray(mask, np.int32),
+            decoder_input_ids=np.asarray(dec, np.int32),
+            rewards=np.asarray(rewards, np.float32),
+            states_ixs=np.asarray(states, np.int32),
+            actions_ixs=np.asarray(actions, np.int32),
+            dones=np.asarray(dones, np.int32),
+        )
+
+    def create_loader(self, batch_size: int, shuffle: bool = True, drop_last: bool = True, seed: int = 0) -> DataLoader:
+        return DataLoader(
+            self, batch_size, collate_fn=self.collate, shuffle=shuffle,
+            drop_last=drop_last, seed=seed,
+        )
+
+
 # ---------------------------------------------------------------------------
 # padding helpers
 # ---------------------------------------------------------------------------

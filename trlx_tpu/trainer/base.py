@@ -246,6 +246,8 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         def mask_leaf(path, leaf):
             keys = [getattr(p, "key", getattr(p, "idx", None)) for p in path]
+            if "v_branch" in keys or "lora" in keys:
+                return np.float32(1.0)  # branches/adapters always train
             if "blocks" in keys:
                 return layer_mask.reshape((n_layer,) + (1,) * (np.ndim(leaf) - 1))
             if "embed" in keys:
@@ -634,6 +636,11 @@ class TPUBaseTrainer(BaseRLTrainer):
             for _ in range(self.n_inner_epochs):
                 train_dataloader = self.create_train_dataloader()
                 for batch in train_dataloader:
+                    if self.config.train.profile_dir is not None:
+                        if self.iter_count == self.config.train.profile_start:
+                            jax.profiler.start_trace(self.config.train.profile_dir)
+                        elif self.iter_count == self.config.train.profile_stop:
+                            jax.profiler.stop_trace()
                     device_batch = self.place_batch(batch)
                     forward_time = clock.tick()
                     with self.mesh:

@@ -16,7 +16,7 @@ import numpy as np
 
 from trlx_tpu.data import ILQLBatch
 from trlx_tpu.data.method_configs import ILQLConfig
-from trlx_tpu.models.wrappers import CausalLMWithILQLHeads
+from trlx_tpu.models.wrappers import CausalLMWithILQLHeads, Seq2SeqLMWithILQLHeads
 from trlx_tpu.ops.ilql import ilql_loss
 from trlx_tpu.parallel import shard_params
 from trlx_tpu.pipeline.offline_pipeline import ILQLRolloutStorage, tokenize_dialogue
@@ -78,6 +78,54 @@ def make_experience(
     )
 
 
+def make_experience_seq2seq(
+    samples, rewards, tokenizer=None, max_length: int = 2048, verbose: bool = True
+):
+    """Seq2seq variant: first phrase is the encoder prompt, second the
+    decoder output; indices run over DECODER positions (parity: reference
+    accelerate_ilql_trainer.py:179-245)."""
+    from trlx_tpu.pipeline.offline_pipeline import ILQLSeq2SeqRolloutStorage
+
+    if verbose:
+        logger.info("Collecting rollouts")
+    if tokenizer is not None:
+        samples = [tokenize_dialogue(s, tokenizer, max_length) for s in samples]
+
+    all_input_ids, all_output_ids = [], []
+    all_actions_ixs, all_states_ixs, all_dones = [], [], []
+    for sample in samples:
+        inputs = [m for m in sample if not m.is_output]
+        outputs = [m for m in sample if m.is_output]
+        if not outputs:
+            raise ValueError("sample has no output tokens")
+        all_input_ids.append([t for m in inputs for t in m.tokens])
+        out_tokens = [t for m in outputs for t in m.tokens]
+        all_output_ids.append(out_tokens)
+        length = len(out_tokens)
+        acts = list(range(length - 1)) or [0]
+        states = acts + [length - 1]
+        all_actions_ixs.append(acts)
+        all_states_ixs.append(states)
+        all_dones.append([1] * (len(states) - 1) + [0])
+
+    returns = np.asarray(rewards, np.float64)
+    returns = returns - returns.mean()
+    std = returns.std()
+    if not np.isnan(std) and std > 0:
+        returns = returns / (std + np.finfo(returns.dtype).eps)
+    rewards_per_sample = []
+    for acts, ret in zip(all_actions_ixs, returns):
+        rs = [0.0] * len(acts)
+        rs[-1] = float(ret)
+        rewards_per_sample.append(rs)
+
+    attention_masks = [[1] * len(ids) for ids in all_input_ids]
+    return ILQLSeq2SeqRolloutStorage(
+        all_input_ids, attention_masks, all_output_ids, rewards_per_sample,
+        all_states_ixs, all_actions_ixs, all_dones,
+    )
+
+
 @register_trainer("TPUILQLTrainer")
 class TPUILQLTrainer(TPUBaseTrainer):
     def __init__(self, config, **kwargs):
@@ -87,15 +135,19 @@ class TPUILQLTrainer(TPUBaseTrainer):
         self._sync_fn = None
 
     def setup_model(self) -> None:
-        if self.config.model.model_arch_type == "seq2seq":
-            raise NotImplementedError(
-                "seq2seq ILQL is not implemented yet (causal only)"
-            )
+        self.seq2seq = self.config.model.model_arch_type == "seq2seq"
         cfg, base_params, self.model_type = self.load_base_model()
         method = self.config.method
-        self.model = CausalLMWithILQLHeads(
-            cfg, two_qs=method.two_qs, alpha=method.alpha
-        )
+        if self.seq2seq:
+            if self.config.model.peft_config is not None:
+                raise NotImplementedError("peft with seq2seq ILQL is not supported")
+            self.model = Seq2SeqLMWithILQLHeads(
+                cfg, two_qs=method.two_qs, alpha=method.alpha
+            )
+        else:
+            self.model = CausalLMWithILQLHeads(
+                cfg, two_qs=method.two_qs, alpha=method.alpha
+            )
         self.rng, key = jax.random.split(self.rng)
         params = self.model.init_params(key, base_params)
         aux = getattr(self, "_loaded_aux", None) or {}
@@ -107,7 +159,8 @@ class TPUILQLTrainer(TPUBaseTrainer):
                     heads[k] = [heads[k][i] for i in sorted(heads[k], key=int)]
             aux = dict(aux, heads=heads)
         params.update(aux)
-        params = self.attach_lora(params)
+        if not self.seq2seq:
+            params = self.attach_lora(params)
         self.params = shard_params(self.mesh, params)
 
     def trainable_mask(self):
@@ -120,12 +173,19 @@ class TPUILQLTrainer(TPUBaseTrainer):
         )
         return mask
 
-    def loss(self, params, batch: ILQLBatch):
-        logits, qvs = self.model.forward(
-            params, batch.input_ids, batch.attention_mask,
-            batch.states_ixs, batch.actions_ixs,
-            remat=self.config.train.remat_policy != "none",
-        )
+    def loss(self, params, batch):
+        remat = self.config.train.remat_policy != "none"
+        if self.seq2seq:
+            logits, qvs = self.model.forward(
+                params, batch.input_ids, batch.attention_mask,
+                batch.decoder_input_ids, batch.states_ixs, batch.actions_ixs,
+                remat=remat,
+            )
+        else:
+            logits, qvs = self.model.forward(
+                params, batch.input_ids, batch.attention_mask,
+                batch.states_ixs, batch.actions_ixs, remat=remat,
+            )
         method = self.config.method
         return ilql_loss(
             logits, *qvs[:2], qvs[2], batch,
@@ -138,7 +198,12 @@ class TPUILQLTrainer(TPUBaseTrainer):
         return self.model.make_logits_processor(params["heads"], beta)
 
     def make_experience(self, samples, rewards, seq_length: int = 1024) -> None:
-        self.store = make_experience(samples, rewards, self.tokenizer, seq_length)
+        if self.seq2seq:
+            self.store = make_experience_seq2seq(
+                samples, rewards, self.tokenizer, seq_length
+            )
+        else:
+            self.store = make_experience(samples, rewards, self.tokenizer, seq_length)
 
     def prepare_learning(self) -> None:
         self.eval_dataloader = self.eval_pipeline.create_loader(

@@ -124,7 +124,11 @@ class TPUPPOTrainer(TPUBaseTrainer):
         else:
             if k is not None and 0 < k < cfg.n_layer:
                 at = cfg.n_layer - k
-            self.model = CausalLMWithValueHead(cfg, branch_at=at)
+            nv = self.config.method.num_value_layers_unfrozen
+            value_at = cfg.n_layer - nv if nv and 0 < nv < cfg.n_layer else None
+            self.model = CausalLMWithValueHead(
+                cfg, branch_at=at, value_branch_at=value_at
+            )
         self.rng, key = jax.random.split(self.rng)
         params = self.model.init_params(key, base_params)
         params.update(getattr(self, "_loaded_aux", None) or {})
