@@ -7,14 +7,25 @@ multi-chip path.
 """
 
 import os
+import re
 
 # Hard-force CPU. The image's sitecustomize imports jax and registers a
 # TPU PJRT plugin at interpreter startup (overriding JAX_PLATFORMS in the
 # environment), so env vars alone are not enough — but backends are not
 # initialized yet, so jax.config still wins if set before first use.
+# Flag-merge logic mirrors __graft_entry__._force_device_count_flag (kept
+# inline here: this file must not import anything that pulls in jax).
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+if m and int(m.group(1)) < 8:
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "--xla_force_host_platform_device_count=8",
+        flags,
+    )
+elif not m:
+    flags += " --xla_force_host_platform_device_count=8"
+os.environ["XLA_FLAGS"] = flags.strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
