@@ -83,6 +83,31 @@ def test_indivisible_dims_fall_back_replicated():
     assert specs["blocks"]["attn"]["q"]["kernel"] == P(None, "fsdp", None, None)
 
 
+def test_opt_state_shards_like_params():
+    """Distributed-optimizer parity: adam moments must carry the same
+    shardings as the params they track, not sit replicated on one device
+    (regression: jit(tx.init) without out_shardings commits to device 0)."""
+    import optax
+
+    from trlx_tpu.parallel import init_sharded_opt_state
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, n_layer=2, n_head=2, n_positions=32,
+        dtype=jnp.float32,
+    )
+    params = TransformerLM(cfg).init(jax.random.PRNGKey(0))
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    with mesh:
+        sharded = shard_params(mesh, params)
+        opt_state = init_sharded_opt_state(mesh, optax.adamw(1e-4), sharded)
+    mu = opt_state[0].mu
+    assert mu["embed"]["wte"].sharding.spec == P("tp", "fsdp")
+    assert mu["blocks"]["attn"]["q"]["kernel"].sharding.spec == P(None, "fsdp", "tp", None)
+    # every opt leaf must be mesh-wide (no single-device stragglers)
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        assert len(leaf.sharding.device_set) == mesh.size
+
+
 def test_local_batch_size():
     mesh = make_mesh({"dp": 4, "fsdp": 2})
     assert local_batch_size(mesh, 16) == 2

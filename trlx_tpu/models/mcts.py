@@ -16,7 +16,7 @@ compute shape matches while the host/device boundary is clean.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -60,8 +60,17 @@ class MCTSNode:
             node = node.parent
 
 
-def _make_eval_fn(model: CausalLMWithILQLHeads, width: int, beta: float, temperature: float):
-    """Jitted (params, ids[1,width], mask[1,width]) -> (priors[V], value)."""
+def _make_eval_fn(model: CausalLMWithILQLHeads, beta: float, temperature: float):
+    """Jitted (params, ids[1,width], mask[1,width]) -> (priors[V], value).
+    The width is fixed by the caller's padded arrays; jit specializes on it.
+    jit's cache is keyed on function identity, so a fresh closure per
+    mcts_generate call would recompile every time; cache the jitted fn on
+    the model instance (not a module-level dict, which would pin every
+    model ever used for the process lifetime)."""
+    cache: Dict[tuple, Callable] = model.__dict__.setdefault("_mcts_eval_fns", {})
+    cache_key = (float(beta), float(temperature))
+    if cache_key in cache:
+        return cache[cache_key]
 
     def eval_fn(params, ids, mask):
         base = _effective_base(model, params)
@@ -80,7 +89,9 @@ def _make_eval_fn(model: CausalLMWithILQLHeads, width: int, beta: float, tempera
         priors = jax.nn.softmax(prior_logits / max(temperature, 1e-6), axis=-1)
         return priors[0], v[0, 0]
 
-    return jax.jit(eval_fn)
+    jitted = jax.jit(eval_fn)
+    cache[cache_key] = jitted
+    return jitted
 
 
 def mcts_generate(
@@ -103,7 +114,7 @@ def mcts_generate(
     if attention_mask is None:
         attention_mask = (input_ids != pad_token_id).astype(np.int32)
     width = P + max_new_tokens
-    eval_fn = _make_eval_fn(model, width, beta, temperature)
+    eval_fn = _make_eval_fn(model, beta, temperature)
     add_mask = None
     if logit_mask is not None:
         add_mask = np.where(np.isfinite(np.asarray(logit_mask, np.float32)), 0.0, -np.inf)

@@ -72,7 +72,11 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # compute dtype inside blocks
     param_dtype: Any = jnp.float32
     # "xla" (let the compiler fuse) | "pallas" (first-party fused kernel
-    # for full teacher-forced forwards; decode steps always use XLA)
+    # for full teacher-forced forwards; decode steps always use XLA).
+    # Note: the pallas path's custom_vjp recomputes attention in plain XLA
+    # on the backward pass, so gradient-taking forwards (PPO/SFT train
+    # steps) see no HBM saving from it — the win is on no-grad forwards
+    # (rollout scoring, hydra/ref logits, eval).
     attention_impl: str = "xla"
 
     def __post_init__(self):

@@ -24,6 +24,7 @@ from trlx_tpu.parallel.mesh import (  # noqa: F401
 )
 from trlx_tpu.parallel.sharding import (  # noqa: F401
     infer_param_pspecs,
+    init_sharded_opt_state,
     param_shardings,
     shard_params,
 )

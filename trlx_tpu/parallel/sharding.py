@@ -109,3 +109,24 @@ def shard_params(mesh: Mesh, params: Dict) -> Dict:
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), params, shardings
     )
+
+
+def init_sharded_opt_state(mesh: Mesh, tx, params: Dict):
+    """Initialize optimizer state with mu/nu sharded like their params.
+
+    This is the distributed-optimizer half of ZeRO-3 parity (reference
+    megatron_20b.yaml `distributed_fused_adam`): optimizer moments follow
+    the same path rules as the params they track (opt-state tree paths end
+    with the param path, so the same regexes match). Without explicit
+    out_shardings, `jax.jit(tx.init)` commits the whole state to one
+    device — fully replicated optimizer memory and a retrace of the train
+    step when GSPMD later re-lays it out.
+    """
+    abstract = jax.eval_shape(tx.init, params)
+
+    def leaf_sharding(path, leaf):
+        spec = _fit_spec(spec_for_path(_path_str(path)), leaf.shape, mesh)
+        return NamedSharding(mesh, spec)
+
+    shardings = jax.tree_util.tree_map_with_path(leaf_sharding, abstract)
+    return jax.jit(tx.init, out_shardings=shardings)(params)

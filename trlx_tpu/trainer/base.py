@@ -7,8 +7,10 @@ Parity: /root/reference/trlx/trainer/accelerate_base_trainer.py:40-682
 / `post_epoch_callback`), the same loop structure (epochs -> inner epochs
 -> batches with gradient accumulation), the same checkpoint layout
 (`checkpoint_{step}` + `best_checkpoint`, each containing `hf_model/`)
-and the same metric keys (`time/forward`, `time/backward`,
-`reward/mean`, `learning_rate_group_0`, ...).
+and the same metric keys (`time/step`, `reward/mean`,
+`learning_rate_group_0`, ...; `time/forward`/`time/backward` are emitted
+when `train.timing_split` is on — the fused jitted step has no per-step
+split, so those keys come from a one-shot measured forward probe).
 
 TPU re-design:
 - One trainer covers what the reference splits across the Accelerate and
@@ -37,7 +39,12 @@ from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.generation import SamplerSettings, generate
 from trlx_tpu.models.hf import load_pretrained, save_pretrained_hf
 from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
-from trlx_tpu.parallel import data_sharding, make_mesh, shard_params
+from trlx_tpu.parallel import (
+    data_sharding,
+    init_sharded_opt_state,
+    make_mesh,
+    shard_params,
+)
 from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import Clock, build_optimizer, logging, significant, to_scalar
 from trlx_tpu.utils.tokenizers import load_tokenizer
@@ -81,7 +88,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             tx = optax.chain(tx, _mask_updates(mask))
         self.tx = tx
         with self.mesh:
-            self.opt_state = jax.jit(self.tx.init)(self.params)
+            self.opt_state = init_sharded_opt_state(self.mesh, self.tx, self.params)
 
         gen_kwargs = dict(config.method.gen_kwargs)
         self.generate_sweep_kwarg = None
@@ -122,6 +129,8 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
 
         self._train_step = None  # built lazily (jitted)
+        self._measured_forward_times = {}  # timing_split probes by batch shape
+        self._seen_step_shapes = set()  # batch shapes whose step has compiled
         self._generate_fns: Dict[Tuple, Callable] = {}
 
     # ------------------------------------------------------------------
@@ -594,7 +603,48 @@ class TPUBaseTrainer(BaseRLTrainer):
             new_params = optax.apply_updates(params, updates)
             return new_params, new_opt_state, loss, stats
 
-        return jax.jit(train_step, donate_argnums=(0, 1))
+        # Pin output shardings to the current (input) shardings: without
+        # this, GSPMD may choose different layouts for the step-1 outputs,
+        # and the changed input shardings force a full retrace+recompile of
+        # the train step on step 2.
+        params_sh = jax.tree_util.tree_map(lambda x: x.sharding, self.params)
+        opt_sh = jax.tree_util.tree_map(lambda x: x.sharding, self.opt_state)
+        return jax.jit(
+            train_step,
+            donate_argnums=(0, 1),
+            out_shardings=(params_sh, opt_sh, None, None),
+        )
+
+    def _measure_forward(self, device_batch) -> float:
+        """Time a jitted loss-only (forward) pass, once per batch shape
+        (`train.timing_split`): compile, then measure a second run so the
+        number excludes compilation. Probes a single microbatch and scales
+        by num_mb so the probe never materializes more activation memory
+        than the scanned train step does."""
+        import time as _time
+
+        key = tuple(
+            tuple(x.shape) for x in jax.tree_util.tree_leaves(device_batch)
+        )
+        if key in self._measured_forward_times:
+            return self._measured_forward_times[key]
+
+        probe_batch = device_batch
+        scale = 1.0
+        if self.num_mb > 1:
+            probe_batch = jax.tree_util.tree_map(
+                lambda x: x[: self.mb_size], device_batch
+            )
+            scale = float(self.num_mb)
+
+        fwd = jax.jit(self.loss)
+        with self.mesh:
+            to_scalar(fwd(self.params, probe_batch)[0])  # compile + warm
+            t0 = _time.time()
+            to_scalar(fwd(self.params, probe_batch)[0])
+            elapsed = (_time.time() - t0) * scale
+        self._measured_forward_times[key] = elapsed
+        return elapsed
 
     @abstractmethod
     def loss(self, params, batch) -> Tuple[jnp.ndarray, Dict]:
@@ -654,11 +704,25 @@ class TPUBaseTrainer(BaseRLTrainer):
                         for k, v in stats.items()
                         if np.ndim(v) == 0
                     }
-                    # jit fuses fwd+bwd+update: report the fused step time
-                    # under both keys the reference emits
-                    stats["time/forward"] = step_time
-                    stats["time/backward"] = 0.0
                     stats["time/step"] = step_time
+                    # jit fuses fwd+bwd+update, so a per-step split does not
+                    # exist; optionally measure a forward-only pass once
+                    # (static shapes => constant cost) to fill the
+                    # reference's time/forward & time/backward keys honestly
+                    # skip the split on the first step of each batch shape:
+                    # that step_time includes the train-step compile, which
+                    # would otherwise be booked entirely under time/backward
+                    shape_key = tuple(
+                        tuple(x.shape)
+                        for x in jax.tree_util.tree_leaves(device_batch)
+                    )
+                    if self.config.train.timing_split and (
+                        shape_key in self._seen_step_shapes
+                    ):
+                        fwd_time = self._measure_forward(device_batch)
+                        stats["time/forward"] = fwd_time
+                        stats["time/backward"] = max(step_time - fwd_time, 0.0)
+                    self._seen_step_shapes.add(shape_key)
                     stats["learning_rate_group_0"] = float(
                         self.schedule(self.iter_count)
                     )

@@ -46,6 +46,16 @@ from trlx_tpu.utils import Clock, infinite_loader, logging, to_scalar
 logger = logging.get_logger(__name__)
 
 
+def _masked_kl_stats(kl, n_valid):
+    """(mean_kl, mean_kl_per_token) over the first n_valid rows only: rows
+    appended by pad_rows for dp-divisibility are excluded so they cannot
+    bias the adaptive KL controller."""
+    row_valid = (jnp.arange(kl.shape[0]) < n_valid).astype(jnp.float32)
+    mean_kl = (kl.sum(axis=1) * row_valid).sum() / n_valid
+    mean_kl_per_token = (kl * row_valid[:, None]).sum() / (n_valid * kl.shape[1])
+    return mean_kl, mean_kl_per_token
+
+
 class AdaptiveKLController:
     """Ziegler-style proportional KL coefficient controller
     (parity: reference modeling_ppo.py:35-57)."""
@@ -222,7 +232,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             return self._experience_fns[key]
         model = self.model
 
-        def seq2seq_fn(params, ref_params, enc_ids, enc_mask, dec_ids, response_mask, scores, scores_mask, kl_coef):
+        def seq2seq_fn(params, ref_params, enc_ids, enc_mask, dec_ids, response_mask, scores, scores_mask, kl_coef, n_valid):
             mask = response_mask.astype(jnp.float32)
             dec_mask = jnp.concatenate(
                 [jnp.ones_like(dec_ids[:, :1]), response_mask.astype(jnp.int32)], axis=1
@@ -232,8 +242,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             ref_logprobs = logprobs_of_labels(out["ref_logits"][:, :-1], dec_ids[:, 1:]) * mask
             log_ratio = logprobs - ref_logprobs
             kl = jnp.exp(log_ratio) - 1 - log_ratio
-            mean_kl_per_token = kl.mean()
-            mean_kl = kl.sum(axis=1).mean()
+            mean_kl, mean_kl_per_token = _masked_kl_stats(kl, n_valid)
             values = out["values"][:, :-1] * mask
 
             rewards = -kl_coef * log_ratio
@@ -260,7 +269,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             self._experience_fns[key] = jax.jit(seq2seq_fn)
             return self._experience_fns[key]
 
-        def fn(params, ref_params, tokens, attention_mask, response_mask, scores, scores_mask, kl_coef):
+        def fn(params, ref_params, tokens, attention_mask, response_mask, scores, scores_mask, kl_coef, n_valid):
             out = model.forward_train(params, ref_params, tokens, attention_mask)
             logprobs_full = logprobs_of_labels(out["logits"][:, :-1], tokens[:, 1:])
             ref_logprobs_full = logprobs_of_labels(out["ref_logits"][:, :-1], tokens[:, 1:])
@@ -270,8 +279,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             full_mask = attention_mask[:, 1:].astype(jnp.float32)
             log_ratio_full = (logprobs_full - ref_logprobs_full) * full_mask
             kl = jnp.exp(log_ratio_full) - 1 - log_ratio_full
-            mean_kl_per_token = kl.mean()
-            mean_kl = kl.sum(axis=1).mean()
+            mean_kl, mean_kl_per_token = _masked_kl_stats(kl, n_valid)
 
             mask = response_mask.astype(jnp.float32)
             sl = slice(P - 1, P + N - 1)
@@ -422,6 +430,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
                     jax.device_put(rpad(scores), sharding),
                     jax.device_put(rpad(scores_mask), sharding),
                     jnp.float32(self.kl_ctl.value),
+                    jnp.float32(B),
                 )
             if target != B:
                 rollout_batch = jax.tree_util.tree_map(

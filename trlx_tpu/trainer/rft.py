@@ -29,6 +29,26 @@ from trlx_tpu.utils import logging
 logger = logging.get_logger(__name__)
 
 
+def compute_thresholds(per_prompt_scores: List[List[float]], percentile: float) -> np.ndarray:
+    """Per-prompt selection thresholds at the given score percentile.
+
+    Quantized rewards: nudge each threshold above the prompt's minimum so
+    exact-min scores are excluded, but cap it at the prompt's maximum so
+    the best sample always survives (selection uses `score >= threshold`).
+    The reference clips against the *global* min/max of the thresholds
+    array, which both inverts when every score is equal (np.clip then
+    returns the upper bound, deselecting everything) and can push a
+    constant-score prompt's threshold above its own maximum; per-prompt
+    bounds avoid both failure modes.
+    """
+    thresholds = np.array(
+        [np.quantile(np.asarray(s), percentile) for s in per_prompt_scores]
+    )
+    mins = np.array([min(s) for s in per_prompt_scores])
+    maxs = np.array([max(s) for s in per_prompt_scores])
+    return np.minimum(np.maximum(thresholds, mins + 1e-3), maxs)
+
+
 @register_trainer("TPURFTTrainer")
 class TPURFTTrainer(TPUBaseTrainer):
     def __init__(self, config, **kwargs):
@@ -105,11 +125,7 @@ class TPURFTTrainer(TPUBaseTrainer):
         percentile = method.start_percentile + percentile_delta * (
             self.epoch_count % method.n_improve_steps
         )
-        thresholds = np.array(
-            [np.quantile(np.asarray(s), percentile) for s in per_prompt_scores]
-        )
-        # quantized rewards: exclude min values but never the max
-        thresholds = np.clip(thresholds, thresholds.min() + 1e-3, thresholds.max() - 1e-3)
+        thresholds = compute_thresholds(per_prompt_scores, percentile)
 
         samples_selected = []
         for prompt, threshold in zip(self.generations_per_prompt, thresholds):
