@@ -40,6 +40,33 @@ def tiny_hf_model(model_type: str):
             intermediate_size=56, tie_word_embeddings=False,
         )
         return transformers.LlamaForCausalLM(cfg)
+    if model_type in ("opt", "opt_untied"):
+        cfg = transformers.OPTConfig(
+            vocab_size=97, max_position_embeddings=64, hidden_size=32,
+            num_hidden_layers=2, num_attention_heads=2, ffn_dim=64,
+            activation_function="relu", do_layer_norm_before=True,
+            word_embed_proj_dim=32,
+            tie_word_embeddings=(model_type == "opt"),
+        )
+        return transformers.OPTForCausalLM(cfg)
+    if model_type == "bloom":
+        cfg = transformers.BloomConfig(
+            vocab_size=97, hidden_size=32, n_layer=2, n_head=2,
+        )
+        return transformers.BloomForCausalLM(cfg)
+    if model_type == "gpt_bigcode":
+        cfg = transformers.GPTBigCodeConfig(
+            vocab_size=97, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+            n_inner=64, multi_query=True, activation_function="gelu_pytorch_tanh",
+        )
+        return transformers.GPTBigCodeForCausalLM(cfg)
+    if model_type == "gpt_neo":
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=97, max_position_embeddings=64, hidden_size=32,
+            num_layers=4, num_heads=2, intermediate_size=64,
+            attention_types=[[["global", "local"], 2]], window_size=4,
+        )
+        return transformers.GPTNeoForCausalLM(cfg)
     raise ValueError(model_type)
 
 
@@ -47,11 +74,17 @@ def convert(model_type):
     torch.manual_seed(0)
     hf = tiny_hf_model(model_type).eval()
     cfg = config_from_hf(hf.config, dtype=jnp.float32, param_dtype=jnp.float32)
-    params = params_from_state_dict(hf.state_dict(), cfg, model_type)
+    params = params_from_state_dict(hf.state_dict(), cfg, hf.config.model_type)
     return hf, TransformerLM(cfg), params
 
 
-@pytest.mark.parametrize("model_type", ["gpt2", "gptj", "gpt_neox", "llama"])
+ALL_ARCHS = [
+    "gpt2", "gptj", "gpt_neo", "gpt_neox", "gpt_bigcode", "llama",
+    "opt", "opt_untied", "bloom",
+]
+
+
+@pytest.mark.parametrize("model_type", ALL_ARCHS)
 def test_logit_parity_with_hf(model_type):
     hf, model, params = convert(model_type)
     rng = np.random.default_rng(1)
@@ -80,7 +113,22 @@ def test_left_padding_invariance():
     )
 
 
-@pytest.mark.parametrize("model_type", ["gpt2", "llama"])
+@pytest.mark.parametrize("model_type", ALL_ARCHS)
+def test_hf_export_round_trip(model_type):
+    """params -> HF state_dict -> params preserves logits (HF-export
+    deploy-artifact parity, reference accelerate_ppo_trainer.py:526-553)."""
+    from trlx_tpu.models.hf import state_dict_from_params
+
+    hf, model, params = convert(model_type)
+    sd = state_dict_from_params(params, model.cfg, hf.config.model_type)
+    params2 = params_from_state_dict(sd, model.cfg, hf.config.model_type)
+    ids = jnp.array(np.random.default_rng(7).integers(0, 97, size=(2, 9)))
+    a = model(params, ids)["logits"]
+    b = model(params2, ids)["logits"]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("model_type", ["gpt2", "llama", "bloom", "gpt_neo"])
 def test_kv_cache_matches_full_forward(model_type):
     _, model, params = convert(model_type)
     rng = np.random.default_rng(3)

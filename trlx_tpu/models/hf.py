@@ -6,9 +6,10 @@ converted into the stacked-layer functional param tree of
 trlx_tpu.models.transformer, and back (HF export for deploy parity,
 reference accelerate_ppo_trainer.py:526-553).
 
-Supported model families: gpt2, gptj, gpt_neox, llama, opt (decoder
-side). Each family is a declarative layout description, not a separate
-model class.
+Supported model families: gpt2, gptj, gpt_neo, gpt_neox, gpt_bigcode,
+llama, opt, bloom — the reference's full decoder dispatch table
+(modeling_ppo.py:1598-1637). Each family is a declarative layout
+description, not a separate model class.
 """
 
 from __future__ import annotations
@@ -121,7 +122,92 @@ def config_from_hf(hf_config: Any, dtype=None, param_dtype=None) -> TransformerC
             dtype=dtype,
             param_dtype=param_dtype,
         )
-    raise ValueError(f"unsupported model_type {mt!r} (supported: gpt2, gptj, gpt_neox, llama)")
+    if mt == "opt":
+        # ref: OPTModelBranch (modeling_ppo.py:689-813). HF OPT computes
+        # positions from the attention-mask cumsum (as we always do) and
+        # offsets the learned table by 2 pad rows.
+        if not getattr(hf_config, "do_layer_norm_before", True):
+            raise ValueError("OPT variants with do_layer_norm_before=False (350m) unsupported")
+        if getattr(hf_config, "word_embed_proj_dim", hf_config.hidden_size) != hf_config.hidden_size:
+            raise ValueError("OPT word_embed_proj_dim != hidden_size unsupported")
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            n_layer=hf_config.num_hidden_layers,
+            n_head=hf_config.num_attention_heads,
+            n_positions=hf_config.max_position_embeddings,
+            intermediate_size=hf_config.ffn_dim,
+            pos_embed="learned",
+            pos_offset=2,
+            activation=hf_config.activation_function,
+            layer_norm_epsilon=1e-5,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+    if mt == "bloom":
+        # ref: BloomModelBranch (modeling_ppo.py:816-929). ALiBi position
+        # bias, LayerNorm directly after word embeddings, per-head fused QKV.
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            n_layer=hf_config.n_layer,
+            n_head=hf_config.n_head,
+            n_positions=getattr(hf_config, "seq_length", 2048),
+            pos_embed="alibi",
+            embed_layernorm=True,
+            activation="gelu_new",
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+            tie_word_embeddings=True,
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+    if mt == "gpt_bigcode":
+        # ref: GPTBigCodeModelBranch (modeling_ppo.py:1079-1222).
+        # Multi-query attention: a single shared KV head.
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            n_layer=hf_config.n_layer,
+            n_head=hf_config.n_head,
+            n_kv_head=1 if getattr(hf_config, "multi_query", True) else hf_config.n_head,
+            n_positions=hf_config.n_positions,
+            intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+            pos_embed="learned",
+            activation="gelu_new",
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+            tie_word_embeddings=True,
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+    if mt == "gpt_neo":
+        # ref: GPTModelBranch covers gpt_neo (modeling_ppo.py:1598-1637).
+        # Quirks: queries are NOT scaled by 1/sqrt(D); alternate layers use
+        # a sliding local-attention window; q/k/v projections have no bias.
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            n_layer=hf_config.num_layers,
+            n_head=hf_config.num_heads,
+            n_positions=hf_config.max_position_embeddings,
+            intermediate_size=hf_config.intermediate_size
+            or 4 * hf_config.hidden_size,
+            pos_embed="learned",
+            activation="gelu_new",
+            layer_norm_epsilon=hf_config.layer_norm_epsilon,
+            attn_scale=1.0,
+            local_window=hf_config.window_size,
+            attn_layers=tuple(hf_config.attention_layers),
+            use_attn_bias=False,
+            use_attn_out_bias=True,
+            tie_word_embeddings=True,
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+    raise ValueError(
+        f"unsupported model_type {mt!r} (supported: gpt2, gptj, gpt_neo, "
+        "gpt_neox, gpt_bigcode, llama, opt, bloom)"
+    )
 
 
 def seq2seq_config_from_hf(hf_config: Any, dtype=None, param_dtype=None):
@@ -411,6 +497,189 @@ def params_from_state_dict(sd: Dict[str, Any], cfg: TransformerConfig, model_typ
             params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
         return params
 
+    if model_type == "opt":
+        pfx = (
+            "model.decoder."
+            if any(k.startswith("model.decoder.") for k in sd)
+            else "decoder."
+            if any(k.startswith("decoder.") for k in sd)
+            else ""
+        )
+        layers = []
+        for i in range(cfg.n_layer):
+            b = f"{pfx}layers.{i}."
+            attn = {}
+            for ours, theirs in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")):
+                attn[ours] = {
+                    "kernel": _np(sd[f"{b}self_attn.{theirs}.weight"]).T.reshape(E, H, D),
+                    "bias": _np(sd[f"{b}self_attn.{theirs}.bias"]).reshape(H, D),
+                }
+            attn["o"] = {
+                "kernel": _np(sd[b + "self_attn.out_proj.weight"]).T.reshape(H, D, E),
+                "bias": _np(sd[b + "self_attn.out_proj.bias"]),
+            }
+            layers.append(
+                {
+                    "ln_1": {
+                        "scale": _np(sd[b + "self_attn_layer_norm.weight"]),
+                        "bias": _np(sd[b + "self_attn_layer_norm.bias"]),
+                    },
+                    "attn": attn,
+                    "ln_2": {
+                        "scale": _np(sd[b + "final_layer_norm.weight"]),
+                        "bias": _np(sd[b + "final_layer_norm.bias"]),
+                    },
+                    "mlp": {
+                        "fc_in": {"kernel": _np(sd[b + "fc1.weight"]).T, "bias": _np(sd[b + "fc1.bias"])},
+                        "fc_out": {"kernel": _np(sd[b + "fc2.weight"]).T, "bias": _np(sd[b + "fc2.bias"])},
+                    },
+                }
+            )
+        params = {
+            # wpe keeps OPT's full table (2 leading pad rows; cfg.pos_offset=2)
+            "embed": {
+                "wte": _np(sd[pfx + "embed_tokens.weight"]),
+                "wpe": _np(sd[pfx + "embed_positions.weight"]),
+            },
+            "blocks": _stack(layers),
+            "ln_f": {
+                "scale": _np(sd[pfx + "final_layer_norm.weight"]),
+                "bias": _np(sd[pfx + "final_layer_norm.bias"]),
+            },
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
+        return params
+
+    if model_type == "bloom":
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        layers = []
+        for i in range(cfg.n_layer):
+            b = f"{pfx}h.{i}."
+            # fused qkv [3E, E], per-head interleave: rows view as [H, 3, D]
+            w = _np(sd[b + "self_attention.query_key_value.weight"]).reshape(H, 3, D, E)
+            bias = _np(sd[b + "self_attention.query_key_value.bias"]).reshape(H, 3, D)
+            attn = {
+                name: {
+                    "kernel": np.moveaxis(w[:, j], -1, 0).reshape(E, H, D),
+                    "bias": bias[:, j],
+                }
+                for j, name in enumerate("qkv")
+            }
+            attn["o"] = {
+                "kernel": _np(sd[b + "self_attention.dense.weight"]).T.reshape(H, D, E),
+                "bias": _np(sd[b + "self_attention.dense.bias"]),
+            }
+            layers.append(
+                {
+                    "ln_1": {
+                        "scale": _np(sd[b + "input_layernorm.weight"]),
+                        "bias": _np(sd[b + "input_layernorm.bias"]),
+                    },
+                    "attn": attn,
+                    "ln_2": {
+                        "scale": _np(sd[b + "post_attention_layernorm.weight"]),
+                        "bias": _np(sd[b + "post_attention_layernorm.bias"]),
+                    },
+                    "mlp": {
+                        "fc_in": {
+                            "kernel": _np(sd[b + "mlp.dense_h_to_4h.weight"]).T,
+                            "bias": _np(sd[b + "mlp.dense_h_to_4h.bias"]),
+                        },
+                        "fc_out": {
+                            "kernel": _np(sd[b + "mlp.dense_4h_to_h.weight"]).T,
+                            "bias": _np(sd[b + "mlp.dense_4h_to_h.bias"]),
+                        },
+                    },
+                }
+            )
+        return {
+            "embed": {"wte": _np(sd[pfx + "word_embeddings.weight"])},
+            "ln_embed": {
+                "scale": _np(sd[pfx + "word_embeddings_layernorm.weight"]),
+                "bias": _np(sd[pfx + "word_embeddings_layernorm.bias"]),
+            },
+            "blocks": _stack(layers),
+            "ln_f": {
+                "scale": _np(sd[pfx + "ln_f.weight"]),
+                "bias": _np(sd[pfx + "ln_f.bias"]),
+            },
+        }
+
+    if model_type == "gpt_bigcode":
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        kv_dim = Hkv * D
+        layers = []
+        for i in range(cfg.n_layer):
+            b = f"{pfx}h.{i}."
+            # c_attn is a Linear [E + 2*kv_dim, E]: q rows then shared k, v
+            w = _np(sd[b + "attn.c_attn.weight"]).T  # [E, E + 2*kv_dim]
+            bias = _np(sd[b + "attn.c_attn.bias"])
+            attn = {
+                "q": {
+                    "kernel": w[:, :E].reshape(E, H, D),
+                    "bias": bias[:E].reshape(H, D),
+                },
+                "k": {
+                    "kernel": w[:, E : E + kv_dim].reshape(E, Hkv, D),
+                    "bias": bias[E : E + kv_dim].reshape(Hkv, D),
+                },
+                "v": {
+                    "kernel": w[:, E + kv_dim :].reshape(E, Hkv, D),
+                    "bias": bias[E + kv_dim :].reshape(Hkv, D),
+                },
+                "o": {
+                    "kernel": _np(sd[b + "attn.c_proj.weight"]).T.reshape(H, D, E),
+                    "bias": _np(sd[b + "attn.c_proj.bias"]),
+                },
+            }
+            layers.append(
+                {
+                    "ln_1": {"scale": _np(sd[b + "ln_1.weight"]), "bias": _np(sd[b + "ln_1.bias"])},
+                    "attn": attn,
+                    "ln_2": {"scale": _np(sd[b + "ln_2.weight"]), "bias": _np(sd[b + "ln_2.bias"])},
+                    "mlp": {
+                        "fc_in": {"kernel": _np(sd[b + "mlp.c_fc.weight"]).T, "bias": _np(sd[b + "mlp.c_fc.bias"])},
+                        "fc_out": {"kernel": _np(sd[b + "mlp.c_proj.weight"]).T, "bias": _np(sd[b + "mlp.c_proj.bias"])},
+                    },
+                }
+            )
+        return {
+            "embed": {"wte": _np(sd[pfx + "wte.weight"]), "wpe": _np(sd[pfx + "wpe.weight"])},
+            "blocks": _stack(layers),
+            "ln_f": {"scale": _np(sd[pfx + "ln_f.weight"]), "bias": _np(sd[pfx + "ln_f.bias"])},
+        }
+
+    if model_type == "gpt_neo":
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        layers = []
+        for i in range(cfg.n_layer):
+            b = f"{pfx}h.{i}."
+            attn = {
+                ours: {"kernel": _np(sd[f"{b}attn.attention.{theirs}.weight"]).T.reshape(E, H, D)}
+                for ours, theirs in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj"))
+            }
+            attn["o"] = {
+                "kernel": _np(sd[b + "attn.attention.out_proj.weight"]).T.reshape(H, D, E),
+                "bias": _np(sd[b + "attn.attention.out_proj.bias"]),
+            }
+            layers.append(
+                {
+                    "ln_1": {"scale": _np(sd[b + "ln_1.weight"]), "bias": _np(sd[b + "ln_1.bias"])},
+                    "attn": attn,
+                    "ln_2": {"scale": _np(sd[b + "ln_2.weight"]), "bias": _np(sd[b + "ln_2.bias"])},
+                    "mlp": {
+                        "fc_in": {"kernel": _np(sd[b + "mlp.c_fc.weight"]).T, "bias": _np(sd[b + "mlp.c_fc.bias"])},
+                        "fc_out": {"kernel": _np(sd[b + "mlp.c_proj.weight"]).T, "bias": _np(sd[b + "mlp.c_proj.bias"])},
+                    },
+                }
+            )
+        return {
+            "embed": {"wte": _np(sd[pfx + "wte.weight"]), "wpe": _np(sd[pfx + "wpe.weight"])},
+            "blocks": _stack(layers),
+            "ln_f": {"scale": _np(sd[pfx + "ln_f.weight"]), "bias": _np(sd[pfx + "ln_f.bias"])},
+        }
+
     raise ValueError(f"unsupported model_type {model_type!r}")
 
 
@@ -593,6 +862,123 @@ def state_dict_from_params(params: Dict, cfg: TransformerConfig, model_type: str
         out["gpt_neox.final_layer_norm.weight"] = A(params["ln_f"]["scale"])
         out["gpt_neox.final_layer_norm.bias"] = A(params["ln_f"]["bias"])
         out["embed_out.weight"] = A(params["lm_head"]["kernel"]).T
+        return out
+
+    if model_type == "opt":
+        out["model.decoder.embed_tokens.weight"] = A(params["embed"]["wte"])
+        out["model.decoder.embed_positions.weight"] = A(params["embed"]["wpe"])
+        for i in range(cfg.n_layer):
+            b = f"model.decoder.layers.{i}."
+            blk = {k: A_tree(v, i) for k, v in blocks.items()}
+            out[b + "self_attn_layer_norm.weight"] = blk["ln_1"]["scale"]
+            out[b + "self_attn_layer_norm.bias"] = blk["ln_1"]["bias"]
+            for ours, theirs in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")):
+                out[b + f"self_attn.{theirs}.weight"] = blk["attn"][ours]["kernel"].reshape(E, H * D).T
+                out[b + f"self_attn.{theirs}.bias"] = blk["attn"][ours]["bias"].reshape(H * D)
+            out[b + "self_attn.out_proj.weight"] = blk["attn"]["o"]["kernel"].reshape(H * D, E).T
+            out[b + "self_attn.out_proj.bias"] = blk["attn"]["o"]["bias"]
+            out[b + "final_layer_norm.weight"] = blk["ln_2"]["scale"]
+            out[b + "final_layer_norm.bias"] = blk["ln_2"]["bias"]
+            out[b + "fc1.weight"] = blk["mlp"]["fc_in"]["kernel"].T
+            out[b + "fc1.bias"] = blk["mlp"]["fc_in"]["bias"]
+            out[b + "fc2.weight"] = blk["mlp"]["fc_out"]["kernel"].T
+            out[b + "fc2.bias"] = blk["mlp"]["fc_out"]["bias"]
+        out["model.decoder.final_layer_norm.weight"] = A(params["ln_f"]["scale"])
+        out["model.decoder.final_layer_norm.bias"] = A(params["ln_f"]["bias"])
+        if "lm_head" in params:
+            out["lm_head.weight"] = A(params["lm_head"]["kernel"]).T
+        else:
+            out["lm_head.weight"] = out["model.decoder.embed_tokens.weight"]
+        return out
+
+    if model_type == "bloom":
+        out["transformer.word_embeddings.weight"] = A(params["embed"]["wte"])
+        out["transformer.word_embeddings_layernorm.weight"] = A(params["ln_embed"]["scale"])
+        out["transformer.word_embeddings_layernorm.bias"] = A(params["ln_embed"]["bias"])
+        for i in range(cfg.n_layer):
+            b = f"transformer.h.{i}."
+            blk = {k: A_tree(v, i) for k, v in blocks.items()}
+            out[b + "input_layernorm.weight"] = blk["ln_1"]["scale"]
+            out[b + "input_layernorm.bias"] = blk["ln_1"]["bias"]
+            # [H, 3, D, E] per-head interleave -> fused [3E, E]
+            w = np.stack(
+                [np.moveaxis(blk["attn"][n]["kernel"], 0, -1) for n in "qkv"], axis=1
+            )
+            out[b + "self_attention.query_key_value.weight"] = w.reshape(3 * E, E)
+            bias = np.stack([blk["attn"][n]["bias"] for n in "qkv"], axis=1)
+            out[b + "self_attention.query_key_value.bias"] = bias.reshape(3 * E)
+            out[b + "self_attention.dense.weight"] = blk["attn"]["o"]["kernel"].reshape(H * D, E).T
+            out[b + "self_attention.dense.bias"] = blk["attn"]["o"]["bias"]
+            out[b + "post_attention_layernorm.weight"] = blk["ln_2"]["scale"]
+            out[b + "post_attention_layernorm.bias"] = blk["ln_2"]["bias"]
+            out[b + "mlp.dense_h_to_4h.weight"] = blk["mlp"]["fc_in"]["kernel"].T
+            out[b + "mlp.dense_h_to_4h.bias"] = blk["mlp"]["fc_in"]["bias"]
+            out[b + "mlp.dense_4h_to_h.weight"] = blk["mlp"]["fc_out"]["kernel"].T
+            out[b + "mlp.dense_4h_to_h.bias"] = blk["mlp"]["fc_out"]["bias"]
+        out["transformer.ln_f.weight"] = A(params["ln_f"]["scale"])
+        out["transformer.ln_f.bias"] = A(params["ln_f"]["bias"])
+        out["lm_head.weight"] = out["transformer.word_embeddings.weight"]
+        return out
+
+    if model_type == "gpt_bigcode":
+        out["transformer.wte.weight"] = A(params["embed"]["wte"])
+        out["transformer.wpe.weight"] = A(params["embed"]["wpe"])
+        kv_dim = Hkv * D
+        for i in range(cfg.n_layer):
+            b = f"transformer.h.{i}."
+            blk = {k: A_tree(v, i) for k, v in blocks.items()}
+            out[b + "ln_1.weight"] = blk["ln_1"]["scale"]
+            out[b + "ln_1.bias"] = blk["ln_1"]["bias"]
+            w = np.concatenate(
+                [
+                    blk["attn"]["q"]["kernel"].reshape(E, H * D),
+                    blk["attn"]["k"]["kernel"].reshape(E, kv_dim),
+                    blk["attn"]["v"]["kernel"].reshape(E, kv_dim),
+                ],
+                axis=-1,
+            )
+            out[b + "attn.c_attn.weight"] = w.T
+            out[b + "attn.c_attn.bias"] = np.concatenate(
+                [
+                    blk["attn"]["q"]["bias"].reshape(H * D),
+                    blk["attn"]["k"]["bias"].reshape(kv_dim),
+                    blk["attn"]["v"]["bias"].reshape(kv_dim),
+                ]
+            )
+            out[b + "attn.c_proj.weight"] = blk["attn"]["o"]["kernel"].reshape(H * D, E).T
+            out[b + "attn.c_proj.bias"] = blk["attn"]["o"]["bias"]
+            out[b + "ln_2.weight"] = blk["ln_2"]["scale"]
+            out[b + "ln_2.bias"] = blk["ln_2"]["bias"]
+            out[b + "mlp.c_fc.weight"] = blk["mlp"]["fc_in"]["kernel"].T
+            out[b + "mlp.c_fc.bias"] = blk["mlp"]["fc_in"]["bias"]
+            out[b + "mlp.c_proj.weight"] = blk["mlp"]["fc_out"]["kernel"].T
+            out[b + "mlp.c_proj.bias"] = blk["mlp"]["fc_out"]["bias"]
+        out["transformer.ln_f.weight"] = A(params["ln_f"]["scale"])
+        out["transformer.ln_f.bias"] = A(params["ln_f"]["bias"])
+        out["lm_head.weight"] = out["transformer.wte.weight"]
+        return out
+
+    if model_type == "gpt_neo":
+        out["transformer.wte.weight"] = A(params["embed"]["wte"])
+        out["transformer.wpe.weight"] = A(params["embed"]["wpe"])
+        for i in range(cfg.n_layer):
+            b = f"transformer.h.{i}."
+            blk = {k: A_tree(v, i) for k, v in blocks.items()}
+            out[b + "ln_1.weight"] = blk["ln_1"]["scale"]
+            out[b + "ln_1.bias"] = blk["ln_1"]["bias"]
+            for ours, theirs in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")):
+                out[b + f"attn.attention.{theirs}.weight"] = blk["attn"][ours]["kernel"].reshape(E, H * D).T
+            out[b + "attn.attention.out_proj.weight"] = blk["attn"]["o"]["kernel"].reshape(H * D, E).T
+            out[b + "attn.attention.out_proj.bias"] = blk["attn"]["o"]["bias"]
+            out[b + "ln_2.weight"] = blk["ln_2"]["scale"]
+            out[b + "ln_2.bias"] = blk["ln_2"]["bias"]
+            out[b + "mlp.c_fc.weight"] = blk["mlp"]["fc_in"]["kernel"].T
+            out[b + "mlp.c_fc.bias"] = blk["mlp"]["fc_in"]["bias"]
+            out[b + "mlp.c_proj.weight"] = blk["mlp"]["fc_out"]["kernel"].T
+            out[b + "mlp.c_proj.bias"] = blk["mlp"]["fc_out"]["bias"]
+        out["transformer.ln_f.weight"] = A(params["ln_f"]["scale"])
+        out["transformer.ln_f.bias"] = A(params["ln_f"]["bias"])
+        out["lm_head.weight"] = out["transformer.wte.weight"]
         return out
 
     raise ValueError(f"export not implemented for {model_type!r}")

@@ -54,16 +54,25 @@ class TransformerConfig:
     head_dim: Optional[int] = None  # default hidden // n_head
 
     # architecture switches
-    pos_embed: str = "learned"  # "learned" | "rotary" | "none"
+    pos_embed: str = "learned"  # "learned" | "rotary" | "alibi" | "none"
+    pos_offset: int = 0  # OPT: learned table has 2 leading pad rows
+    embed_layernorm: bool = False  # bloom: LayerNorm after word embeddings
     rotary_style: str = "neox"  # "neox" (half rotate) | "gptj" (interleaved)
     rotary_dim: Optional[int] = None  # default head_dim
     rope_theta: float = 10000.0
+    # gpt-neo quirks: queries are NOT scaled by 1/sqrt(head_dim), and
+    # every other layer attends only within a sliding window
+    attn_scale: Optional[float] = None  # None -> 1/sqrt(head_dim)
+    local_window: Optional[int] = None  # sliding-window size for "local" layers
+    attn_layers: Optional[Tuple[str, ...]] = None  # per-layer "global"/"local"
     norm: str = "layernorm"  # "layernorm" | "rmsnorm"
     layer_norm_epsilon: float = 1e-5
     activation: str = "gelu_new"  # "gelu_new" | "gelu" | "silu" | "relu"
     mlp_gated: bool = False  # llama-style SwiGLU
     parallel_residual: bool = False  # gptj/neox: attn and mlp share input
     use_attn_bias: bool = True
+    # gpt-neo: q/k/v have no bias but out_proj does; None = use_attn_bias
+    use_attn_out_bias: Optional[bool] = None
     use_mlp_bias: bool = True
     use_norm_bias: bool = True
     tie_word_embeddings: bool = True
@@ -132,6 +141,20 @@ def apply_rope(x: Array, cos: Array, sin: Array, style: str) -> Array:
         x1, x2 = x_rot[..., :half], x_rot[..., half:]
         rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return jnp.concatenate([rotated.astype(x.dtype), x_pass], axis=-1)
+
+
+def alibi_slopes(n_head: int) -> Array:
+    """Per-head ALiBi slopes (bloom parity). The bias added to scores is
+    `slope[h] * key_position`, equivalent to the canonical
+    `-slope * (q_pos - k_pos)` because the per-query constant cancels in
+    softmax — this is also how HF bloom builds its alibi tensor."""
+    p = 2 ** math.floor(math.log2(n_head))
+    base = 2.0 ** (-(2.0 ** -(math.log2(p) - 3)))
+    slopes = [base ** i for i in range(1, p + 1)]
+    if p < n_head:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * p) - 3)))
+        slopes += [extra_base ** i for i in range(1, 2 * (n_head - p) + 1, 2)]
+    return jnp.asarray(slopes, jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -211,7 +234,20 @@ class Attention(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        if cfg.attention_impl == "pallas" and cache is None and key_mask is not None:
+        # the pallas kernel bakes in 1/sqrt(D) scaling and a plain
+        # causal+padding mask; architectures with nonstandard scaling or
+        # extra additive biases (alibi, local windows) take the XLA path
+        plain_bias = (
+            cfg.attn_scale is None
+            and cfg.pos_embed != "alibi"
+            and cfg.local_window is None
+        )
+        if (
+            cfg.attention_impl == "pallas"
+            and cache is None
+            and key_mask is not None
+            and plain_bias
+        ):
             from trlx_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(
@@ -221,7 +257,7 @@ class Attention(nn.Module):
                 key_mask,
             ).transpose(0, 2, 1, 3)
         else:
-            scale = 1.0 / math.sqrt(D)
+            scale = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / math.sqrt(D)
             # [B, H, T, S]; accumulate scores in fp32 for stability
             scores = jnp.einsum(
                 "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
@@ -230,13 +266,18 @@ class Attention(nn.Module):
             probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
             out = jnp.einsum("bhts,bshd->bthd", probs, v)
 
+        out_bias = (
+            cfg.use_attn_out_bias
+            if cfg.use_attn_out_bias is not None
+            else cfg.use_attn_bias
+        )
         proj = nn.DenseGeneral(
             features=E,
             axis=(-2, -1),
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02 / math.sqrt(2 * cfg.n_layer)),
-            use_bias=cfg.use_attn_bias,
+            use_bias=out_bias,
             name="o",
         )
         return proj(out), new_kv
@@ -311,11 +352,17 @@ class Embedding(nn.Module):
         )
         h = jnp.take(wte, input_ids, axis=0)
         if cfg.pos_embed == "learned":
+            # pos_offset: OPT's table carries 2 leading pad rows; real
+            # position i lives at table row i + offset
             wpe = self.param(
                 "wpe", nn.initializers.normal(0.01),
-                (cfg.n_positions, cfg.hidden_size), cfg.param_dtype,
+                (cfg.n_positions + cfg.pos_offset, cfg.hidden_size), cfg.param_dtype,
             )
-            h = h + jnp.take(wpe, jnp.clip(positions, 0, cfg.n_positions - 1), axis=0)
+            h = h + jnp.take(
+                wpe,
+                jnp.clip(positions, 0, cfg.n_positions - 1) + cfg.pos_offset,
+                axis=0,
+            )
         return h.astype(cfg.dtype)
 
     def attend(self, hidden: Array) -> Array:
@@ -384,8 +431,51 @@ class TransformerLM:
         self.cfg = cfg
         self.embed = Embedding(cfg)
         self.block = Block(cfg)
-        self.ln_f = Norm(cfg)
+        self.ln_f = Norm(cfg)  # stateless: also applied with ln_embed params
         self.lm_head = None if cfg.tie_word_embeddings else LMHead(cfg)
+
+    # -- bias / embedding helpers ---------------------------------------
+
+    def _build_bias(
+        self, key_mask: Array, q_slots: Array, k_slots: Array
+    ) -> Tuple[Array, Optional[Array]]:
+        """(attn_bias, local_bias): the base causal+padding bias, with the
+        per-key ALiBi term folded in for bloom-style models, plus the extra
+        sliding-window bias applied only on "local" layers (gpt-neo)."""
+        cfg = self.cfg
+        bias = make_attention_bias(key_mask, q_slots, k_slots)
+        if cfg.pos_embed == "alibi":
+            key_pos = jnp.maximum(jnp.cumsum(key_mask, axis=1) - 1, 0)
+            alibi = (
+                alibi_slopes(cfg.n_head)[None, :, None, None]
+                * key_pos.astype(jnp.float32)[:, None, None, :]
+            )
+            bias = bias + alibi * (key_mask[:, None, None, :] > 0)
+        local_bias = None
+        if cfg.local_window is not None:
+            qs = q_slots if q_slots.ndim == 2 else q_slots[None, :]
+            dist = qs[:, :, None] - k_slots[None, None, :]  # [1|B, T, S]
+            local_bias = jnp.where(dist >= cfg.local_window, NEG_INF, 0.0)[
+                :, None, :, :
+            ].astype(jnp.float32)
+        return bias, local_bias
+
+    def _embed_h(self, params: Dict, input_ids: Array, positions: Array) -> Array:
+        h = self.embed.apply({"params": params["embed"]}, input_ids, positions)
+        if self.cfg.embed_layernorm:
+            h = self.ln_f.apply({"params": params["ln_embed"]}, h)
+        return h
+
+    def _layer_flags(self, n: int, layer_offset: int) -> Optional[Array]:
+        """1.0 for layers using the local sliding window, else 0.0 — for
+        the n layers starting at layer_offset in the full stack."""
+        cfg = self.cfg
+        if cfg.attn_layers is None or cfg.local_window is None:
+            return None
+        kinds = cfg.attn_layers[layer_offset : layer_offset + n]
+        return jnp.asarray(
+            [1.0 if k == "local" else 0.0 for k in kinds], jnp.float32
+        )
 
     # -- init ------------------------------------------------------------
 
@@ -408,6 +498,8 @@ class TransformerLM:
             "blocks": block_params,
             "ln_f": self.ln_f.init(r_head, h)["params"],
         }
+        if cfg.embed_layernorm:
+            params["ln_embed"] = self.ln_f.init(r_head, h)["params"]
         if self.lm_head is not None:
             params["lm_head"] = self.lm_head.init(r_lm, h)["params"]
         return params
@@ -423,27 +515,36 @@ class TransformerLM:
         cache: Optional[Dict[str, Array]] = None,
         remat: bool = False,
         key_mask: Optional[Array] = None,
+        local_bias: Optional[Array] = None,
+        layer_offset: int = 0,
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
-        """lax.scan over the stacked layer params (and cache layers)."""
+        """lax.scan over the stacked layer params (and cache layers).
+        `layer_offset` locates this slice within the full stack so
+        per-layer attention kinds (gpt-neo global/local) line up."""
+        n = jax.tree_util.tree_leaves(block_params)[0].shape[0]
+        flags = self._layer_flags(n, layer_offset)
 
         def body(hidden, layer):
-            if cache is not None:
-                lp, layer_kv = layer
-                layer_cache = dict(layer_kv, index=cache["index"])
-            else:
-                lp, layer_cache = layer, None
+            lp = layer["p"]
+            bias = attn_bias
+            if flags is not None:
+                bias = bias + layer["flag"] * local_bias
+            layer_cache = (
+                dict(layer["kv"], index=cache["index"]) if cache is not None else None
+            )
             out, new_kv = self.block.apply(
-                {"params": lp}, hidden, attn_bias, positions, layer_cache, key_mask
+                {"params": lp}, hidden, bias, positions, layer_cache, key_mask
             )
             return out, new_kv
 
         if remat:
             body = jax.checkpoint(body, prevent_cse=False)
 
+        xs: Dict[str, Any] = {"p": block_params}
         if cache is not None:
-            xs = (block_params, {"k": cache["k"], "v": cache["v"]})
-        else:
-            xs = block_params
+            xs["kv"] = {"k": cache["k"], "v": cache["v"]}
+        if flags is not None:
+            xs["flag"] = flags
         h, new_kvs = jax.lax.scan(body, h, xs)
         new_cache = None
         if cache is not None:
@@ -477,18 +578,21 @@ class TransformerLM:
                 positions = q_slots[None, :] * jnp.ones((B, 1), jnp.int32)
             within = jnp.arange(S)[None, :] < cache["index"] + T  # [1, S]
             key_mask = (within & (cache["key_mask"] > 0)).astype(jnp.int32)
-            bias = make_attention_bias(key_mask, q_slots, jnp.arange(S))
+            bias, local_bias = self._build_bias(key_mask, q_slots, jnp.arange(S))
             layer_cache = cache
         else:
             if positions is None:
                 positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-            bias = make_attention_bias(attention_mask, jnp.arange(T), jnp.arange(T))
+            bias, local_bias = self._build_bias(
+                attention_mask, jnp.arange(T), jnp.arange(T)
+            )
             layer_cache = None
 
-        h = self.embed.apply({"params": params["embed"]}, input_ids, positions)
+        h = self._embed_h(params, input_ids, positions)
         h, new_cache = self._scan_blocks(
             params["blocks"], h, bias, positions, layer_cache, remat=remat,
             key_mask=None if cache is not None else attention_mask,
+            local_bias=local_bias,
         )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
         logits = self._logits(params, hidden)
@@ -524,16 +628,20 @@ class TransformerLM:
         if attention_mask is None:
             attention_mask = jnp.ones((B, T), jnp.int32)
         positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-        bias = make_attention_bias(attention_mask, jnp.arange(T), jnp.arange(T))
-        h = self.embed.apply({"params": params["embed"]}, input_ids, positions)
+        bias, local_bias = self._build_bias(
+            attention_mask, jnp.arange(T), jnp.arange(T)
+        )
+        h = self._embed_h(params, input_ids, positions)
 
         bottom = jax.tree_util.tree_map(lambda x: x[:branch_at], params["blocks"])
         top = jax.tree_util.tree_map(lambda x: x[branch_at:], params["blocks"])
         h_branch, _ = self._scan_blocks(
-            bottom, h, bias, positions, remat=remat, key_mask=attention_mask
+            bottom, h, bias, positions, remat=remat, key_mask=attention_mask,
+            local_bias=local_bias,
         )
         h_top, _ = self._scan_blocks(
-            top, h_branch, bias, positions, remat=remat, key_mask=attention_mask
+            top, h_branch, bias, positions, remat=remat, key_mask=attention_mask,
+            local_bias=local_bias, layer_offset=branch_at,
         )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h_top)
         logits = self._logits(params, hidden)
@@ -543,6 +651,7 @@ class TransformerLM:
             "branch_hidden": h_branch,
             "positions": positions,
             "attn_bias": bias,
+            "local_bias": local_bias,
         }
 
     def forward_with_multi_capture(
@@ -562,8 +671,10 @@ class TransformerLM:
         if attention_mask is None:
             attention_mask = jnp.ones((B, T), jnp.int32)
         positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-        bias = make_attention_bias(attention_mask, jnp.arange(T), jnp.arange(T))
-        h = self.embed.apply({"params": params["embed"]}, input_ids, positions)
+        bias, local_bias = self._build_bias(
+            attention_mask, jnp.arange(T), jnp.arange(T)
+        )
+        h = self._embed_h(params, input_ids, positions)
 
         captures = []
         prev = 0
@@ -573,7 +684,8 @@ class TransformerLM:
                     lambda x: x[prev:point], params["blocks"]
                 )
                 h, _ = self._scan_blocks(
-                    seg, h, bias, positions, remat=remat, key_mask=attention_mask
+                    seg, h, bias, positions, remat=remat, key_mask=attention_mask,
+                    local_bias=local_bias, layer_offset=prev,
                 )
             if point < self.cfg.n_layer:
                 captures.append(h)
@@ -586,6 +698,7 @@ class TransformerLM:
             "captures": captures,
             "positions": positions,
             "attn_bias": bias,
+            "local_bias": local_bias,
         }
 
     def forward_from_layer(
@@ -595,15 +708,21 @@ class TransformerLM:
         attn_bias: Array,
         positions: Array,
         remat: bool = False,
+        local_bias: Optional[Array] = None,
     ) -> Dict[str, Array]:
         """Run only a top-k branch from a captured hidden state.
 
         `branch_params` holds {"blocks": stacked top-k params, "ln_f",
         "embed", ["lm_head"]} — the frozen in-process reference model
         (parity: hydra `forward_hydra`, reference modeling_ppo.py:410-453).
+        The branch is always the TOP k layers, so per-layer attention
+        kinds are aligned from the end of the stack.
         """
+        k = jax.tree_util.tree_leaves(branch_params["blocks"])[0].shape[0]
         h, _ = self._scan_blocks(
-            branch_params["blocks"], branch_hidden, attn_bias, positions, remat=remat
+            branch_params["blocks"], branch_hidden, attn_bias, positions,
+            remat=remat, local_bias=local_bias,
+            layer_offset=self.cfg.n_layer - k,
         )
         hidden = self.ln_f.apply({"params": branch_params["ln_f"]}, h)
         logits = self._logits(branch_params, hidden)

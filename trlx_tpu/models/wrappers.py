@@ -128,7 +128,9 @@ class CausalLMWithValueHead:
             return apply_head(params["v_head"], out["hidden_states"])[..., 0]
         h = out["v_branch_hidden"]
         h, _ = self.lm._scan_blocks(
-            params["v_branch"]["blocks"], h, out["attn_bias"], out["positions"]
+            params["v_branch"]["blocks"], h, out["attn_bias"], out["positions"],
+            local_bias=out.get("local_bias"),
+            layer_offset=self.value_branch_at,
         )
         hidden = self.lm.ln_f.apply({"params": params["v_branch"]["ln_f"]}, h)
         return apply_head(params["v_head"], hidden)[..., 0]
@@ -210,6 +212,7 @@ class CausalLMWithValueHead:
             out["attn_bias"],
             out["positions"],
             remat=remat,
+            local_bias=out.get("local_bias"),
         )
         return dict(
             out,

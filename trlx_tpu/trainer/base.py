@@ -59,6 +59,12 @@ _DTYPES = {
 }
 
 
+def _batch_shape_key(device_batch) -> tuple:
+    """Hashable leaf-shape signature of a batch pytree; keys both the
+    timing-split probe cache and the compile-step gate."""
+    return tuple(tuple(x.shape) for x in jax.tree_util.tree_leaves(device_batch))
+
+
 class TPUBaseTrainer(BaseRLTrainer):
     """Shared trainer machinery; subclasses provide the algorithm."""
 
@@ -623,9 +629,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         than the scanned train step does."""
         import time as _time
 
-        key = tuple(
-            tuple(x.shape) for x in jax.tree_util.tree_leaves(device_batch)
-        )
+        key = _batch_shape_key(device_batch)
         if key in self._measured_forward_times:
             return self._measured_forward_times[key]
 
@@ -712,10 +716,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                     # skip the split on the first step of each batch shape:
                     # that step_time includes the train-step compile, which
                     # would otherwise be booked entirely under time/backward
-                    shape_key = tuple(
-                        tuple(x.shape)
-                        for x in jax.tree_util.tree_leaves(device_batch)
-                    )
+                    shape_key = _batch_shape_key(device_batch)
                     if self.config.train.timing_split and (
                         shape_key in self._seen_step_shapes
                     ):
