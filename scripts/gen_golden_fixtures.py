@@ -1,0 +1,222 @@
+"""Generate golden-numerics fixtures from the reference implementation.
+
+Runs the reference's pure torch functions (GAE, PPO loss, ILQL loss,
+whiten, RunningMoments, logprobs_of_labels — SURVEY.md §7 "hard parts")
+on seeded inputs and saves the tensors to tests/golden/*.npz.
+tests/test_golden.py then asserts the trlx_tpu ops reproduce them.
+
+This script only runs in the build environment (it imports from
+/root/reference); the committed .npz fixtures are what CI uses. The
+reference's optional deps (torchtyping, deepspeed) are stubbed with
+minimal shims so the pure functions import — no reference code is
+vendored or copied.
+"""
+
+import importlib.machinery
+import sys
+import types
+
+import numpy as np
+import torch
+
+REFERENCE = "/root/reference"
+
+
+def _install_shims():
+    if "torchtyping" not in sys.modules:
+        shim = types.ModuleType("torchtyping")
+
+        class _TensorType:
+            def __class_getitem__(cls, item):
+                return torch.Tensor
+
+        shim.TensorType = _TensorType
+        sys.modules["torchtyping"] = shim
+    # the reference's config modules import trainer modules at package
+    # import time, which drag in cluster-only deps; stub what's missing
+    for name in ("deepspeed", "ray", "ray.air", "ray.air.session", "ray.tune",
+                 "tritonclient", "tritonclient.grpc", "wandb"):
+        if name not in sys.modules:
+            try:
+                __import__(name)
+            except ImportError:
+                mod = types.ModuleType(name)
+                mod.zero = types.SimpleNamespace(GatheredParameters=None)
+                # a None __spec__ breaks importlib.util.find_spec probes
+                # (accelerate runs one on import)
+                mod.__spec__ = importlib.machinery.ModuleSpec(name, loader=None)
+                sys.modules[name] = mod
+
+
+def main(out_dir: str):
+    import os
+
+    _install_shims()
+    sys.path.insert(0, REFERENCE)
+    from trlx.models.modeling_ilql import ILQLConfig
+    from trlx.models.modeling_ppo import PPOConfig
+    from trlx.utils.modeling import RunningMoments, logprobs_of_labels, whiten
+
+    os.makedirs(out_dir, exist_ok=True)
+    torch.manual_seed(0)
+    rng = np.random.default_rng(0)
+
+    # --- whiten -----------------------------------------------------------
+    xs = rng.normal(size=(8, 16)).astype(np.float32)
+    np.savez(
+        os.path.join(out_dir, "whiten.npz"),
+        xs=xs,
+        shifted=whiten(torch.tensor(xs), shift_mean=True).numpy(),
+        unshifted=whiten(torch.tensor(xs), shift_mean=False).numpy(),
+    )
+
+    # --- logprobs_of_labels ----------------------------------------------
+    logits = rng.normal(size=(4, 10, 50)).astype(np.float32) * 3
+    labels = rng.integers(0, 50, size=(4, 10))
+    np.savez(
+        os.path.join(out_dir, "logprobs.npz"),
+        logits=logits,
+        labels=labels,
+        # reference convention: logits[:, :-1] vs labels[:, 1:]
+        out=logprobs_of_labels(
+            torch.tensor(logits)[:, :-1], torch.tensor(labels)[:, 1:]
+        ).numpy(),
+    )
+
+    # --- RunningMoments ---------------------------------------------------
+    rm = RunningMoments()
+    batches = [rng.normal(loc=i, size=(32,)).astype(np.float32) * (1 + i) for i in range(4)]
+    means, stds, run_means, run_stds = [], [], [], []
+    for b in batches:
+        m, s = rm.update(torch.tensor(b))
+        # snapshot as floats: rm.mean becomes a tensor that later updates
+        # mutate in place, so storing the object records only final values
+        means.append(float(m))
+        stds.append(float(s))
+        run_means.append(float(rm.mean))
+        run_stds.append(float(rm.std))
+    np.savez(
+        os.path.join(out_dir, "running_moments.npz"),
+        batches=np.stack(batches),
+        batch_means=np.asarray(means, np.float32),
+        batch_stds=np.asarray(stds, np.float32),
+        running_means=np.asarray(run_means, np.float32),
+        running_stds=np.asarray(run_stds, np.float32),
+    )
+
+    # --- PPO GAE + loss ---------------------------------------------------
+    cfg = PPOConfig(
+        name="PPOConfig", ppo_epochs=4, num_rollouts=128, chunk_size=128,
+        init_kl_coef=0.05, target=6.0, horizon=10000, gamma=0.99, lam=0.95,
+        cliprange=0.2, cliprange_value=0.2, vf_coef=1.0,
+        scale_reward=None, ref_mean=None, ref_std=None,
+        cliprange_reward=10.0, gen_kwargs={},
+    )
+    B, T = 6, 12
+    values_t = rng.normal(size=(B, T)).astype(np.float32)
+    rewards_t = rng.normal(size=(B, T)).astype(np.float32) * 0.1
+    adv, ret = cfg.get_advantages_and_returns(
+        torch.tensor(values_t), torch.tensor(rewards_t), T, use_whitening=True
+    )
+    adv_nw, ret_nw = cfg.get_advantages_and_returns(
+        torch.tensor(values_t), torch.tensor(rewards_t), T, use_whitening=False
+    )
+
+    logprobs = rng.normal(size=(B, T)).astype(np.float32) * 0.5 - 2
+    old_logprobs = logprobs + rng.normal(size=(B, T)).astype(np.float32) * 0.1
+    new_values = values_t + rng.normal(size=(B, T)).astype(np.float32) * 0.3
+    mask = (rng.random((B, T)) > 0.2).astype(np.float32)
+    mask[:, 0] = 1.0
+    loss, stats = cfg.loss(
+        logprobs=torch.tensor(logprobs),
+        values=torch.tensor(new_values),
+        old_logprobs=torch.tensor(old_logprobs),
+        old_values=torch.tensor(values_t),
+        advantages=adv,
+        returns=ret,
+        mask=torch.tensor(mask),
+    )
+    np.savez(
+        os.path.join(out_dir, "ppo.npz"),
+        values=values_t,
+        rewards=rewards_t,
+        advantages=adv.numpy(),
+        returns=ret.numpy(),
+        advantages_nw=adv_nw.numpy(),
+        returns_nw=ret_nw.numpy(),
+        logprobs=logprobs,
+        old_logprobs=old_logprobs,
+        new_values=new_values,
+        mask=mask,
+        loss=float(loss),
+        **{
+            "stat_" + k.replace("/", "__"): np.float32(v)
+            for k, v in stats.items()
+            if np.ndim(v) == 0
+        },
+    )
+
+    # --- ILQL loss --------------------------------------------------------
+    from trlx.data.ilql_types import ILQLBatch
+
+    icfg = ILQLConfig(
+        name="ilqlconfig", tau=0.7, gamma=0.99, cql_scale=0.1, awac_scale=1.0,
+        alpha=0.995, beta=0.5, steps_for_target_q_sync=5, two_qs=True,
+        gen_kwargs={},
+    )
+    B, A, V = 4, 6, 30  # batch, actions, vocab; states = A + 1
+    S = A + 1
+    T_in = S + 1
+    input_ids = rng.integers(0, V, size=(B, T_in))
+    attn = np.ones((B, T_in), np.int64)
+    logits_i = (rng.normal(size=(B, A, V)) * 2).astype(np.float32)
+    qs_i = [(rng.normal(size=(B, A, V))).astype(np.float32) for _ in range(2)]
+    tqs_i = [(rng.normal(size=(B, A, V))).astype(np.float32) for _ in range(2)]
+    vs_i = rng.normal(size=(B, S, 1)).astype(np.float32)
+    rewards_i = (rng.random((B, A)) > 0.8).astype(np.float32)
+    actions_ixs = np.tile(np.arange(A), (B, 1))
+    states_ixs = np.tile(np.arange(S), (B, 1))
+    dones = np.ones((B, S), np.int64)
+    dones[:, -1] = 0
+    batch = ILQLBatch(
+        input_ids=torch.tensor(input_ids),
+        attention_mask=torch.tensor(attn),
+        rewards=torch.tensor(rewards_i),
+        states_ixs=torch.tensor(states_ixs),
+        actions_ixs=torch.tensor(actions_ixs),
+        dones=torch.tensor(dones),
+    )
+    loss_i, stats_i = icfg.loss(
+        (
+            torch.tensor(logits_i),
+            (
+                tuple(torch.tensor(q) for q in qs_i),
+                tuple(torch.tensor(q) for q in tqs_i),
+                torch.tensor(vs_i),
+            ),
+        ),
+        batch,
+    )
+    np.savez(
+        os.path.join(out_dir, "ilql.npz"),
+        input_ids=input_ids,
+        logits=logits_i,
+        q0=qs_i[0], q1=qs_i[1], tq0=tqs_i[0], tq1=tqs_i[1],
+        vs=vs_i,
+        rewards=rewards_i,
+        actions_ixs=actions_ixs,
+        states_ixs=states_ixs,
+        dones=dones,
+        loss=float(loss_i),
+        **{
+            "stat_" + k.replace("/", "__"): np.float32(v)
+            for k, v in stats_i.items()
+            if np.ndim(np.asarray(v)) == 0
+        },
+    )
+
+    print("wrote fixtures to", out_dir)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "tests/golden")

@@ -50,7 +50,10 @@ def test_gae_whitening(rng):
         jnp.array(values), jnp.array(rewards), gamma=1.0, lam=0.95, use_whitening=True
     )
     assert abs(float(adv.mean())) < 1e-5
-    assert abs(float(adv.std()) - 1.0) < 1e-2
+    # whiten uses unbiased variance (reference single-process parity), so
+    # the population std of 40 whitened samples is sqrt(39/40), not 1.0
+    n = adv.size
+    assert abs(float(adv.std()) - np.sqrt((n - 1) / n)) < 1e-3
 
 
 def test_whiten(rng):

@@ -31,6 +31,22 @@ logger = logging.get_logger(__name__)
 # ---------------------------------------------------------------------------
 
 
+def _activation_name(hf_name: str) -> str:
+    """HF activation_function -> TransformerConfig.activation."""
+    table = {
+        "gelu_new": "gelu_new",
+        "gelu_pytorch_tanh": "gelu_new",
+        "gelu_fast": "gelu_new",
+        "gelu": "gelu",
+        "relu": "relu",
+        "silu": "silu",
+        "swish": "silu",
+    }
+    if hf_name not in table:
+        raise ValueError(f"unsupported activation_function {hf_name!r}")
+    return table[hf_name]
+
+
 def config_from_hf(hf_config: Any, dtype=None, param_dtype=None) -> TransformerConfig:
     """Translate a transformers PretrainedConfig into a TransformerConfig."""
     import jax.numpy as jnp
@@ -139,7 +155,7 @@ def config_from_hf(hf_config: Any, dtype=None, param_dtype=None) -> TransformerC
             intermediate_size=hf_config.ffn_dim,
             pos_embed="learned",
             pos_offset=2,
-            activation=hf_config.activation_function,
+            activation=_activation_name(hf_config.activation_function),
             layer_norm_epsilon=1e-5,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
             dtype=dtype,
@@ -174,7 +190,7 @@ def config_from_hf(hf_config: Any, dtype=None, param_dtype=None) -> TransformerC
             n_positions=hf_config.n_positions,
             intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
             pos_embed="learned",
-            activation="gelu_new",
+            activation=_activation_name(hf_config.activation_function),
             layer_norm_epsilon=hf_config.layer_norm_epsilon,
             tie_word_embeddings=True,
             dtype=dtype,
@@ -193,7 +209,7 @@ def config_from_hf(hf_config: Any, dtype=None, param_dtype=None) -> TransformerC
             intermediate_size=hf_config.intermediate_size
             or 4 * hf_config.hidden_size,
             pos_embed="learned",
-            activation="gelu_new",
+            activation=_activation_name(hf_config.activation_function),
             layer_norm_epsilon=hf_config.layer_norm_epsilon,
             attn_scale=1.0,
             local_window=hf_config.window_size,
@@ -756,7 +772,7 @@ def save_pretrained_hf(
 
 
 def state_dict_from_params(params: Dict, cfg: TransformerConfig, model_type: str) -> Dict[str, np.ndarray]:
-    """Inverse of params_from_state_dict (currently gpt2 + llama)."""
+    """Inverse of params_from_state_dict (all supported causal families)."""
     H, D, E = cfg.n_head, cfg.head_dim, cfg.hidden_size
     Hkv = cfg.n_kv_head
     out: Dict[str, np.ndarray] = {}

@@ -51,8 +51,16 @@ def whiten(
     shift_mean: bool = True,
     axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
-    """Normalize to zero mean / unit variance (across the global batch)."""
-    mean, var, _ = _global_mean_var(xs, axis_name)
+    """Normalize to zero mean / unit variance (across the global batch).
+
+    Uses the UNBIASED variance, matching the reference's single-process
+    path (`torch.var_mean` default — utils/modeling.py:212), which is
+    what its published curves were trained with. (The reference's
+    distributed branch divides by N instead — an inconsistency we don't
+    reproduce; golden tests pin the single-process numbers.)
+    """
+    mean, var, count = _global_mean_var(xs, axis_name)
+    var = var * count / jnp.maximum(count - 1, 1.0)
     whitened = (xs - mean) * jax.lax.rsqrt(var + 1e-8)
     if not shift_mean:
         whitened = whitened + mean
