@@ -81,7 +81,11 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # compute dtype inside blocks
     param_dtype: Any = jnp.float32
     # "xla" (let the compiler fuse) | "pallas" (first-party fused kernel
-    # for full teacher-forced forwards; decode steps always use XLA).
+    # for full teacher-forced forwards; decode steps always use XLA) |
+    # "ring" (sequence/context parallelism: teacher-forced forwards run
+    # ops.ring_attention over the mesh's `sp` axis — requires
+    # TransformerLM.mesh to be set and seq divisible by sp; decode steps
+    # and non-plain-bias architectures fall back to XLA).
     # Note: the pallas path's custom_vjp recomputes attention in plain XLA
     # on the backward pass, so gradient-taking forwards (PPO/SFT train
     # steps) see no HBM saving from it — the win is on no-grad forwards
@@ -195,6 +199,7 @@ class Attention(nn.Module):
         positions: Array,  # [B, T] absolute positions (for rope)
         cache: Optional[Dict[str, Array]] = None,  # {"k","v"}: [B, S, Hkv, D], "index"
         key_mask: Optional[Array] = None,  # [B, T]; enables the pallas path
+        ring_mesh=None,  # Mesh; non-None routes to ring attention over `sp`
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
         cfg = self.cfg
         B, T, E = x.shape
@@ -242,7 +247,17 @@ class Attention(nn.Module):
             and cfg.pos_embed != "alibi"
             and cfg.local_window is None
         )
-        if (
+        if ring_mesh is not None:
+            # sequence-parallel path: K/V rotate around the `sp` ring via
+            # ppermute while each shard accumulates its queries' attention
+            # (TransformerLM._ring_mesh gates on plain-bias archs, full
+            # teacher-forced forwards and mesh-divisible shapes)
+            from trlx_tpu.ops.ring_attention import ring_attention_sharded
+
+            out = ring_attention_sharded(
+                q, k, v, ring_mesh, segment_mask=key_mask, causal=True
+            )
+        elif (
             cfg.attention_impl == "pallas"
             and cache is None
             and key_mask is not None
@@ -326,11 +341,12 @@ class Block(nn.Module):
         positions: Array,
         cache: Optional[Dict[str, Array]] = None,
         key_mask: Optional[Array] = None,
+        ring_mesh=None,
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
         cfg = self.cfg
         h = Norm(cfg, name="ln_1")(x)
         attn_out, new_kv = Attention(cfg, name="attn")(
-            h, attn_bias, positions, cache, key_mask
+            h, attn_bias, positions, cache, key_mask, ring_mesh
         )
         if cfg.parallel_residual:
             x = x + attn_out + MLP(cfg, name="mlp")(h)
@@ -433,6 +449,45 @@ class TransformerLM:
         self.block = Block(cfg)
         self.ln_f = Norm(cfg)  # stateless: also applied with ln_embed params
         self.lm_head = None if cfg.tie_word_embeddings else LMHead(cfg)
+        # set by the trainer when cfg.attention_impl == "ring": the device
+        # mesh whose `sp` axis carries the sequence shards
+        self.mesh = None
+
+    def _ring_mesh(self, batch: int, seq: int, cache) -> Optional[Any]:
+        """The mesh to run ring attention over, or None for the XLA/pallas
+        paths. Static (trace-time) decision: ring needs a full
+        teacher-forced forward, a plain causal+padding bias, and shapes
+        divisible by the mesh axes shard_map will split them over."""
+        cfg = self.cfg
+        if cfg.attention_impl != "ring" or self.mesh is None or cache is not None:
+            return None
+        if (
+            cfg.attn_scale is not None
+            or cfg.pos_embed == "alibi"
+            or cfg.local_window is not None
+        ):
+            return None
+        m = self.mesh.shape
+        if m.get("sp", 1) <= 1:
+            return None
+        if (
+            seq % m["sp"]
+            or batch % (m["dp"] * m["fsdp"])
+            or cfg.n_head % m["tp"]
+        ):
+            # sp>1 was requested but this call can't ring-shard — falling
+            # back to full attention materializes the O(T^2) bias the user
+            # configured sp to avoid, so say so (warnings dedupe per site)
+            import warnings
+
+            warnings.warn(
+                f"ring attention requested (sp={m['sp']}) but shapes "
+                f"batch={batch}, seq={seq}, n_head={cfg.n_head} don't divide "
+                f"mesh axes {dict(m)}; falling back to full XLA attention",
+                stacklevel=3,
+            )
+            return None
+        return self.mesh
 
     # -- bias / embedding helpers ---------------------------------------
 
@@ -517,6 +572,7 @@ class TransformerLM:
         key_mask: Optional[Array] = None,
         local_bias: Optional[Array] = None,
         layer_offset: int = 0,
+        ring_mesh=None,
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
         """lax.scan over the stacked layer params (and cache layers).
         `layer_offset` locates this slice within the full stack so
@@ -533,7 +589,8 @@ class TransformerLM:
                 dict(layer["kv"], index=cache["index"]) if cache is not None else None
             )
             out, new_kv = self.block.apply(
-                {"params": lp}, hidden, bias, positions, layer_cache, key_mask
+                {"params": lp}, hidden, bias, positions, layer_cache, key_mask,
+                ring_mesh,
             )
             return out, new_kv
 
@@ -583,9 +640,15 @@ class TransformerLM:
         else:
             if positions is None:
                 positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-            bias, local_bias = self._build_bias(
-                attention_mask, jnp.arange(T), jnp.arange(T)
-            )
+            ring = self._ring_mesh(B, T, cache)
+            if ring is not None:
+                # the ring path masks via per-shard segment masks and global
+                # position comparison — never materialize the [B,1,T,T] bias
+                bias, local_bias = None, None
+            else:
+                bias, local_bias = self._build_bias(
+                    attention_mask, jnp.arange(T), jnp.arange(T)
+                )
             layer_cache = None
 
         h = self._embed_h(params, input_ids, positions)
@@ -593,6 +656,7 @@ class TransformerLM:
             params["blocks"], h, bias, positions, layer_cache, remat=remat,
             key_mask=None if cache is not None else attention_mask,
             local_bias=local_bias,
+            ring_mesh=None if cache is not None else ring,
         )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
         logits = self._logits(params, hidden)
@@ -628,20 +692,24 @@ class TransformerLM:
         if attention_mask is None:
             attention_mask = jnp.ones((B, T), jnp.int32)
         positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-        bias, local_bias = self._build_bias(
-            attention_mask, jnp.arange(T), jnp.arange(T)
-        )
+        ring = self._ring_mesh(B, T, None)
+        if ring is not None:
+            bias, local_bias = None, None
+        else:
+            bias, local_bias = self._build_bias(
+                attention_mask, jnp.arange(T), jnp.arange(T)
+            )
         h = self._embed_h(params, input_ids, positions)
 
         bottom = jax.tree_util.tree_map(lambda x: x[:branch_at], params["blocks"])
         top = jax.tree_util.tree_map(lambda x: x[branch_at:], params["blocks"])
         h_branch, _ = self._scan_blocks(
             bottom, h, bias, positions, remat=remat, key_mask=attention_mask,
-            local_bias=local_bias,
+            local_bias=local_bias, ring_mesh=ring,
         )
         h_top, _ = self._scan_blocks(
             top, h_branch, bias, positions, remat=remat, key_mask=attention_mask,
-            local_bias=local_bias, layer_offset=branch_at,
+            local_bias=local_bias, layer_offset=branch_at, ring_mesh=ring,
         )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h_top)
         logits = self._logits(params, hidden)
@@ -652,6 +720,7 @@ class TransformerLM:
             "positions": positions,
             "attn_bias": bias,
             "local_bias": local_bias,
+            "key_mask": attention_mask,
         }
 
     def forward_with_multi_capture(
@@ -671,9 +740,13 @@ class TransformerLM:
         if attention_mask is None:
             attention_mask = jnp.ones((B, T), jnp.int32)
         positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-        bias, local_bias = self._build_bias(
-            attention_mask, jnp.arange(T), jnp.arange(T)
-        )
+        ring = self._ring_mesh(B, T, None)
+        if ring is not None:
+            bias, local_bias = None, None
+        else:
+            bias, local_bias = self._build_bias(
+                attention_mask, jnp.arange(T), jnp.arange(T)
+            )
         h = self._embed_h(params, input_ids, positions)
 
         captures = []
@@ -685,7 +758,7 @@ class TransformerLM:
                 )
                 h, _ = self._scan_blocks(
                     seg, h, bias, positions, remat=remat, key_mask=attention_mask,
-                    local_bias=local_bias, layer_offset=prev,
+                    local_bias=local_bias, layer_offset=prev, ring_mesh=ring,
                 )
             if point < self.cfg.n_layer:
                 captures.append(h)
@@ -699,6 +772,7 @@ class TransformerLM:
             "positions": positions,
             "attn_bias": bias,
             "local_bias": local_bias,
+            "key_mask": attention_mask,
         }
 
     def forward_from_layer(
@@ -709,6 +783,7 @@ class TransformerLM:
         positions: Array,
         remat: bool = False,
         local_bias: Optional[Array] = None,
+        key_mask: Optional[Array] = None,
     ) -> Dict[str, Array]:
         """Run only a top-k branch from a captured hidden state.
 
@@ -716,13 +791,19 @@ class TransformerLM:
         "embed", ["lm_head"]} — the frozen in-process reference model
         (parity: hydra `forward_hydra`, reference modeling_ppo.py:410-453).
         The branch is always the TOP k layers, so per-layer attention
-        kinds are aligned from the end of the stack.
+        kinds are aligned from the end of the stack. With `attn_bias=None`
+        (ring-attention capture) the padding mask rides in `key_mask`.
         """
         k = jax.tree_util.tree_leaves(branch_params["blocks"])[0].shape[0]
+        ring = None
+        if attn_bias is None and key_mask is not None:
+            B, T = branch_hidden.shape[:2]
+            ring = self._ring_mesh(B, T, None)
         h, _ = self._scan_blocks(
             branch_params["blocks"], branch_hidden, attn_bias, positions,
             remat=remat, local_bias=local_bias,
             layer_offset=self.cfg.n_layer - k,
+            key_mask=key_mask, ring_mesh=ring,
         )
         hidden = self.ln_f.apply({"params": branch_params["ln_f"]}, h)
         logits = self._logits(branch_params, hidden)

@@ -127,10 +127,14 @@ class CausalLMWithValueHead:
         if self.value_branch_at is None:
             return apply_head(params["v_head"], out["hidden_states"])[..., 0]
         h = out["v_branch_hidden"]
+        ring = None
+        if out["attn_bias"] is None:  # ring-attention trunk pass
+            ring = self.lm._ring_mesh(h.shape[0], h.shape[1], None)
         h, _ = self.lm._scan_blocks(
             params["v_branch"]["blocks"], h, out["attn_bias"], out["positions"],
             local_bias=out.get("local_bias"),
             layer_offset=self.value_branch_at,
+            key_mask=out.get("key_mask"), ring_mesh=ring,
         )
         hidden = self.lm.ln_f.apply({"params": params["v_branch"]["ln_f"]}, h)
         return apply_head(params["v_head"], hidden)[..., 0]
@@ -213,6 +217,7 @@ class CausalLMWithValueHead:
             out["positions"],
             remat=remat,
             local_bias=out.get("local_bias"),
+            key_mask=out.get("key_mask"),
         )
         return dict(
             out,

@@ -37,7 +37,11 @@ def _block_attention(q, k, v, bias, m_prev, l_prev, o_prev):
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     s = s + bias
-    m_cur = jnp.max(s, axis=-1)  # [B, H, Tq]
+    # the softmax max-shift cancels analytically (d out / d m == 0), so the
+    # running max is detached: without this, cotangents route through the
+    # max/isfinite/exp chain and turn into NaN via inf*0 on fully-masked
+    # (padding) rows
+    m_cur = jax.lax.stop_gradient(jnp.max(s, axis=-1))  # [B, H, Tq]
     m_new = jnp.maximum(m_prev, m_cur)
     # guard fully-masked rows (all -inf): exp(-inf - -inf) -> keep finite
     m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -73,8 +77,10 @@ def ring_attention(
 
     # derive the accumulators from q so they carry shard_map's
     # device-varying type (fresh constants would be typed as replicated
-    # and fail the scan carry check)
-    qT = q32.transpose(0, 2, 1, 3)  # [B, H, T, D]
+    # and fail the scan carry check); stop_gradient because they are
+    # semantically constants — without it the backward pass routes
+    # cotangents through `m0`'s -inf (inf * 0.0 = NaN in the q grads)
+    qT = jax.lax.stop_gradient(q32.transpose(0, 2, 1, 3))  # [B, H, T, D]
     m0 = qT[..., 0] * 0.0 - jnp.inf
     l0 = qT[..., 0] * 0.0
     o0 = qT * 0.0

@@ -87,6 +87,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         # subclass hook: builds self.model (wrapper), self.params and any
         # auxiliary trees (e.g. PPO's frozen reference branch)
         self.setup_model()
+        # context parallelism: hand the mesh to the model so ring attention
+        # can shard_map teacher-forced forwards over the `sp` axis
+        if self.mesh.shape["sp"] > 1:
+            self._lm().mesh = self.mesh
 
         tx, self.schedule = build_optimizer(config.optimizer, config.scheduler)
         mask = self.trainable_mask()
@@ -155,6 +159,15 @@ class TPUBaseTrainer(BaseRLTrainer):
         extra = mc.model_extra_configs or {}
         if mc.model_arch_type == "seq2seq":
             return self._load_seq2seq_base(mc, extra)
+
+        def finalize(tcfg):
+            # mesh sp>1 means the user asked for context parallelism: switch
+            # the default attention to the ring implementation (an explicit
+            # attention_impl, e.g. "pallas", is respected as-is)
+            if self.mesh.shape["sp"] > 1 and tcfg.attention_impl == "xla":
+                tcfg = tcfg.replace(attention_impl="ring")
+            return tcfg
+
         native_cfg_fp = os.path.join(mc.model_path, "trlx_tpu_config.json")
         if os.path.isdir(mc.model_path) and os.path.exists(native_cfg_fp):
             # native checkpoint (orbax params + architecture json), the
@@ -173,7 +186,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             aux_dir = os.path.join(os.path.abspath(mc.model_path), "aux")
             if os.path.isdir(aux_dir):
                 self._loaded_aux = ocp.PyTreeCheckpointer().restore(aux_dir)
-            return tcfg, params, meta.get("model_type")
+            return finalize(tcfg), params, meta.get("model_type")
         if mc.model_path == "random" or "transformer" in extra:
             tdict = dict(extra.get("transformer", {}))
             tdict.setdefault("vocab_size", getattr(self.tokenizer, "vocab_size", 258))
@@ -182,12 +195,12 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
             self.rng, key = jax.random.split(self.rng)
             params = TransformerLM(tcfg).init(key)
-            return tcfg, params, extra.get("model_type")
+            return finalize(tcfg), params, extra.get("model_type")
         lm, params, model_type = load_pretrained(
             mc.model_path, dtype=self.compute_dtype, param_dtype=self.param_dtype
         )
         self._hf_config_path = mc.model_path
-        return lm.cfg, params, model_type
+        return finalize(lm.cfg), params, model_type
 
     def _load_seq2seq_base(self, mc, extra):
         from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM
@@ -325,11 +338,23 @@ class TPUBaseTrainer(BaseRLTrainer):
     # ------------------------------------------------------------------
 
     def place_batch(self, batch):
-        """Host batch -> device arrays sharded batch-dim over (dp, fsdp)."""
-        sharding = data_sharding(self.mesh)
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(np.asarray(x), sharding), batch
-        )
+        """Host batch -> device arrays sharded batch-dim over (dp, fsdp),
+        and — when the mesh has an `sp` axis — seq-dim over sp for every
+        rank>=2 leaf whose dim 1 divides evenly (context parallelism)."""
+        sp = self.mesh.shape["sp"]
+        base = data_sharding(self.mesh)
+        if sp == 1:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(np.asarray(x), base), batch
+            )
+        seq = data_sharding(self.mesh, shard_seq=True)
+
+        def put(x):
+            x = np.asarray(x)
+            s = seq if (x.ndim >= 2 and x.shape[1] % sp == 0) else base
+            return jax.device_put(x, s)
+
+        return jax.tree_util.tree_map(put, batch)
 
     def data_ways(self) -> int:
         return self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
