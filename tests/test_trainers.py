@@ -202,3 +202,16 @@ def test_kl_controllers():
     adaptive2 = AdaptiveKLController(0.05, target=6.0, horizon=10000)
     adaptive2.update(1.0, 512)  # below target -> coef falls
     assert adaptive2.value < 0.05
+
+
+def test_vocab_size_tokenizer_mismatch_raises(tmp_path):
+    # a tokenizer special id >= model vocab_size would silently NaN the
+    # embedding gather (jnp.take fill mode); setup must raise instead
+    config = default_sft_config().evolve(
+        train=dict(batch_size=4, total_steps=1, tracker=None,
+                   checkpoint_dir=str(tmp_path / "ckpts"), seq_length=12),
+        model=tiny_model_cfg(vocab_size=256),  # byte tokenizer pad/eos id is 257
+        tokenizer=dict(tokenizer_path="byte"),
+    )
+    with pytest.raises(ValueError, match="out of range"):
+        trlx_tpu.train(samples=["a b", "c d"], config=config)

@@ -4,8 +4,14 @@ Parity: /root/reference/trlx/pipeline/ppo_pipeline.py:14-104. The
 reference stores ragged per-sample tensors and pads at collate time;
 rollouts here are born rectangular (PPORolloutBatch — queries left-padded
 to max_prompt_length, responses right-padded to max_new_tokens), so the
-store is row-indexed numpy and collation is pure slicing: zero host
-compute between rollout and train step.
+store is row-indexed and collation is pure slicing: zero host compute
+between rollout and train step.
+
+Rollouts pushed as jax Arrays STAY ON DEVICE: the experience fn's outputs
+are already sharded device arrays, and a device->host round-trip per
+array costs real wall time (over a remote-tunneled TPU it is the single
+largest cost in the rollout loop). Batching then happens by device-side
+gather with a host-generated permutation.
 """
 
 from __future__ import annotations
@@ -16,10 +22,47 @@ import time
 from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from trlx_tpu.data import PPORolloutBatch
 from trlx_tpu.pipeline import BaseRolloutStore, DataLoader
+
+
+class _DeviceGatherLoader:
+    """Minimal loader over a device-resident rectangular pytree: yields
+    `tree[perm[i*b:(i+1)*b]]` device gathers, no host copies.
+
+    Keep the shuffle/drop_last/len semantics in lockstep with
+    `pipeline.DataLoader` — the host and device paths must produce the
+    same batch composition for a given seed."""
+
+    def __init__(self, history, batch_size, shuffle, drop_last, seed):
+        self.history = history
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+
+    def _n(self) -> int:
+        return len(jax.tree_util.tree_leaves(self.history)[0])
+
+    def __len__(self) -> int:
+        n = self._n()
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        n = self._n()
+        order = np.arange(n)
+        if self.shuffle:
+            self._rng.shuffle(order)
+        for start in range(0, n, self.batch_size):
+            idxs = order[start : start + self.batch_size]
+            if self.drop_last and len(idxs) < self.batch_size:
+                return
+            yield jax.tree_util.tree_map(lambda x: x[idxs], self.history)
 
 
 class PPORolloutStorage(BaseRolloutStore):
@@ -31,12 +74,30 @@ class PPORolloutStorage(BaseRolloutStore):
         self.history: Optional[PPORolloutBatch] = None
 
     def push(self, exps: PPORolloutBatch) -> None:
-        exps = jax.tree_util.tree_map(np.asarray, exps)
+        def _on_device(tree) -> bool:
+            return any(
+                isinstance(leaf, jax.Array)
+                for leaf in jax.tree_util.tree_leaves(tree)
+            )
+
+        # residency follows the held history so one mixed push can never
+        # silently download the whole device buffer: a device history
+        # promotes incoming host batches (cheap upload), a host history
+        # demotes incoming device batches
+        if self.history is not None:
+            on_device = _on_device(self.history)
+        else:
+            on_device = _on_device(exps)
+        if on_device:
+            exps = jax.tree_util.tree_map(jnp.asarray, exps)
+        else:
+            exps = jax.tree_util.tree_map(np.asarray, exps)
         if self.history is None:
             self.history = exps
         else:
+            cat = jnp.concatenate if on_device else np.concatenate
             self.history = jax.tree_util.tree_map(
-                lambda a, b: np.concatenate([a, b], axis=0), self.history, exps
+                lambda a, b: cat([a, b], axis=0), self.history, exps
             )
 
     def clear_history(self) -> None:
@@ -53,14 +114,15 @@ class PPORolloutStorage(BaseRolloutStore):
         (parity: reference ppo_pipeline.py:30-49)."""
         os.makedirs(location, exist_ok=True)
         fpath = os.path.join(location, f"epoch-{str(time.time())}.json")
+        history = jax.tree_util.tree_map(np.asarray, self.history)
 
         def exp_to_dict(i: int):
             d = {
-                "query_tensor": self.history.query_tensors[i].tolist(),
-                "response_tensor": self.history.response_tensors[i].tolist(),
-                "logprobs": self.history.logprobs[i].tolist(),
-                "values": self.history.values[i].tolist(),
-                "rewards": self.history.rewards[i].tolist(),
+                "query_tensor": history.query_tensors[i].tolist(),
+                "response_tensor": history.response_tensors[i].tolist(),
+                "logprobs": history.logprobs[i].tolist(),
+                "values": history.values[i].tolist(),
+                "rewards": history.rewards[i].tolist(),
             }
             if tokenizer is not None:
                 d["query"] = tokenizer.decode(d["query_tensor"])
@@ -75,7 +137,14 @@ class PPORolloutStorage(BaseRolloutStore):
 
     def create_loader(
         self, batch_size: int, shuffle: bool = False, drop_last: bool = False, seed: int = 0
-    ) -> DataLoader:
+    ):
+        if self.history is not None and any(
+            isinstance(leaf, jax.Array)
+            for leaf in jax.tree_util.tree_leaves(self.history)
+        ):
+            return _DeviceGatherLoader(
+                self.history, batch_size, shuffle, drop_last, seed
+            )
         return DataLoader(
             self, batch_size, collate_fn=self.collate, shuffle=shuffle,
             drop_last=drop_last, seed=seed,

@@ -166,6 +166,17 @@ class TPUBaseTrainer(BaseRLTrainer):
             # attention_impl, e.g. "pallas", is respected as-is)
             if self.mesh.shape["sp"] > 1 and tcfg.attention_impl == "xla":
                 tcfg = tcfg.replace(attention_impl="ring")
+            # a tokenizer id >= vocab_size would silently fill the embedding
+            # gather with NaN under XLA (jnp.take fill mode) — fail loudly
+            for name in ("pad_token_id", "eos_token_id", "bos_token_id"):
+                tid = getattr(self.tokenizer, name, None)
+                if tid is not None and int(tid) >= tcfg.vocab_size:
+                    raise ValueError(
+                        f"tokenizer {name}={tid} is out of range for model "
+                        f"vocab_size={tcfg.vocab_size}; align the model's "
+                        "vocab_size with the tokenizer (the byte tokenizer "
+                        "needs vocab_size>=258)"
+                    )
             return tcfg
 
         native_cfg_fp = os.path.join(mc.model_path, "trlx_tpu_config.json")
@@ -343,15 +354,14 @@ class TPUBaseTrainer(BaseRLTrainer):
         rank>=2 leaf whose dim 1 divides evenly (context parallelism)."""
         sp = self.mesh.shape["sp"]
         base = data_sharding(self.mesh)
-        if sp == 1:
-            return jax.tree_util.tree_map(
-                lambda x: jax.device_put(np.asarray(x), base), batch
-            )
-        seq = data_sharding(self.mesh, shard_seq=True)
+        seq = data_sharding(self.mesh, shard_seq=True) if sp > 1 else base
 
         def put(x):
-            x = np.asarray(x)
-            s = seq if (x.ndim >= 2 and x.shape[1] % sp == 0) else base
+            # device-resident leaves (the on-device rollout store) reshard
+            # device-to-device; only host leaves pay the upload
+            if not isinstance(x, jax.Array):
+                x = np.asarray(x)
+            s = seq if (sp > 1 and x.ndim >= 2 and x.shape[1] % sp == 0) else base
             return jax.device_put(x, s)
 
         return jax.tree_util.tree_map(put, batch)

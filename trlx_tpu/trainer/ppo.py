@@ -41,7 +41,7 @@ from trlx_tpu.parallel import data_sharding, shard_params
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
-from trlx_tpu.utils import Clock, infinite_loader, logging, to_scalar
+from trlx_tpu.utils import Clock, infinite_loader, logging
 
 logger = logging.get_logger(__name__)
 
@@ -330,11 +330,25 @@ class TPUPPOTrainer(TPUBaseTrainer):
             stats["time/rollout_generate"] = time() - rollout_generate_time
 
             prompt_tensors = np.asarray(batch.input_ids)
-            sequences = np.asarray(gen_out["sequences"])
-            response_ids = np.asarray(gen_out["response_ids"])
-            response_mask = np.asarray(gen_out["response_mask"])
+            # ONE packed device->host fetch: a remote-tunneled chip pays
+            # ~100ms latency PER transfer, so the three generation outputs
+            # ride a single concatenated array
+            seq_w = gen_out["sequences"].shape[1]
+            N = gen_out["response_ids"].shape[1]
+            packed = np.asarray(
+                jnp.concatenate(
+                    [
+                        gen_out["sequences"],
+                        gen_out["response_ids"],
+                        gen_out["response_mask"].astype(gen_out["sequences"].dtype),
+                    ],
+                    axis=1,
+                )
+            )
+            sequences = packed[:, :seq_w]
+            response_ids = packed[:, seq_w : seq_w + N]
+            response_mask = packed[:, seq_w + N :]
             P = prompt_tensors.shape[1]
-            N = response_ids.shape[1]
 
             prompt_sizes = [P] * len(sequences)
             str_samples, str_prompts, str_outputs = self.decode(
@@ -387,13 +401,22 @@ class TPUPPOTrainer(TPUBaseTrainer):
             self.running_moments, scores_mean, scores_std = running_moments_update(
                 self.running_moments, score_sums
             )
-            stats["rollout_scores/mean"] = to_scalar(scores_mean)
-            stats["rollout_scores/std"] = to_scalar(scores_std)
-            stats["rollout_scores/running_mean"] = to_scalar(self.running_moments.mean)
-            stats["rollout_scores/running_std"] = to_scalar(self.running_moments.std)
+            # one fetch for all four score scalars (vs four round-trips)
+            sm, ss, rmean, rstd = np.asarray(
+                jnp.stack(
+                    [
+                        scores_mean, scores_std,
+                        self.running_moments.mean, self.running_moments.std,
+                    ]
+                )
+            ).tolist()
+            stats["rollout_scores/mean"] = sm
+            stats["rollout_scores/std"] = ss
+            stats["rollout_scores/running_mean"] = rmean
+            stats["rollout_scores/running_std"] = rstd
 
             if method.scale_reward == "running":
-                scores /= max(to_scalar(self.running_moments.std), 1e-8)
+                scores /= max(rstd, 1e-8)
             elif method.scale_reward == "ref":
                 scores /= max(self.ref_std, 1e-8)
 
@@ -433,15 +456,20 @@ class TPUPPOTrainer(TPUBaseTrainer):
                     jnp.float32(B),
                 )
             if target != B:
+                # trim the sharding-pad rows ON DEVICE (the store keeps
+                # device-resident rollouts; no host round-trip here)
                 rollout_batch = jax.tree_util.tree_map(
-                    lambda x: np.asarray(x)[:B], rollout_batch
+                    lambda x: x[:B], rollout_batch
                 )
 
-            mean_kl = to_scalar(kl_stats["mean_kl"])
+            # one fetch for both KL scalars
+            mean_kl, mean_kl_per_token = np.asarray(
+                jnp.stack([kl_stats["mean_kl"], kl_stats["mean_kl_per_token"]])
+            ).tolist()
             stats["time/rollout_time"] = clock.tick()
             stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
             stats["policy/kl_per_token"] = float(
-                np.sqrt(max(to_scalar(kl_stats["mean_kl_per_token"]), 0.0))
+                np.sqrt(max(mean_kl_per_token, 0.0))
             )
             accumulated_stats.append(stats)
 
