@@ -1,6 +1,6 @@
 """Benchmark: PPO throughput (samples/sec) on a GPT2-small-class model.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 The driver's north star (BASELINE.json) is GPT2-small PPO sentiments at
 >= 8x the Accelerate-CPU baseline's samples/sec. With zero network
@@ -18,6 +18,13 @@ The baseline is the SAME loop driven through torch/transformers on CPU
 (the reference's Accelerate-CPU configuration), measured once and cached
 in .bench_baseline.json. samples/sec = num_rollouts / (rollout + train
 wall time), steady-state (one warmup cycle first).
+
+Extra keys reported alongside the headline metric:
+  tokens_per_sec  processed tokens (gen + experience + train passes) / s
+  mfu             analytic model FLOPs / wall / peak (bf16) for the chip
+  longctx_*       8k-token fused-attention path: tokens/s through a full
+                  train step with attention_impl="pallas", and the
+                  pallas-vs-XLA speedup of the attention op itself
 """
 
 from __future__ import annotations
@@ -31,9 +38,53 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # GPT2-small geometry
 L, H, HEADS, VOCAB = 12, 768, 12, 50257
 PROMPT_LEN, NEW_TOKENS = 32, 32
-NUM_ROLLOUTS, CHUNK, BATCH, PPO_EPOCHS = 64, 32, 32, 4
+NUM_ROLLOUTS, CHUNK, BATCH, PPO_EPOCHS = 64, 64, 32, 4
+SEQ = PROMPT_LEN + NEW_TOKENS
 
 BASELINE_CACHE = os.path.join(REPO, ".bench_baseline.json")
+
+# bf16 peak per chip by device kind (dense matmul TFLOP/s)
+PEAK_TFLOPS = {"TPU v4": 275.0, "TPU v5 lite": 197.0, "TPU v5": 459.0, "TPU v6 lite": 918.0}
+
+
+def chip_peak_tflops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for key, peak in sorted(PEAK_TFLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(key):
+            return peak
+    return 197.0  # conservative default
+
+
+def fwd_flops_per_token(ctx: int) -> float:
+    """Analytic forward FLOPs/token: 2*(qkvo+mlp+logits params) + score/av
+    matmuls (4*ctx*H per layer)."""
+    matmul_params = 12 * L * H * H + VOCAB * H
+    return 2.0 * matmul_params + 4.0 * ctx * H * L
+
+
+def cycle_flops() -> float:
+    """Model FLOPs for one steady-state PPO cycle (MFU numerator).
+
+    Generation: policy prefill (PROMPT_LEN) + NEW_TOKENS decode steps.
+    Experience: policy AND ref teacher-forced forwards over SEQ.
+    Train: fwd+bwd (3x fwd) over SEQ, policy only (the in-graph ref
+    recompute is dead-code-eliminated), PPO_EPOCHS times.
+    """
+    gen = NUM_ROLLOUTS * (PROMPT_LEN + NEW_TOKENS) * fwd_flops_per_token(SEQ)
+    exp = 2 * NUM_ROLLOUTS * SEQ * fwd_flops_per_token(SEQ)
+    train = 3 * PPO_EPOCHS * NUM_ROLLOUTS * SEQ * fwd_flops_per_token(SEQ)
+    return gen + exp + train
+
+
+def cycle_tokens() -> int:
+    """Token-passes per cycle (tokens/s numerator): every token that goes
+    through a model forward or backward, counted once per pass."""
+    gen = NUM_ROLLOUTS * SEQ  # prefill + decode, policy
+    exp = 2 * NUM_ROLLOUTS * SEQ  # policy + ref
+    train = 2 * PPO_EPOCHS * NUM_ROLLOUTS * SEQ  # fwd + bwd
+    return gen + exp + train
 
 
 class WideByteTokenizer:
@@ -85,7 +136,7 @@ def bench_tpu() -> float:
     config = default_ppo_config().evolve(
         train=dict(
             batch_size=BATCH, total_steps=10_000, eval_interval=10_000,
-            checkpoint_interval=10_000, seq_length=PROMPT_LEN + NEW_TOKENS,
+            checkpoint_interval=10_000, seq_length=SEQ,
             epochs=10_000, tracker=None,
             checkpoint_dir=os.path.join("/tmp", "bench_ckpts"),
             compute_dtype="bfloat16",
@@ -135,6 +186,74 @@ def bench_tpu() -> float:
     cycle()
     dt = time.time() - t0
     return NUM_ROLLOUTS / dt
+
+
+def bench_longctx() -> dict:
+    """Long-context train step (8k tokens) through the fused pallas
+    attention path, plus the attention-op pallas-vs-XLA speedup.
+
+    A [B,H,8k,8k] fp32 score tensor (3.2 GB at B=1,H=12) thrashes HBM on
+    the XLA path; the pallas kernel keeps per-block scores in VMEM, so
+    long-context training is only practical through it. The full-model
+    comparison is therefore run pallas-only and the XLA contrast is
+    measured at the attention-op level where it stays cheap."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+    from trlx_tpu.ops.flash_attention import _attention_reference, flash_attention
+
+    T = 8192
+    out = {}
+
+    # attention op: pallas vs XLA
+    B, NH, D = 1, HEADS, H // HEADS
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, NH, T, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, NH, T, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, NH, T, D), jnp.bfloat16)
+    mask = jnp.ones((B, T), jnp.int32)
+    sm = 1.0 / np.sqrt(D)
+    fx = jax.jit(lambda q, k, v: _attention_reference(q, k, v, mask, True, sm))
+    fp = jax.jit(lambda q, k, v: flash_attention(q, k, v, mask, causal=True))
+
+    def timeit(f, iters=3):
+        f(q, k, v).block_until_ready()
+        t0 = time.time()
+        for _ in range(iters):
+            r = f(q, k, v)
+        r.block_until_ready()
+        return (time.time() - t0) / iters
+
+    t_xla, t_pallas = timeit(fx), timeit(fp)
+    out["longctx_attn_pallas_speedup"] = round(t_xla / t_pallas, 2)
+
+    # full model train step at 8k, pallas path
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, hidden_size=H, n_layer=L, n_head=HEADS,
+        n_positions=T, attention_impl="pallas", dtype=jnp.bfloat16,
+    )
+    lm = TransformerLM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, T), 0, VOCAB)
+    amask = jnp.ones((1, T), jnp.int32)
+
+    def loss(p):
+        o = lm(p, ids, attention_mask=amask)
+        lp = jax.nn.log_softmax(o["logits"].astype(jnp.float32), -1)
+        tgt = jnp.concatenate([ids[:, 1:], ids[:, :1]], 1)
+        return -jnp.take_along_axis(lp, tgt[..., None], -1).mean()
+
+    step = jax.jit(jax.grad(loss))
+    jax.block_until_ready(step(params))
+    t0 = time.time()
+    for _ in range(3):
+        g = step(params)
+    jax.block_until_ready(g)
+    dt = (time.time() - t0) / 3
+    out["longctx_train_tokens_per_sec"] = round(T / dt, 1)
+    return out
 
 
 def bench_torch_cpu() -> float:
@@ -206,6 +325,17 @@ def main():
             json.dump({"samples_per_sec": baseline, "measured_at": time.time()}, f)
 
     value = bench_tpu()
+    dt_cycle = NUM_ROLLOUTS / value
+    tokens_per_sec = cycle_tokens() / dt_cycle
+    mfu = cycle_flops() / dt_cycle / (chip_peak_tflops() * 1e12)
+
+    extras = {}
+    if os.environ.get("BENCH_LONGCTX", "1") != "0":
+        try:
+            extras = bench_longctx()
+        except Exception as exc:  # long-ctx is auxiliary; never sink the bench
+            extras = {"longctx_error": f"{type(exc).__name__}: {exc}"[:200]}
+
     print(
         json.dumps(
             {
@@ -213,6 +343,9 @@ def main():
                 "value": round(value, 3),
                 "unit": "samples/s",
                 "vs_baseline": round(value / baseline, 2) if baseline else None,
+                "tokens_per_sec": round(tokens_per_sec, 1),
+                "mfu": round(mfu, 4),
+                **extras,
             }
         )
     )

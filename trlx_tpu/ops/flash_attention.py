@@ -57,7 +57,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, sm_scale, causal, q_o
         rows = qi * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, S), 0) + q_offset
         cols = jax.lax.broadcasted_iota(jnp.int32, (Bq, S), 1)
         s = jnp.where(rows >= cols, s, NEG_INF)
-    mask = mask_ref[0]  # [S]
+    mask = mask_ref[0, 0]  # [S]
     s = jnp.where(mask[None, :] > 0, s, NEG_INF)
 
     m = jnp.max(s, axis=-1, keepdims=True)
@@ -93,12 +93,16 @@ def _flash_forward(q, k, v, key_mask, causal: bool, sm_scale: float, block_q: in
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S), lambda bh, qi: (bh // H, 0)),
+            # [B, 1, S] so the block's trailing two dims (1, S) equal the
+            # array dims — Mosaic requires trailing block dims divisible
+            # by (8, 128) OR equal to the array's (a bare (1, S) block
+            # over [B, S] fails to lower on real TPU)
+            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh // H, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         interpret=jax.default_backend() == "cpu",
-    )(qr, kr, vr, key_mask.astype(jnp.int32))
+    )(qr, kr, vr, key_mask.astype(jnp.int32)[:, None, :])
     return out.reshape(B, H, T, D)
 
 
