@@ -245,12 +245,16 @@ def bench_longctx() -> dict:
         tgt = jnp.concatenate([ids[:, 1:], ids[:, :1]], 1)
         return -jnp.take_along_axis(lp, tgt[..., None], -1).mean()
 
-    step = jax.jit(jax.grad(loss))
-    jax.block_until_ready(step(params))
+    step = jax.jit(jax.value_and_grad(loss))
+    lv, g = step(params)
+    float(lv)
     t0 = time.time()
     for _ in range(3):
-        g = step(params)
-    jax.block_until_ready(g)
+        lv, g = step(params)
+    # fetch scalars: block_until_ready alone has been observed returning
+    # early over the remote-tunneled chip
+    float(lv)
+    float(jnp.asarray(jax.tree_util.tree_leaves(g)[0]).ravel()[0])
     dt = (time.time() - t0) / 3
     out["longctx_train_tokens_per_sec"] = round(T / dt, 1)
     return out

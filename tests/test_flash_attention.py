@@ -63,3 +63,43 @@ def test_model_forward_parity_pallas_vs_xla():
     np.testing.assert_allclose(
         np.asarray(out_p)[real], np.asarray(out_x)[real], atol=2e-4, rtol=2e-3
     )
+
+
+def test_kernel_gradients_with_padding_and_fully_masked_rows():
+    # left-padded batch: causal + pad creates query rows whose every key
+    # is masked — the regime where a logsumexp-based backward silently
+    # diverges from the reference (fp32 absorbs log(l) at m = -1e30)
+    B, H, T, D = 2, 2, 64, 32
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    m = np.ones((B, T), np.int32)
+    m[:, :19] = 0  # 19 leading pad slots
+    mask = jnp.asarray(m)
+
+    def loss_flash(q_, k_, v_):
+        return (flash_attention(q_, k_, v_, mask) * jnp.arange(D)).sum()
+
+    def loss_ref(q_, k_, v_):
+        return (_attention_reference(q_, k_, v_, mask, True, D**-0.5) * jnp.arange(D)).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+
+def test_kernel_cross_attention_shapes():
+    # T != S (decode-style / cross attention), non-causal, half-masked
+    B, H, T, S, D = 1, 3, 32, 64, 16
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    m = np.ones((B, S), np.int32)
+    m[:, :10] = 0
+    mask = jnp.asarray(m)
+    out = flash_attention(q, k, v, mask, causal=False)
+    ref = _attention_reference(q, k, v, mask, False, D**-0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-4)

@@ -1,20 +1,34 @@
-"""Pallas fused causal attention for TPU.
+"""Pallas fused causal attention for TPU — forward AND backward.
 
 The reference leans on flash/fused attention inside its native deps
 (SURVEY.md §2.9 last row — NeMo/HF kernels). Here the fused kernel is
-first-party Pallas: per (batch*head, q-block) grid cell the scores
-[Bq, S] live only in VMEM — the [B, H, T, S] probability tensor never
-touches HBM, which is the HBM-bandwidth win on TPU (the MXU does the two
-matmuls back to back from VMEM).
+first-party Pallas: per (batch*head, q-block) grid cell, scores are
+computed against key/value *chunks* with an online softmax, so VMEM
+holds only [block_q, chunk] tiles — the [B, H, T, S] probability tensor
+never exists anywhere, which is the HBM-bandwidth win on TPU (the MXU
+runs the two matmuls back to back from VMEM).
 
-Gradient story: the kernel carries a `jax.custom_vjp` whose backward
-recomputes attention with plain XLA ops and differentiates that — the
-training step pays the same FLOPs as the XLA path while every no-grad
-forward (rollout generation prefill, the experience-scoring forward,
-evaluation) runs the fused kernel. Enable with
-`TransformerConfig(attention_impl="pallas")`; CPU tests run the kernel
-in interpreter mode automatically.
-"""
+Backward is fused too (flash-style): the forward emits per-row softmax
+stats, and two pallas kernels recompute probabilities chunkwise from
+(q, k, m, l) to produce dq and (dk, dv). This is what makes 8k+ token
+*training* practical: an XLA recompute path spills a multi-GB score
+tensor per layer.
+
+The softmax stats are saved as (m, l) SEPARATELY, not lse = m + log l:
+fully-masked rows (pure-padding queries) have m = NEG_INF and the fp32
+sum would absorb log(l), breaking the backward's probability
+reconstruction. With (m, l), p = exp(s - m) / l reproduces the
+forward's uniform distribution on those rows exactly, and ds is zeroed
+at masked entries so gradients match the XLA where()-mask reference.
+
+Enable with `TransformerConfig(attention_impl="pallas")`; CPU tests run
+the kernels in interpreter mode automatically.
+
+VMEM budget: full-length K/V (or Q/dO) rows live in VMEM in bf16
+(~1 MB per 8k tokens at D=64) while fp32 tiles are [block, chunk] —
+bounded regardless of sequence length. Sequences beyond ~32k tokens
+should shard the sequence instead (ring attention,
+ops/ring_attention.py)."""
 
 from __future__ import annotations
 
@@ -27,10 +41,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+CHUNK = 512  # key/query chunk for the in-kernel loops
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
 
 
 def _attention_reference(q, k, v, key_mask, causal: bool, sm_scale: float):
-    """Plain XLA attention (backward-pass recompute + numerics oracle)."""
+    """Plain XLA attention (numerics oracle for tests)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * sm_scale
     T, S = s.shape[-2], s.shape[-1]
@@ -43,40 +62,68 @@ def _attention_reference(q, k, v, key_mask, causal: bool, sm_scale: float):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, sm_scale, causal, q_offset):
+def _pick_block(n: int, block: int) -> int:
+    b = min(block, n)
+    while n % b:
+        b //= 2
+    return b
+
+
+def _tile_valid(bq, ck, row0, col0, causal):
+    """validity of a [bq, ck] score tile whose global top-left is
+    (row0, col0) in causal coordinates (rows already q_offset-shifted)."""
+    if not causal:
+        return jnp.ones((bq, ck), jnp.bool_)
+    rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, ck), 0)
+    cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, ck), 1)
+    return rows >= cols
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref,
+    *, sm_scale, causal, q_offset, n_chunks, ck,
+):
+    bq = q_ref.shape[1]
+    D = q_ref.shape[2]
     q = q_ref[0].astype(jnp.float32)  # [Bq, D]
-    k = k_ref[0].astype(jnp.float32)  # [S, D]
-    v = v_ref[0].astype(jnp.float32)  # [S, D]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * sm_scale  # [Bq, S]
+    row0 = pl.program_id(1) * bq + q_offset
 
-    Bq, S = s.shape
-    qi = pl.program_id(1)
-    if causal:
-        rows = qi * Bq + jax.lax.broadcasted_iota(jnp.int32, (Bq, S), 0) + q_offset
-        cols = jax.lax.broadcasted_iota(jnp.int32, (Bq, S), 1)
-        s = jnp.where(rows >= cols, s, NEG_INF)
-    mask = mask_ref[0, 0]  # [S]
-    s = jnp.where(mask[None, :] > 0, s, NEG_INF)
+    def body(j, carry):
+        o_acc, m_run, l_run = carry
+        k_c = k_ref[0, pl.ds(j * ck, ck), :].astype(jnp.float32)  # [ck, D]
+        v_c = v_ref[0, pl.ds(j * ck, ck), :].astype(jnp.float32)
+        mk = mask_ref[0, 0, pl.ds(j * ck, ck)]  # [ck]
+        s = jax.lax.dot_general(
+            q, k_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [Bq, ck]
+        valid = _tile_valid(bq, ck, row0, j * ck, causal) & (mk[None, :] > 0)
+        s = jnp.where(valid, s, NEG_INF)
 
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    ) / jnp.maximum(l, 1e-30)
-    o_ref[0] = o.astype(o_ref.dtype)
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_run - m_new)
+        p = jnp.exp(s - m_new)  # [Bq, ck]
+        l_new = l_run * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o_acc * corr + jax.lax.dot_general(
+            p, v_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((bq, D), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_chunks, body, (o0, m0, l0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m_ref[0] = m
+    l_ref[0] = l
 
 
-def _flash_forward(q, k, v, key_mask, causal: bool, sm_scale: float, block_q: int):
+def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, with_stats=False):
     B, H, T, D = q.shape
     S = k.shape[2]
     if key_mask is None:
         key_mask = jnp.ones((B, S), jnp.int32)
-    bq = min(block_q, T)
-    while T % bq:
-        bq //= 2
+    bq = _pick_block(T, block_q)
+    ck = _pick_block(S, CHUNK)
     grid = (B * H, T // bq)
 
     qr = q.reshape(B * H, T, D)
@@ -84,9 +131,10 @@ def _flash_forward(q, k, v, key_mask, causal: bool, sm_scale: float, block_q: in
     vr = v.reshape(B * H, S, D)
 
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, causal=causal, q_offset=S - T
+        _flash_kernel, sm_scale=sm_scale, causal=causal, q_offset=S - T,
+        n_chunks=S // ck, ck=ck,
     )
-    out = pl.pallas_call(
+    out, m, l = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -99,11 +147,190 @@ def _flash_forward(q, k, v, key_mask, causal: bool, sm_scale: float, block_q: in
             # over [B, S] fails to lower on real TPU)
             pl.BlockSpec((1, 1, S), lambda bh, qi: (bh // H, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qr, kr, vr, key_mask.astype(jnp.int32)[:, None, :])
+    out = out.reshape(B, H, T, D)
+    if with_stats:
+        return out, m, l
+    return out
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, m_ref, l_ref, delta_ref, dq_ref,
+    *, sm_scale, causal, q_offset, n_chunks, ck,
+):
+    bq = q_ref.shape[1]
+    D = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)  # [Bq, D]
+    do = do_ref[0].astype(jnp.float32)  # [Bq, D]
+    m = m_ref[0]  # [Bq, 1]
+    l = jnp.maximum(l_ref[0], 1e-30)
+    delta = delta_ref[0]  # [Bq, 1]
+    row0 = pl.program_id(1) * bq + q_offset
+
+    def body(j, dq_acc):
+        k_c = k_ref[0, pl.ds(j * ck, ck), :].astype(jnp.float32)  # [ck, D]
+        v_c = v_ref[0, pl.ds(j * ck, ck), :].astype(jnp.float32)
+        mk = mask_ref[0, 0, pl.ds(j * ck, ck)]
+        s = jax.lax.dot_general(
+            q, k_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [Bq, ck]
+        valid = _tile_valid(bq, ck, row0, j * ck, causal) & (mk[None, :] > 0)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - m) / l  # [Bq, ck]
+        dp = jax.lax.dot_general(
+            do, v_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bq, ck]
+        # masked entries carry no gradient into s (the reference's
+        # where() routes their cotangent to the NEG_INF constant);
+        # explicit zeroing matters on fully-masked rows where p is
+        # uniform, not ~0
+        ds = jnp.where(valid, p * (dp - delta) * sm_scale, 0.0)
+        return dq_acc + jax.lax.dot_general(
+            ds, k_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, n_chunks, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, m_ref, l_ref, delta_ref, dk_ref, dv_ref,
+    *, sm_scale, causal, q_offset, n_chunks, cq,
+):
+    """dk/dv for one key block. Works in TRANSPOSED orientation
+    ([Bk, cq] score tiles) so the per-row stats stream in lane-major
+    [1, T] layout — a [T, 1] operand would be lane-padded to [T, 128]
+    in VMEM (4 MB per stat at 8k tokens), which blows the budget."""
+    bk = k_ref.shape[1]
+    D = k_ref.shape[2]
+    k = k_ref[0].astype(jnp.float32)  # [Bk, D]
+    v = v_ref[0].astype(jnp.float32)
+    col0 = pl.program_id(1) * bk
+    mk = mask_ref[0, 0, pl.ds(col0, bk)]  # [Bk]
+
+    def body(j, carry):
+        dk_acc, dv_acc = carry
+        q_c = q_ref[0, pl.ds(j * cq, cq), :].astype(jnp.float32)  # [cq, D]
+        do_c = do_ref[0, pl.ds(j * cq, cq), :].astype(jnp.float32)
+        m_c = m_ref[0, 0, pl.ds(j * cq, cq)]  # [cq] (lane vector)
+        l_c = jnp.maximum(l_ref[0, 0, pl.ds(j * cq, cq)], 1e-30)
+        delta_c = delta_ref[0, 0, pl.ds(j * cq, cq)]
+        s_t = jax.lax.dot_general(
+            k, q_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [Bk, cq]
+        rows = col0 + jax.lax.broadcasted_iota(jnp.int32, (bk, cq), 0)  # key idx
+        cols = j * cq + q_offset + jax.lax.broadcasted_iota(jnp.int32, (bk, cq), 1)
+        valid = (cols >= rows) if causal else jnp.ones((bk, cq), jnp.bool_)
+        valid = valid & (mk[:, None] > 0)
+        s_t = jnp.where(valid, s_t, NEG_INF)
+        p_t = jnp.exp(s_t - m_c[None, :]) / l_c[None, :]  # [Bk, cq]
+        dv_new = dv_acc + jax.lax.dot_general(
+            p_t, do_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bk, D]
+        dp_t = jax.lax.dot_general(
+            v, do_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bk, cq]
+        ds_t = jnp.where(valid, p_t * (dp_t - delta_c[None, :]) * sm_scale, 0.0)
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds_t, q_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bk, D]
+        return dk_new, dv_new
+
+    z = jnp.zeros((bk, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, n_chunks, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, key_mask, o, m, l, g, causal, sm_scale, block_q):
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    if key_mask is None:
+        key_mask = jnp.ones((B, S), jnp.int32)
+    mask3 = key_mask.astype(jnp.int32)[:, None, :]
+
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+    dor = g.reshape(B * H, T, D)
+    # delta_i = rowsum(dO_i * O_i): tiny elementwise pass, fine in XLA
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * o.reshape(B * H, T, D).astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )  # [BH, T, 1]
+
+    bq = _pick_block(T, block_q)
+    ck = _pick_block(S, CHUNK)
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, sm_scale=sm_scale, causal=causal, q_offset=S - T,
+            n_chunks=S // ck, ck=ck,
+        ),
+        grid=(B * H, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh // H, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        interpret=jax.default_backend() == "cpu",
-    )(qr, kr, vr, key_mask.astype(jnp.int32)[:, None, :])
-    return out.reshape(B, H, T, D)
+        interpret=_interpret(),
+    )(qr, kr, vr, mask3, dor, m, l, delta)
+
+    bk = _pick_block(S, block_q)
+    cq = _pick_block(T, CHUNK)
+    # lane-major stat views for the dkv kernel (see its docstring)
+    m_t = m.reshape(B * H, 1, T)
+    l_t = l.reshape(B * H, 1, T)
+    delta_t = delta.reshape(B * H, 1, T)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, sm_scale=sm_scale, causal=causal, q_offset=S - T,
+            n_chunks=T // cq, cq=cq,
+        ),
+        grid=(B * H, S // bk),
+        in_specs=[
+            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki: (bh // H, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(qr, kr, vr, mask3, dor, m_t, l_t, delta_t)
+
+    return (
+        dq.reshape(B, H, T, D),
+        dk.reshape(B, H, S, D),
+        dv.reshape(B, H, S, D),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -121,19 +348,19 @@ def flash_attention(q, k, v, key_mask, causal=True, sm_scale=None, block_q=128):
 def _fwd(q, k, v, key_mask, causal, sm_scale, block_q):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    out = _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q)
-    return out, (q, k, v, key_mask)
+    out, m, l = _flash_forward(
+        q, k, v, key_mask, causal, sm_scale, block_q, with_stats=True
+    )
+    return out, (q, k, v, key_mask, out, m, l)
 
 
 def _bwd(causal, sm_scale, block_q, res, g):
-    q, k, v, key_mask = res
+    q, k, v, key_mask, o, m, l = res
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_reference(q_, k_, v_, key_mask, causal, sm_scale),
-        q, k, v,
+    dq, dk, dv = _flash_backward(
+        q, k, v, key_mask, o, m, l, g, causal, sm_scale, block_q
     )
-    dq, dk, dv = vjp(g)
     return dq, dk, dv, None
 
 
