@@ -215,3 +215,35 @@ def test_vocab_size_tokenizer_mismatch_raises(tmp_path):
     )
     with pytest.raises(ValueError, match="out of range"):
         trlx_tpu.train(samples=["a b", "c d"], config=config)
+
+
+@pytest.mark.slow
+def test_ppo_fused_inner_loop(tmp_path):
+    # train.fused_inner_loop runs all ppo_epochs x minibatches as one
+    # jitted scan; learn() must still checkpoint, eval and converge on
+    # finite losses
+    ckpt_dir = str(tmp_path / "ckpts")
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=4, eval_interval=2, checkpoint_interval=2,
+            seq_length=12, epochs=4, tracker=None, checkpoint_dir=ckpt_dir,
+            fused_inner_loop=True,
+        ),
+        model=tiny_model_cfg(num_layers_unfrozen=1),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=16, chunk_size=8, ppo_epochs=2,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    prompts = ["hello world", "the cat", "a b", "xyz", "what is", "I am", "go", "ok"]
+    trainer = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=prompts, config=config
+    )
+    assert trainer.iter_count >= 4
+    names = sorted(os.listdir(ckpt_dir))
+    assert "best_checkpoint" in names
+    metrics_fp = os.path.join(ckpt_dir, "logs", "metrics.jsonl")
+    recs = [json.loads(line) for line in open(metrics_fp)]
+    losses = [r["losses/total_loss"] for r in recs if "losses/total_loss" in r]
+    assert losses and all(np.isfinite(l) for l in losses)

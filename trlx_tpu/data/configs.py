@@ -169,6 +169,13 @@ class TrainConfig(_Section):
     # and emits `time/forward` = that measurement and `time/backward` =
     # step - forward, matching the reference's metric keys.
     timing_split: bool = False
+    # Run ALL inner-epoch optimizer steps as one jitted lax.scan over
+    # minibatch permutations instead of one dispatch per minibatch
+    # (trainers that hold the epoch's data as a rectangular batch — PPO's
+    # rollout store — support this; others fall back to the per-step
+    # loop). Removes per-step dispatch latency and host syncs; per-step
+    # metric granularity collapses to per-block means.
+    fused_inner_loop: bool = False
 
 
 _SECTIONS: Tuple[Tuple[str, type], ...] = (

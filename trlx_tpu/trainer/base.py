@@ -139,6 +139,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
 
         self._train_step = None  # built lazily (jitted)
+        self._fused_train_step = None  # built lazily (jitted inner loop)
         self._measured_forward_times = {}  # timing_split probes by batch shape
         self._seen_step_shapes = set()  # batch shapes whose step has compiled
         self._generate_fns: Dict[Tuple, Callable] = {}
@@ -608,53 +609,182 @@ class TPUBaseTrainer(BaseRLTrainer):
     # the training loop
     # ------------------------------------------------------------------
 
-    def make_train_step(self):
-        """One jitted function: microbatch scan -> mean grads -> masked
-        optimizer update. Donates params/opt_state."""
+    def _step_update(self, params, opt_state, batch):
+        """Pure (jit-traceable) single optimizer step: microbatch scan ->
+        mean grads -> masked optimizer update."""
         loss_fn = self.loss
         num_mb, mb_size = self.num_mb, self.mb_size
         tx = self.tx
 
-        def train_step(params, opt_state, batch):
-            def compute(p, b):
-                return jax.value_and_grad(loss_fn, has_aux=True)(p, b)
+        def compute(p, b):
+            return jax.value_and_grad(loss_fn, has_aux=True)(p, b)
 
-            if num_mb == 1:
-                (loss, stats), grads = compute(params, batch)
-            else:
-                mbs = jax.tree_util.tree_map(
-                    lambda x: x.reshape((num_mb, mb_size) + x.shape[1:]), batch
-                )
-                first = jax.tree_util.tree_map(lambda x: x[0], mbs)
-                (l_shape, s_shape), g_shape = jax.eval_shape(compute, params, first)
-                zeros = jax.tree_util.tree_map(
-                    lambda s: jnp.zeros(s.shape, s.dtype), (g_shape, l_shape, s_shape)
-                )
+        if num_mb == 1:
+            (loss, stats), grads = compute(params, batch)
+        else:
+            mbs = jax.tree_util.tree_map(
+                lambda x: x.reshape((num_mb, mb_size) + x.shape[1:]), batch
+            )
+            first = jax.tree_util.tree_map(lambda x: x[0], mbs)
+            (l_shape, s_shape), g_shape = jax.eval_shape(compute, params, first)
+            zeros = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), (g_shape, l_shape, s_shape)
+            )
 
-                def body(acc, mb):
-                    (l, s), g = compute(params, mb)
-                    return jax.tree_util.tree_map(jnp.add, acc, (g, l, s)), None
+            def body(acc, mb):
+                (l, s), g = compute(params, mb)
+                return jax.tree_util.tree_map(jnp.add, acc, (g, l, s)), None
 
-                (g_sum, l_sum, s_sum), _ = jax.lax.scan(body, zeros, mbs)
-                grads = jax.tree_util.tree_map(lambda x: x / num_mb, g_sum)
-                loss = l_sum / num_mb
-                stats = jax.tree_util.tree_map(lambda x: x / num_mb, s_sum)
+            (g_sum, l_sum, s_sum), _ = jax.lax.scan(body, zeros, mbs)
+            grads = jax.tree_util.tree_map(lambda x: x / num_mb, g_sum)
+            loss = l_sum / num_mb
+            stats = jax.tree_util.tree_map(lambda x: x / num_mb, s_sum)
 
-            updates, new_opt_state = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            return new_params, new_opt_state, loss, stats
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, loss, stats
 
+    def _pinned_state_shardings(self):
         # Pin output shardings to the current (input) shardings: without
         # this, GSPMD may choose different layouts for the step-1 outputs,
         # and the changed input shardings force a full retrace+recompile of
         # the train step on step 2.
         params_sh = jax.tree_util.tree_map(lambda x: x.sharding, self.params)
         opt_sh = jax.tree_util.tree_map(lambda x: x.sharding, self.opt_state)
+        return params_sh, opt_sh
+
+    def make_train_step(self):
+        """One jitted function per optimizer step. Donates params/opt_state."""
+        params_sh, opt_sh = self._pinned_state_shardings()
         return jax.jit(
-            train_step,
+            self._step_update,
             donate_argnums=(0, 1),
             out_shardings=(params_sh, opt_sh, None, None),
         )
+
+    def make_fused_train_steps(self):
+        """The whole inner loop as ONE jitted call: scan the optimizer
+        step over host-chosen minibatch permutations of a device-resident
+        epoch batch.
+
+        Dispatch cost is per-call, not per-step — on a remote-tunneled
+        chip each dispatch costs 100ms+, and even locally the XLA launch
+        overhead and the per-step host sync disappear. The reference
+        pays this per minibatch by construction (torch eager loop).
+
+        Signature: (params, opt_state, full_batch, perms[n_steps, bs])
+        -> (params, opt_state, mean_loss, mean_stats)."""
+
+        def fused(params, opt_state, full_batch, perms):
+            def body(carry, perm):
+                p, o = carry
+                mb = jax.tree_util.tree_map(lambda x: x[perm], full_batch)
+                p, o, loss, stats = self._step_update(p, o, mb)
+                return (p, o), (loss, stats)
+
+            (params, opt_state), (losses, stats) = jax.lax.scan(
+                body, (params, opt_state), perms
+            )
+            mean_stats = jax.tree_util.tree_map(
+                lambda x: jnp.mean(x, axis=0), stats
+            )
+            return params, opt_state, jnp.mean(losses), mean_stats
+
+        params_sh, opt_sh = self._pinned_state_shardings()
+        return jax.jit(
+            fused,
+            donate_argnums=(0, 1),
+            out_shardings=(params_sh, opt_sh, None, None),
+        )
+
+    def _fused_epoch_batch(self):
+        """Override to enable `train.fused_inner_loop`: return the full
+        inner-epoch training batch as a (pytree, n_rows) pair, or None
+        when the trainer cannot provide one (streaming pipelines)."""
+        return None
+
+    def _learn_fused(self, fused_src, best_reward, results):
+        """All inner epochs in one device call (see make_fused_train_steps).
+
+        Checkpoint/eval interval checks fire when a boundary is crossed
+        inside the fused block — same cadence as the unfused loop up to
+        quantization to block ends."""
+        import time as _time
+
+        full, n = fused_src
+        bs = self.config.train.batch_size
+        n_batches = max(n // bs, 1)
+        steps_left = max(self.total_steps - self.iter_count, 1)
+        rng = np.random.default_rng(self.iter_count)
+        perm_rows = []
+        for _ in range(self.n_inner_epochs):
+            order = rng.permutation(n)[: n_batches * bs]
+            perm_rows.extend(order.reshape(n_batches, bs))
+        perms = np.asarray(perm_rows[:steps_left], np.int32)
+        n_steps = len(perms)
+
+        if self._fused_train_step is None:
+            self._fused_train_step = self.make_fused_train_steps()
+        device_full = self.place_batch(full)
+        t0 = _time.time()
+        with self.mesh:
+            self.params, self.opt_state, loss, stats = self._fused_train_step(
+                self.params, self.opt_state, device_full, jnp.asarray(perms)
+            )
+        # ONE host fetch for loss + every scalar stat
+        keys = [k for k in stats if np.ndim(stats[k]) == 0]
+        packed = np.asarray(jnp.stack([loss] + [stats[k] for k in keys]))
+        elapsed = _time.time() - t0
+        stats = {k: float(v) for k, v in zip(keys, packed[1:])}
+        stats["time/step"] = elapsed / n_steps
+        stats["learning_rate_group_0"] = float(self.schedule(self.iter_count))
+
+        prev = self.iter_count
+        self.iter_count += n_steps
+        for _ in range(self.n_inner_epochs):
+            self.post_backward_callback()
+
+        def crossed(interval: int) -> bool:
+            return (prev // interval) != (self.iter_count // interval) or (
+                self.iter_count >= self.total_steps
+            )
+
+        if crossed(self.config.train.checkpoint_interval):
+            subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
+            directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
+            logger.info("Saving checkpoint into %s", directory)
+            if self.config.train.save_optimizer:
+                self.save(directory)
+            self.save_pretrained(os.path.join(directory, "hf_model"))
+
+        if crossed(self.config.train.eval_interval):
+            results = self.evaluate()
+            stats.update(results)
+            if self.config.train.save_best:
+                reward = stats.get(
+                    "reward/mean", stats.get("metrics/reward", -float("inf"))
+                )
+                if reward > best_reward:
+                    best_reward = reward
+                    directory = os.path.join(
+                        self.config.train.checkpoint_dir, "best_checkpoint"
+                    )
+                    logger.info("Saving best checkpoint into %s", directory)
+                    if self.config.train.save_optimizer:
+                        self.save(directory)
+                    self.save_pretrained(os.path.join(directory, "hf_model"))
+
+        desc = " | ".join(
+            f"{k}: {v:.2f}"
+            for k, v in stats.items()
+            if k.startswith("losses/") or k == "loss"
+        )
+        logger.info(
+            "[step %d/%d] (fused x%d) %s",
+            self.iter_count, self.total_steps, n_steps, desc,
+        )
+        self.tracker.log(stats, step=self.iter_count)
+        return results, best_reward, self.iter_count >= self.total_steps
 
     def _measure_forward(self, device_batch) -> float:
         """Time a jitted loss-only (forward) pass, once per batch shape
@@ -722,6 +852,19 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         clock = Clock()
         for _ in range(self.config.train.epochs):
+            fused_src = (
+                self._fused_epoch_batch()
+                if self.config.train.fused_inner_loop
+                else None
+            )
+            if fused_src is not None:
+                results, best_reward, done = self._learn_fused(
+                    fused_src, best_reward, results
+                )
+                if done:
+                    return results
+                self.post_epoch_callback()
+                continue
             for _ in range(self.n_inner_epochs):
                 train_dataloader = self.create_train_dataloader()
                 for batch in train_dataloader:

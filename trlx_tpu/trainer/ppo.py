@@ -534,6 +534,13 @@ class TPUPPOTrainer(TPUBaseTrainer):
     def post_backward_callback(self) -> None:
         self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
 
+    def _fused_epoch_batch(self):
+        # the rollout store is a rectangular (device-resident) pytree:
+        # the whole ppo_epochs x minibatch loop can run as one fused scan
+        if self.store.history is None or len(self.store) == 0:
+            return None
+        return self.store.history, len(self.store)
+
     def post_epoch_callback(self) -> None:
         if self.log_rollouts:
             self.store.export_history(self.rollout_logging_dir, self.tokenizer)
