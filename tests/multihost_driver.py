@@ -1,0 +1,69 @@
+"""Subprocess driver for the multi-host test: one OS process per
+simulated host, 4 CPU devices each, wired together with
+jax.distributed. Run via tests/test_multihost.py, not directly.
+
+Usage: python multihost_driver.py <process_id> <num_processes> <port> <workdir>
+"""
+
+import os
+import sys
+
+pid, nproc, port, workdir = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from trlx_tpu.parallel import multihost as mh
+
+mh.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
+)
+assert jax.process_count() == nproc, jax.process_count()
+assert len(jax.devices()) == 4 * nproc, len(jax.devices())
+
+import numpy as np
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import default_ppo_config
+
+ckpt_dir = os.path.join(workdir, "ckpts")
+config = default_ppo_config().evolve(
+    train=dict(
+        batch_size=8, total_steps=3, eval_interval=2, checkpoint_interval=2,
+        seq_length=16, epochs=3, tracker=None, checkpoint_dir=ckpt_dir,
+        mesh={"dp": -1},
+    ),
+    model=dict(
+        model_path="random", num_layers_unfrozen=-1,
+        model_extra_configs={
+            "transformer": dict(hidden_size=16, n_layer=2, n_head=2, n_positions=64)
+        },
+    ),
+    tokenizer=dict(tokenizer_path="byte"),
+    method=dict(
+        num_rollouts=16, chunk_size=8, ppo_epochs=1,
+        gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+    ),
+)
+
+
+def reward_fn(samples, prompts, outputs, **kw):
+    return [float(len(o.split())) for o in outputs]
+
+
+prompts = ["hello world", "the cat", "a b c", "xyz w", "what is", "I am", "go on", "ok then"]
+trainer = trlx_tpu.train(reward_fn=reward_fn, prompts=prompts, config=config)
+
+assert trainer.iter_count >= 3, trainer.iter_count
+# every process must agree on the (replicated) final params
+leaf = jax.tree_util.tree_leaves(trainer.params)[0]
+val = float(np.sum(np.abs(np.asarray(mh.allgather(leaf)))))
+print(f"MULTIHOST_OK pid={pid} iter={trainer.iter_count} paramsum={val:.6f}")

@@ -81,6 +81,11 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def vector_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a rank-1 [batch] array (per-sample scores/sums)."""
+    return NamedSharding(mesh, P(("dp", "fsdp")))
+
+
 def local_batch_size(mesh: Mesh, global_batch: int) -> int:
     """Per-data-shard batch (dp*fsdp ways)."""
     ways = mesh.shape["dp"] * mesh.shape["fsdp"]

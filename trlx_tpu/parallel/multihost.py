@@ -1,0 +1,229 @@
+"""Multi-host (multi-process) SPMD execution.
+
+The reference scales out with torch.distributed/NCCL choreography:
+rank-0 scatters rollout chunks, gathers decoded strings and scores, and
+re-broadcasts tensors (accelerate_ppo_trainer.py:292-341,
+nemo_ppo_trainer.py:344-362). The TPU-native shape of the same thing is
+data-parallel SPMD over a global mesh: every process runs the SAME
+program; jitted computation sees GLOBAL arrays (GSPMD inserts the
+collectives); only host-side work (tokenize, decode, reward fns) is
+per-process, operating on the rows whose device shards live on this
+host.
+
+The helpers here are the complete host<->global bridge:
+
+  initialize()            wire up jax.distributed (no-op single-host)
+  shard_list(xs)          this process's strided slice of a host list
+  global_from_local(t, s) per-process local rows -> one global array
+  local_rows(arr)         this process's rows of a global batch array
+  allgather(x)            host-side values -> full np array everywhere
+  is_main()               gate for tracker/checkpoint-metadata writes
+
+Mesh layout note: jax.devices() orders devices process-major, and
+make_mesh lays axes (dp, fsdp, tp, sp) major-to-minor, so batch rows
+land on processes in contiguous blocks — `local_rows` of a
+(dp, fsdp)-sharded batch is exactly the [p*B/P, (p+1)*B/P) row block,
+matching what `global_from_local` assembled. tp/sp shards of the same
+rows stay host-local, riding ICI not DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Wire up jax.distributed. On TPU pods with the standard launcher
+    env (TPU_WORKER_HOSTNAMES etc.) all arguments auto-detect; pass them
+    explicitly for manual/CPU-simulated launches. No-op when already
+    initialized or when running single-process."""
+    if num_processes is not None and num_processes <= 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:  # already initialized
+        if "already" not in str(e).lower():
+            raise
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def is_main() -> bool:
+    return jax.process_index() == 0
+
+
+def shard_list(items: Sequence[Any]) -> list:
+    """This process's strided slice of a host-side list (prompts, eval
+    rows). Strided (not blocked) so truncated datasets stay balanced;
+    padded by wrap-around so every process holds the same count (SPMD
+    programs must run in lockstep — a short process would deadlock the
+    collectives)."""
+    p, n = jax.process_index(), jax.process_count()
+    if n == 1:
+        return list(items)
+    local = list(items[p::n])
+    want = (len(items) + n - 1) // n
+    i = 0
+    while len(local) < want:
+        local.append(items[(p + i * n) % len(items)])
+        i += 1
+    return local
+
+
+def shard_pipeline(pipeline):
+    """Per-process view of an indexable pipeline: this process's strided
+    slice of the rows, same collate/loader behavior. No-op single-host."""
+    if not is_multihost():
+        return pipeline
+    import copy
+
+    clone = copy.copy(pipeline)
+    if hasattr(pipeline, "prompts"):
+        clone.prompts = shard_list(pipeline.prompts)
+        return clone
+    idxs = shard_list(list(range(len(pipeline))))
+
+    class _View(type(pipeline)):
+        def __init__(self):  # bypass the parent tokenizing __init__
+            self.__dict__.update(clone.__dict__)
+            self._idxs = idxs
+
+        def __len__(self):
+            return len(self._idxs)
+
+        def __getitem__(self, i):
+            return pipeline[self._idxs[i]]
+
+    return _View()
+
+
+def global_from_local(tree, sharding):
+    """Per-process local row blocks -> one global array per leaf.
+
+    `sharding` is the target NamedSharding for the GLOBAL batch (e.g.
+    data_sharding(mesh)); each process contributes len(global)/P rows."""
+    if not is_multihost():
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), sharding), tree
+        )
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)
+        ),
+        tree,
+    )
+
+
+def local_rows(arr) -> np.ndarray:
+    """This process's contiguous row block of a global [B, ...] batch
+    array (the rows whose data lives on this host's devices)."""
+    if not isinstance(arr, jax.Array):
+        return np.asarray(arr)
+    if arr.is_fully_replicated or not is_multihost():
+        return np.asarray(arr)
+    shards = {}
+    for s in arr.addressable_shards:
+        start = s.index[0].start or 0
+        if start not in shards:
+            shards[start] = np.asarray(s.data)
+    rows = [shards[k] for k in sorted(shards)]
+    out = np.concatenate(rows, axis=0)
+    # replicated-over-(tp, sp) shards can still cover full columns; when
+    # the batch dim is the only sharded one this is simply the row block
+    return out
+
+
+def allgather(x) -> np.ndarray:
+    """Host-side numeric values -> the full global np array, on every
+    process. For global jax Arrays this is an all-gather to replicated;
+    for host arrays it concatenates per-process contributions in process
+    order."""
+    if not is_multihost():
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    if isinstance(x, jax.Array):
+        if x.is_fully_replicated:
+            return np.asarray(x)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return np.asarray(
+            jax.jit(
+                lambda a: a,
+                out_shardings=NamedSharding(x.sharding.mesh, PartitionSpec()),
+            )(x)
+        )
+    return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+
+
+def broadcast_flag(value: bool) -> bool:
+    """Process 0's bool, agreed on every process (keeps data-dependent
+    control flow deterministic across hosts)."""
+    if not is_multihost():
+        return bool(value)
+    from jax.experimental import multihost_utils
+
+    return bool(
+        multihost_utils.broadcast_one_to_all(np.int32(1 if value else 0))
+    )
+
+
+def barrier(name: str) -> None:
+    """Host-level sync point (coordination service, not a device
+    collective). Placed around host-divergent sections (checkpoint file
+    IO, exports) so one process can't race ahead and enqueue device
+    collectives that interleave with the laggard's — XLA dispatch is
+    async, so python-thread position and in-flight collectives are
+    otherwise unordered across hosts."""
+    if not is_multihost():
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def gather_params(tree):
+    """Materialize a (possibly fsdp/tp-sharded) param tree as host numpy
+    on EVERY process (collective: all processes must call). Used by the
+    HF-export path, which needs full tensors to write."""
+    if not is_multihost():
+        return jax.device_get(tree)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    meshes = {
+        x.sharding.mesh
+        for x in jax.tree_util.tree_leaves(tree)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable
+    }
+    if not meshes:
+        return jax.device_get(tree)
+    mesh = meshes.pop()
+    # ONE jitted identity program replicating every leaf: the collectives
+    # ride a single deterministic XLA executable on all processes (a
+    # per-leaf host gather would issue N independent collectives, which
+    # is slower and fragile against interleaving with other collectives)
+    rep = jax.jit(
+        lambda t: t, out_shardings=NamedSharding(mesh, PartitionSpec())
+    )(tree)
+    return jax.device_get(rep)

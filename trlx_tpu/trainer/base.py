@@ -45,6 +45,7 @@ from trlx_tpu.parallel import (
     make_mesh,
     shard_params,
 )
+from trlx_tpu.parallel import multihost as mh
 from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import Clock, build_optimizer, logging, significant, to_scalar
 from trlx_tpu.utils.tokenizers import load_tokenizer
@@ -370,6 +371,18 @@ class TPUBaseTrainer(BaseRLTrainer):
     def data_ways(self) -> int:
         return self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
 
+    def local_ways(self) -> int:
+        """Row-divisibility requirement for THIS process's block of a
+        global batch (multi-host: each process contributes 1/P of the
+        rows; mesh layout keeps those rows on this host's devices)."""
+        ways, pc = self.data_ways(), mh.process_count()
+        if ways % pc:
+            raise ValueError(
+                f"dp*fsdp={ways} must be divisible by process_count={pc} "
+                "(each host must own whole data shards)"
+            )
+        return ways // pc
+
     @staticmethod
     def pad_rows(arr: np.ndarray, target_rows: int) -> np.ndarray:
         """Pad the leading dim to `target_rows` by repeating the last row."""
@@ -439,25 +452,38 @@ class TPUBaseTrainer(BaseRLTrainer):
         # eval batch then reuses the cached executable instead of
         # recompiling the whole decode loop
         B, P = input_ids.shape
-        target = B + (-B) % self.data_ways()
+        pc = mh.process_count()
+        target = B + (-B) % self.local_ways()
+        # cache keys hold GLOBAL row counts; compare in local terms
         compiled = [
-            shape[0]
+            shape[0] // pc
             for (s, shape) in self._generate_fns
-            if s == settings and shape[1] == P and shape[0] >= target
+            if s == settings and shape[1] == P and shape[0] // pc >= target
         ]
         if compiled:
             target = min(compiled)
+        if target != B and mh.is_multihost():
+            # a per-process pad would sit INSIDE the global batch (each
+            # host owns a contiguous row block), so the [:B] trim below
+            # can't remove it — demand clean shapes instead
+            raise ValueError(
+                f"multi-host generation needs batch rows ({B} per process) "
+                f"divisible by local data ways ({self.local_ways()})"
+            )
         if target != B:
             input_ids = self.pad_rows(input_ids, target)
             attention_mask = self.pad_rows(attention_mask, target)
         with self.mesh:
-            fn = self._get_generate_fn(settings, input_ids.shape)
+            # generate fns trace over GLOBAL row counts: shape keys are
+            # the global batch shape
+            gshape = (input_ids.shape[0] * pc, input_ids.shape[1])
+            fn = self._get_generate_fn(settings, gshape)
             self.rng, key = jax.random.split(self.rng)
             sharding = data_sharding(self.mesh)
             out = fn(
                 self.params,
-                jax.device_put(input_ids, sharding),
-                jax.device_put(attention_mask, sharding),
+                mh.global_from_local(input_ids, sharding),
+                mh.global_from_local(attention_mask, sharding),
                 key,
             )
         if target != B:
@@ -543,10 +569,12 @@ class TPUBaseTrainer(BaseRLTrainer):
             for batch in self.eval_dataloader:
                 kwargs = {sweep_arg: sweep_value} if sweep_value is not None else {}
                 out = self.generate_eval(batch.input_ids, batch.attention_mask, **kwargs)
-                sequences = np.asarray(out["sequences"])
+                # multi-host: decode/score only this host's rows; scalar
+                # stats are all-gathered below
+                sequences = mh.local_rows(out["sequences"])
                 all_samples.extend(sequences)
                 all_prompts.extend(np.asarray(batch.input_ids))
-                all_sizes.extend([batch.input_ids.shape[1]] * len(sequences))
+                all_sizes.extend([np.shape(batch.input_ids)[1]] * len(sequences))
                 for k, v in (batch.metadata or {}).items():
                     all_metadata.setdefault(k, []).extend(v)
             stats["time/generate"] = _time.time() - generate_time
@@ -570,7 +598,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                 ]
                 columns.append("reward")
                 columns_data.append(rewards)
-                stats[f"reward/mean{suffix}"] = float(np.mean(rewards))
+                stats[f"reward/mean{suffix}"] = float(
+                    np.mean(mh.allgather(np.asarray(rewards, np.float32)))
+                )
             if self.metric_fn:
                 metric_time = _time.time()
                 metrics = self.metric_fn(
@@ -580,7 +610,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                 stats["time/metric"] = _time.time() - metric_time
                 stats.update(
                     {
-                        f"metrics/{k}{suffix}": float(np.mean(xs))
+                        f"metrics/{k}{suffix}": float(
+                            np.mean(mh.allgather(np.asarray(xs, np.float32)))
+                        )
                         for k, xs in metrics.items()
                     }
                 )
@@ -967,11 +999,15 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
         ckptr = ocp.PyTreeCheckpointer()
+        # orbax writes distributed arrays collectively: every process
+        # calls save (each persists its shards); only process 0 writes
+        # the scalar metadata
         ckptr.save(
             os.path.join(directory, "state"), self._state_tree(), force=True
         )
-        with open(os.path.join(directory, "state.json"), "w") as f:
-            json.dump({"iter_count": self.iter_count}, f)
+        if mh.is_main():
+            with open(os.path.join(directory, "state.json"), "w") as f:
+                json.dump({"iter_count": self.iter_count}, f)
 
     def load(self, directory: Optional[str] = None) -> None:
         import orbax.checkpoint as ocp
@@ -998,20 +1034,29 @@ class TPUBaseTrainer(BaseRLTrainer):
         )
         os.makedirs(directory, exist_ok=True)
         base = self.params.get("base", self.params)
-        base = jax.device_get(base)
+        # all processes join the gather (collective); process 0 writes
+        base = mh.gather_params(base)
         # auxiliary heads (value / Q) ride alongside the deploy artifact so
         # an ILQL/PPO policy reloads losslessly (the HF export itself stays
         # base-only for from_pretrained parity, reference :526-553)
         aux = {k: v for k, v in self.params.items() if k != "base"}
         if aux:
+            aux = mh.gather_params(aux)
             import orbax.checkpoint as ocp
 
+            # orbax save is COLLECTIVE (internal sync_global_devices):
+            # every process must call it, even though only the primary
+            # writes
             ocp.PyTreeCheckpointer().save(
-                os.path.join(directory, "aux"), jax.device_get(aux), force=True
+                os.path.join(directory, "aux"), aux, force=True
             )
         model_type = getattr(self, "model_type", None)
         exported = False
-        if model_type is not None and getattr(self, "_hf_config_path", None):
+        if (
+            model_type is not None
+            and getattr(self, "_hf_config_path", None)
+            and mh.is_main()
+        ):
             try:
                 import transformers
 
@@ -1020,6 +1065,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                 exported = True
             except Exception as e:
                 logger.warning("HF export failed (%s); saving orbax params", e)
+        # all processes must agree on the fallback (the orbax save below
+        # is collective)
+        exported = mh.broadcast_flag(exported)
         if not exported:
             import dataclasses
 
@@ -1028,20 +1076,25 @@ class TPUBaseTrainer(BaseRLTrainer):
             ocp.PyTreeCheckpointer().save(
                 os.path.join(directory, "params"), base, force=True
             )
-            tcfg = {
-                k: v
-                for k, v in dataclasses.asdict(self.model.cfg).items()
-                if k not in ("dtype", "param_dtype") and v is not None
-            }
-            arch_key = (
-                "seq2seq"
-                if self.config.model.model_arch_type == "seq2seq"
-                else "transformer"
-            )
-            with open(os.path.join(directory, "trlx_tpu_config.json"), "w") as f:
-                json.dump({arch_key: tcfg, "model_type": model_type}, f)
-        if hasattr(self.tokenizer, "save_pretrained"):
+            if mh.is_main():
+                tcfg = {
+                    k: v
+                    for k, v in dataclasses.asdict(self.model.cfg).items()
+                    if k not in ("dtype", "param_dtype") and v is not None
+                }
+                arch_key = (
+                    "seq2seq"
+                    if self.config.model.model_arch_type == "seq2seq"
+                    else "transformer"
+                )
+                with open(os.path.join(directory, "trlx_tpu_config.json"), "w") as f:
+                    json.dump({arch_key: tcfg, "model_type": model_type}, f)
+        if mh.is_main() and hasattr(self.tokenizer, "save_pretrained"):
             self.tokenizer.save_pretrained(directory)
+        # wait out process 0's plain-file writes: racing ahead would let
+        # a process enqueue device collectives that interleave with the
+        # laggard's
+        mh.barrier("save_pretrained")
 
 
 # ---------------------------------------------------------------------------

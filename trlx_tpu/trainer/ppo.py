@@ -38,6 +38,8 @@ from trlx_tpu.ops.common import (
 )
 from trlx_tpu.ops.ppo import gae_advantages_and_returns, ppo_loss
 from trlx_tpu.parallel import data_sharding, shard_params
+from trlx_tpu.parallel import multihost as mh
+from trlx_tpu.parallel.mesh import vector_sharding
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
@@ -335,7 +337,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             # ride a single concatenated array
             seq_w = gen_out["sequences"].shape[1]
             N = gen_out["response_ids"].shape[1]
-            packed = np.asarray(
+            packed = mh.local_rows(
                 jnp.concatenate(
                     [
                         gen_out["sequences"],
@@ -394,7 +396,12 @@ class TPUPPOTrainer(TPUBaseTrainer):
             if method.cliprange_reward:
                 scores = np.clip(scores, -method.cliprange_reward, method.cliprange_reward)
 
-            score_sums = jnp.asarray((scores * scores_mask).sum(axis=1))
+            # local per-row sums -> one GLOBAL vector; the running-moment
+            # update then reduces over every host's rows in-graph (the
+            # reference all-gathers scores to rank 0 instead)
+            score_sums = mh.global_from_local(
+                (scores * scores_mask).sum(axis=1), vector_sharding(self.mesh)
+            )
             if self.ref_mean is None:
                 self.ref_mean = float(score_sums.mean())
                 self.ref_std = float(score_sums.std())
@@ -421,9 +428,17 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 scores /= max(self.ref_std, 1e-8)
 
             # pad rows to the data-parallel multiple for sharding; the
-            # extra rows are trimmed off the rollout batch afterwards
+            # extra rows are trimmed off the rollout batch afterwards.
+            # multi-host: B counts LOCAL rows; padding would land inside
+            # the global batch, so clean divisibility is required (the
+            # generate() call above already enforced it)
             B = len(sequences)
-            target = B + (-B) % self.data_ways()
+            target = B + (-B) % self.local_ways()
+            if mh.is_multihost() and target != B:
+                raise ValueError(
+                    f"multi-host rollout rows ({B} per process) must divide "
+                    f"local data ways ({self.local_ways()})"
+                )
 
             def rpad(x):
                 return self.pad_rows(x, target)
@@ -448,12 +463,12 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 rollout_batch, kl_stats = exp_fn(
                     self.params,
                     self.ref_params,
-                    *[jax.device_put(a, sharding) for a in args],
-                    jax.device_put(rpad(response_mask), sharding),
-                    jax.device_put(rpad(scores), sharding),
-                    jax.device_put(rpad(scores_mask), sharding),
+                    *[mh.global_from_local(a, sharding) for a in args],
+                    mh.global_from_local(rpad(response_mask), sharding),
+                    mh.global_from_local(rpad(scores), sharding),
+                    mh.global_from_local(rpad(scores_mask), sharding),
                     jnp.float32(self.kl_ctl.value),
-                    jnp.float32(B),
+                    jnp.float32(B * mh.process_count()),
                 )
             if target != B:
                 # trim the sharding-pad rows ON DEVICE (the store keeps
@@ -474,7 +489,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             accumulated_stats.append(stats)
 
             self.push_to_store(rollout_batch)
-            n_collected += len(sequences)
+            n_collected += len(sequences) * mh.process_count()
             logger.info("[rollout %d / %d]", n_collected, num_rollouts)
 
         stats = {
@@ -502,9 +517,15 @@ class TPUPPOTrainer(TPUBaseTrainer):
             f.write(json.dumps(config.to_dict(), indent=2))
 
     def add_prompt_pipeline(self, pipeline) -> None:
+        # multi-host: each process iterates its own strided slice of the
+        # prompts at chunk_size/P rows; generation reassembles the global
+        # chunk (the reference scatters from rank 0 instead —
+        # accelerate_ppo_trainer.py:292-341)
+        pipeline = mh.shard_pipeline(pipeline)
+        chunk = max(self.config.method.chunk_size // mh.process_count(), 1)
         # drop_last keeps chunk shapes static: one compiled sampler
         loader = pipeline.create_loader(
-            self.config.method.chunk_size, shuffle=True, drop_last=True,
+            chunk, shuffle=True, drop_last=True,
             seed=self.config.train.seed,
         )
         if len(loader) == 0:
@@ -514,8 +535,8 @@ class TPUPPOTrainer(TPUBaseTrainer):
         self.prompt_iterator = infinite_loader(loader)
 
     def prepare_learning(self) -> None:
-        self.eval_dataloader = self.eval_pipeline.create_loader(
-            self.config.method.chunk_size
+        self.eval_dataloader = mh.shard_pipeline(self.eval_pipeline).create_loader(
+            max(self.config.method.chunk_size // mh.process_count(), 1)
         )
         self.make_experience(self.config.method.num_rollouts)
         self.n_inner_epochs = self.config.method.ppo_epochs

@@ -41,10 +41,22 @@ class Tracker:
         self.logging_dir = train.logging_dir or os.path.join(
             train.checkpoint_dir, "logs"
         )
-        os.makedirs(self.logging_dir, exist_ok=True)
-        self._jsonl = open(os.path.join(self.logging_dir, "metrics.jsonl"), "a")
         self._tb = None
         self._wandb = None
+        self._jsonl = None
+        # multi-host: only process 0 writes (parity: reference gates all
+        # trackers on accelerator.is_main_process)
+        try:
+            import jax
+
+            self.enabled = jax.process_index() == 0
+        except Exception:
+            self.enabled = True
+        if not self.enabled:
+            self.backend = None
+            return
+        os.makedirs(self.logging_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(self.logging_dir, "metrics.jsonl"), "a")
 
         if self.backend == "tensorboard":
             try:
@@ -75,6 +87,8 @@ class Tracker:
             )
 
     def log(self, stats: Dict[str, Any], step: int) -> None:
+        if self._jsonl is None:  # non-main process
+            return
         scalars = {k: float(v) for k, v in stats.items() if isinstance(v, Number)}
         rec = dict(scalars, _step=step, _time=time.time())
         self._jsonl.write(json.dumps(rec) + "\n")
@@ -86,7 +100,8 @@ class Tracker:
             self._wandb.log(stats, step=step)
 
     def close(self) -> None:
-        self._jsonl.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
         if self._tb is not None:
             self._tb.close()
         if self._wandb is not None:
