@@ -73,6 +73,13 @@ def train(
     max_prompt_length = config.train.seq_length - config.method.gen_kwargs.get(
         "max_new_tokens", 0
     )
+    if max_prompt_length <= 0:
+        raise ValueError(
+            f"train.seq_length ({config.train.seq_length}) must exceed "
+            f"gen_kwargs['max_new_tokens'] "
+            f"({config.method.gen_kwargs.get('max_new_tokens', 0)}): prompts "
+            "would be truncated to zero tokens"
+        )
 
     # --- online ----------------------------------------------------------
     if reward_fn:

@@ -110,6 +110,8 @@ def generate(
     rng: jax.Array,
     settings: SamplerSettings,
     logits_processor: Optional[Callable[[Array, Array], Array]] = None,
+    soft_prompt: Optional[Array] = None,  # [n, E] prompt-tuning tokens
+    kv_prefix: Optional[Dict[str, Array]] = None,  # prefix-tuning k/v
 ) -> Dict[str, Array]:
     """Sample up to `settings.max_new_tokens` continuations.
 
@@ -120,23 +122,65 @@ def generate(
 
     `logits_processor(hidden_last, logits) -> logits` (both [B, ...]) runs
     before temperature/top-k/top-p — the ILQL advantage-shaping hook.
+
+    Adapters warm the KV cache: soft-prompt tokens run one extra prefill
+    segment over slots [0, n); kv prefixes are written into the cache
+    directly. Either way the prompt then occupies slots [n, n+P) and
+    sampled tokens follow — the decode loop is adapter-oblivious.
     """
     B, P = input_ids.shape
     N = settings.max_new_tokens
     if N < 1:
         raise ValueError("max_new_tokens must be >= 1")
-    total = P + N
+    n_virt = 0
+    if soft_prompt is not None:
+        n_virt = soft_prompt.shape[0]
+    elif kv_prefix is not None:
+        n_virt = kv_prefix["k"].shape[1]
+    total = n_virt + P + N
 
     # response slots count as attendable keys once written
     key_mask = jnp.concatenate(
-        [attention_mask.astype(jnp.int32), jnp.ones((B, N), jnp.int32)], axis=1
+        [
+            jnp.ones((B, n_virt), jnp.int32),
+            attention_mask.astype(jnp.int32),
+            jnp.ones((B, N), jnp.int32),
+        ],
+        axis=1,
     )
     cache = model.init_cache(B, total, key_mask)
+    if kv_prefix is not None:
+        L = cache["k"].shape[0]
 
-    # real positions (rope/wpe) run over non-pad tokens only
-    positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+        def tiled(x):
+            return jnp.broadcast_to(
+                x[:, None], (L, B) + x.shape[1:]
+            ).astype(cache["k"].dtype)
+
+        cache = dict(
+            cache,
+            k=jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], tiled(kv_prefix["k"]), 0, axis=2
+            ),
+            v=jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], tiled(kv_prefix["v"]), 0, axis=2
+            ),
+            index=jnp.int32(n_virt),
+        )
+    elif soft_prompt is not None:
+        warm = model(
+            params,
+            jnp.zeros((B, n_virt), input_ids.dtype),
+            cache=cache,
+            prefix_embeds=soft_prompt,
+        )
+        cache = warm["cache"]
+
+    # real positions (rope/wpe) run over non-pad tokens only, offset past
+    # any virtual prefix (HF past-length semantics)
+    positions = n_virt + jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
     out = model(params, input_ids, attention_mask, positions=positions, cache=cache)
-    prompt_len = attention_mask.sum(axis=1)  # [B] real lengths
+    prompt_len = n_virt + attention_mask.sum(axis=1)  # [B] next real position
 
     def pick_next(rng, hidden_last, logits_last, finished):
         if logits_processor is not None:

@@ -39,21 +39,12 @@ DEFAULT_TARGETS = r"blocks/attn/[qkv]/kernel$|blocks/attn/o/kernel$"
 
 def normalize_peft_config(peft_config: Any) -> Dict[str, Any]:
     """Accept a dict in the HF peft style ({"peft_type": "LORA", "r": 8,
-    "lora_alpha": 16, ...}) and normalize to our fields."""
-    if peft_config is None:
-        return None
-    cfg = dict(peft_config)
-    peft_type = str(cfg.get("peft_type", "LORA")).upper()
-    if peft_type != "LORA":
-        raise ValueError(
-            f"peft_type {peft_type!r} not supported (LORA only); the reference's "
-            "PROMPT_TUNING/PREFIX_TUNING variants are not implemented"
-        )
-    return {
-        "r": int(cfg.get("r", 8)),
-        "alpha": float(cfg.get("lora_alpha", cfg.get("alpha", 16))),
-        "targets": cfg.get("target_modules") or DEFAULT_TARGETS,
-    }
+    "lora_alpha": 16, ...}) and normalize to our fields. Delegates to
+    models/peft.py, which owns the full adapter surface (LORA |
+    PROMPT_TUNING | PREFIX_TUNING)."""
+    from trlx_tpu.models.peft import normalize_peft_config as _norm
+
+    return _norm(peft_config)
 
 
 def _path_str(path) -> str:

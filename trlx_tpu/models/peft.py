@@ -1,0 +1,96 @@
+"""Parameter-efficient fine-tuning adapters: LoRA, prompt tuning and
+prefix tuning, first-party and TPU-shaped.
+
+Parity: the reference delegates to HF `peft`
+(/root/reference/trlx/models/modeling_base.py:124-275 threads
+peft_config through from_pretrained; /root/reference/tests/test_peft.py
+is the contract — note the reference itself only exercises
+{LORA, PROMPT_TUNING, PREFIX_TUNING} x causal and LORA x seq2seq, since
+peft 0.3.0's seq2seq prompt/prefix variants were broken).
+
+Adapter param layouts (all live beside "base" in the trainer's param
+tree; the base stays frozen via the update mask):
+
+  lora    {path: {"a": [L?, in, r], "b": [L?, r, out]}}  (models/lora.py)
+  prompt  {"embedding": [n_virtual, E]}    soft tokens, run as real
+                                           leading sequence positions
+  prefix  {"k": [L, n_virtual, Hkv, D],    direct per-layer key/values,
+           "v": [L, n_virtual, Hkv, D]}    realized as a pre-warmed
+                                           pseudo KV cache
+
+The model-side mechanics live in TransformerLM.__call__
+(prefix_embeds / kv_prefix kwargs) and models/generation.py (cache
+warm-up)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.lora import DEFAULT_TARGETS, init_lora_params  # noqa: F401
+
+
+def normalize_peft_config(peft_config: Any) -> Optional[Dict[str, Any]]:
+    """Accept an HF-peft-style dict ({"peft_type": ..., ...}) and
+    normalize to our fields. Returns None for no adapter."""
+    if peft_config is None:
+        return None
+    cfg = dict(peft_config)
+    peft_type = str(cfg.get("peft_type", "LORA")).upper()
+    if peft_type == "LORA":
+        return {
+            "peft_type": "LORA",
+            "r": int(cfg.get("r", 8)),
+            "alpha": float(cfg.get("lora_alpha", cfg.get("alpha", 16))),
+            "targets": cfg.get("target_modules") or DEFAULT_TARGETS,
+        }
+    if peft_type in ("PROMPT_TUNING", "PREFIX_TUNING"):
+        return {
+            "peft_type": peft_type,
+            "num_virtual_tokens": int(cfg.get("num_virtual_tokens", 10)),
+        }
+    raise ValueError(
+        f"peft_type {peft_type!r} not supported "
+        "(LORA | PROMPT_TUNING | PREFIX_TUNING)"
+    )
+
+
+def init_prompt_params(rng: jax.Array, cfg, n_virtual: int) -> Dict[str, jnp.ndarray]:
+    """Soft-token embeddings ~ N(0, 0.02) ([RANDOM] init, the reference
+    test's prompt_tuning_init)."""
+    return {
+        "embedding": jax.random.normal(
+            rng, (n_virtual, cfg.hidden_size), jnp.float32
+        )
+        * 0.02
+    }
+
+
+def init_prefix_params(rng: jax.Array, cfg, n_virtual: int) -> Dict[str, jnp.ndarray]:
+    """Per-layer key/value prefixes ~ N(0, 0.02), stacked over layers to
+    match the scan-stacked block params."""
+    n_kv = cfg.n_kv_head or cfg.n_head
+    head_dim = cfg.head_dim or cfg.hidden_size // cfg.n_head
+    k_rng, v_rng = jax.random.split(rng)
+    shape = (cfg.n_layer, n_virtual, n_kv, head_dim)
+    return {
+        "k": jax.random.normal(k_rng, shape, jnp.float32) * 0.02,
+        "v": jax.random.normal(v_rng, shape, jnp.float32) * 0.02,
+    }
+
+
+ADAPTER_KEYS = ("lora", "prompt", "prefix")
+
+
+def adapter_call_kwargs(params: Dict) -> Dict[str, Any]:
+    """kwargs for TransformerLM.__call__ from a wrapper param tree —
+    threads prompt/prefix adapters into the forward (LoRA merges into
+    the base weights instead, see wrappers._effective_base)."""
+    kw = {}
+    if "prompt" in params:
+        kw["prefix_embeds"] = params["prompt"]["embedding"]
+    if "prefix" in params:
+        kw["kv_prefix"] = params["prefix"]
+    return kw

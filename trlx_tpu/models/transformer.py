@@ -619,15 +619,68 @@ class TransformerLM:
         positions: Optional[Array] = None,
         cache: Optional[Dict[str, Array]] = None,
         remat: bool = False,
+        prefix_embeds: Optional[Array] = None,  # [n, E] prompt tuning
+        kv_prefix: Optional[Dict[str, Array]] = None,  # {k,v}: [L, n, Hkv, D]
     ) -> Dict[str, Array]:
         """Full forward. Without `cache`: plain teacher-forced pass over a
         (possibly left-padded) sequence. With `cache`: the input occupies
         cache slots [index, index+T) and attends over the cache prefix —
         the same entry point serves prefill (T=prompt_len) and decode
-        (T=1)."""
+        (T=1).
+
+        Adapters (teacher-forced paths; generation warms the KV cache
+        instead — see models/generation.py):
+        - `prefix_embeds` (PROMPT tuning): n trainable soft tokens run as
+          real leading sequence positions; outputs keep [B, T] shapes
+          (the virtual rows are sliced off after the blocks).
+        - `kv_prefix` (PREFIX tuning): trainable per-layer key/values,
+          realized as a pre-warmed pseudo-cache so the attention path is
+          untouched. Real-token positions shift by n in both cases
+          (HF peft past-length semantics)."""
         B, T = input_ids.shape
         if attention_mask is None:
             attention_mask = jnp.ones((B, T), jnp.int32)
+        n_virtual = 0  # rows to slice off the outputs (prompt tuning)
+        if prefix_embeds is not None and cache is None:
+            # teacher-forced prompt tuning: soft tokens become real
+            # leading positions; callers keep [B, T] output shapes
+            n_virtual = prefix_embeds.shape[0]
+            input_ids = jnp.concatenate(
+                [jnp.zeros((B, n_virtual), input_ids.dtype), input_ids], axis=1
+            )
+            attention_mask = jnp.concatenate(
+                [jnp.ones((B, n_virtual), jnp.int32), attention_mask], axis=1
+            )
+            positions = None  # recomputed over the extended mask below
+            T = T + n_virtual
+        if kv_prefix is not None and cache is None:
+            # prefix tuning: trainable per-layer k/v realized as a
+            # pre-warmed pseudo-cache occupying slots [0, n); the input
+            # occupies [n, n+T) so the attention path is untouched
+            n = kv_prefix["k"].shape[1]
+            S = n + T
+            shape = (self.cfg.n_layer, B, S) + kv_prefix["k"].shape[2:]
+
+            def tiled(x):
+                return jnp.broadcast_to(
+                    x[:, None], (self.cfg.n_layer, B) + x.shape[1:]
+                ).astype(self.cfg.dtype)
+
+            cache = {
+                "k": jax.lax.dynamic_update_slice_in_dim(
+                    jnp.zeros(shape, self.cfg.dtype), tiled(kv_prefix["k"]), 0, axis=2
+                ),
+                "v": jax.lax.dynamic_update_slice_in_dim(
+                    jnp.zeros(shape, self.cfg.dtype), tiled(kv_prefix["v"]), 0, axis=2
+                ),
+                "index": jnp.int32(n),
+                "key_mask": jnp.concatenate(
+                    [jnp.ones((B, n), jnp.int32), attention_mask], axis=1
+                ),
+            }
+            # pad-aware positions shifted past the prefix (HF past-length
+            # semantics)
+            positions = n + jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
         if cache is not None:
             S = cache["k"].shape[2]  # [L, B, S, Hkv, D]
             q_slots = cache["index"] + jnp.arange(T)
@@ -652,6 +705,15 @@ class TransformerLM:
             layer_cache = None
 
         h = self._embed_h(params, input_ids, positions)
+        if prefix_embeds is not None:
+            # the virtual slots were embedded as token 0 (+wpe): swap the
+            # wte row for the trainable soft embedding, keeping wpe
+            n_rows = n_virtual if n_virtual else h.shape[1]
+            wte0 = params["embed"]["wte"][0].astype(h.dtype)
+            soft = prefix_embeds[None, :n_rows].astype(h.dtype)
+            h = jax.lax.dynamic_update_slice_in_dim(
+                h, h[:, :n_rows] - wte0 + soft, 0, axis=1
+            )
         h, new_cache = self._scan_blocks(
             params["blocks"], h, bias, positions, layer_cache, remat=remat,
             key_mask=None if cache is not None else attention_mask,
@@ -660,6 +722,10 @@ class TransformerLM:
         )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
         logits = self._logits(params, hidden)
+        if n_virtual:
+            hidden = hidden[:, n_virtual:]
+            logits = logits[:, n_virtual:]
+            positions = positions[:, n_virtual:]
         return {
             "logits": logits,
             "hidden_states": hidden,

@@ -43,7 +43,9 @@ Array = jnp.ndarray
 
 
 def _effective_base(wrapper, params: Dict) -> Dict:
-    """Resolve the base param tree, merging a LoRA overlay if present."""
+    """Resolve the base param tree, merging a LoRA overlay if present.
+    With any peft adapter the base is stop-gradiented: only the adapter
+    (+ heads) trains, and the backward never materializes base grads."""
     if "lora" in params:
         from trlx_tpu.models.lora import merge_lora
 
@@ -51,7 +53,16 @@ def _effective_base(wrapper, params: Dict) -> Dict:
             jax.lax.stop_gradient(params["base"]), params["lora"],
             getattr(wrapper, "lora_scaling", 1.0),
         )
+    if "prompt" in params or "prefix" in params:
+        return jax.lax.stop_gradient(params["base"])
     return params["base"]
+
+
+def _adapter_kwargs(params: Dict) -> Dict:
+    """Prompt/prefix adapter kwargs for TransformerLM.__call__."""
+    from trlx_tpu.models.peft import adapter_call_kwargs
+
+    return adapter_call_kwargs(params)
 
 
 class CausalLM:
@@ -73,7 +84,10 @@ class CausalLM:
         attention_mask: Optional[Array] = None,
         remat: bool = False,
     ) -> Dict[str, Array]:
-        return self.lm(_effective_base(self, params), input_ids, attention_mask, remat=remat)
+        return self.lm(
+            _effective_base(self, params), input_ids, attention_mask,
+            remat=remat, **_adapter_kwargs(params),
+        )
 
 
 class CausalLMWithValueHead:
@@ -183,7 +197,8 @@ class CausalLMWithValueHead:
     ) -> Dict[str, Array]:
         if self.value_branch_at is None:
             out = self.lm(
-                _effective_base(self, params), input_ids, attention_mask, remat=remat
+                _effective_base(self, params), input_ids, attention_mask,
+                remat=remat, **_adapter_kwargs(params),
             )
         else:
             out = self._multi_forward(params, input_ids, attention_mask, remat)
@@ -415,7 +430,10 @@ class CausalLMWithILQLHeads:
         ILQL loss consumes (trlx_tpu.ops.ilql.ilql_loss)."""
         from trlx_tpu.ops.common import batched_index_select
 
-        out = self.lm(_effective_base(self, params), input_ids, attention_mask, remat=remat)
+        out = self.lm(
+            _effective_base(self, params), input_ids, attention_mask,
+            remat=remat, **_adapter_kwargs(params),
+        )
         qs, target_qs, vs = apply_ilql_heads(
             params["heads"], out["hidden_states"], states_ixs, actions_ixs
         )

@@ -298,21 +298,44 @@ class TPUBaseTrainer(BaseRLTrainer):
         return jax.tree_util.tree_map_with_path(mask_leaf, params)
 
     def attach_lora(self, params: Dict) -> Dict:
-        """Add a LoRA overlay to a {"base": ...} params tree when
-        model.peft_config asks for one; sets the wrapper's merge scaling."""
-        from trlx_tpu.models.lora import init_lora_params, normalize_peft_config
+        """Back-compat alias for attach_peft."""
+        return self.attach_peft(params)
+
+    def attach_peft(self, params: Dict) -> Dict:
+        """Add the configured adapter (LoRA overlay / prompt soft tokens /
+        per-layer kv prefixes) to a {"base": ...} params tree."""
+        from trlx_tpu.models.peft import (
+            init_lora_params,
+            init_prefix_params,
+            init_prompt_params,
+            normalize_peft_config,
+        )
 
         pc = normalize_peft_config(self.config.model.peft_config)
         if pc is None:
             return params
         self.rng, key = jax.random.split(self.rng)
-        params["lora"] = init_lora_params(key, params["base"], pc["r"], pc["targets"])
-        self.model.lora_scaling = pc["alpha"] / pc["r"]
+        if pc["peft_type"] == "LORA":
+            params["lora"] = init_lora_params(
+                key, params["base"], pc["r"], pc["targets"]
+            )
+            self.model.lora_scaling = pc["alpha"] / pc["r"]
+        elif pc["peft_type"] == "PROMPT_TUNING":
+            params["prompt"] = init_prompt_params(
+                key, self.model.cfg, pc["num_virtual_tokens"]
+            )
+        elif pc["peft_type"] == "PREFIX_TUNING":
+            params["prefix"] = init_prefix_params(
+                key, self.model.cfg, pc["num_virtual_tokens"]
+            )
         return params
 
     def lora_freeze_mask(self, params: Dict) -> Optional[Dict]:
-        """With LoRA: base frozen entirely, adapters + heads train."""
-        if "lora" not in params:
+        """With any peft adapter: base frozen entirely, adapters + heads
+        train (the reference peft contract)."""
+        from trlx_tpu.models.peft import ADAPTER_KEYS
+
+        if not any(k in params for k in ADAPTER_KEYS):
             return None
         mask = jax.tree_util.tree_map(lambda _: np.float32(1.0), params)
         mask["base"] = jax.tree_util.tree_map(
@@ -425,6 +448,10 @@ class TPUBaseTrainer(BaseRLTrainer):
                 return generate(
                     lm, base, input_ids, attention_mask, rng, settings,
                     logits_processor=make_processor(params),
+                    soft_prompt=(
+                        params["prompt"]["embedding"] if "prompt" in params else None
+                    ),
+                    kv_prefix=params.get("prefix"),
                 )
 
             self._generate_fns[key] = jax.jit(fn)

@@ -128,6 +128,18 @@ class TPUPPOTrainer(TPUBaseTrainer):
             # with adapters the reference model is the disabled-adapter
             # base, not a hydra branch (reference peft contract)
             k = -1
+            from trlx_tpu.models.peft import normalize_peft_config
+
+            pc = normalize_peft_config(self.config.model.peft_config)
+            if (
+                pc["peft_type"] in ("PROMPT_TUNING", "PREFIX_TUNING")
+                and self.config.method.num_value_layers_unfrozen
+            ):
+                raise NotImplementedError(
+                    "num_value_layers_unfrozen with prompt/prefix tuning is "
+                    "not supported (the value-branch capture forward does "
+                    "not thread virtual-token adapters)"
+                )
         at = None
         if self.seq2seq:
             if k is not None and 0 < k < cfg.n_decoder_layer:
