@@ -320,6 +320,62 @@ def t5_params_from_state_dict(sd: Dict[str, Any], cfg) -> Dict:
     return params
 
 
+def t5_state_dict_from_params(params: Dict, cfg) -> Dict[str, np.ndarray]:
+    """Inverse of t5_params_from_state_dict: T5LM param tree -> HF torch
+    state_dict names (deploy artifact for seq2seq policies)."""
+    H, Dk, D = cfg.n_head, cfg.d_kv, cfg.d_model
+    out: Dict[str, np.ndarray] = {}
+
+    def A(x):
+        return np.asarray(x, dtype=np.float32)
+
+    def attn_out(prefix: str, blk: Dict) -> None:
+        out[prefix + ".q.weight"] = A(blk["q"]["kernel"]).reshape(D, H * Dk).T
+        out[prefix + ".k.weight"] = A(blk["k"]["kernel"]).reshape(D, H * Dk).T
+        out[prefix + ".v.weight"] = A(blk["v"]["kernel"]).reshape(D, H * Dk).T
+        out[prefix + ".o.weight"] = A(blk["o"]["kernel"]).reshape(H * Dk, D).T
+
+    def mlp_out(prefix: str, blk: Dict) -> None:
+        out[prefix + ".wo.weight"] = A(blk["fc_out"]["kernel"]).T
+        if "fc_gate" in blk:  # gated (v1.1)
+            out[prefix + ".wi_0.weight"] = A(blk["fc_in"]["kernel"]).T
+            out[prefix + ".wi_1.weight"] = A(blk["fc_gate"]["kernel"]).T
+        else:
+            out[prefix + ".wi.weight"] = A(blk["fc_in"]["kernel"]).T
+
+    def stack_out(side: str, tree: Dict, n: int, is_decoder: bool) -> None:
+        for i in range(n):
+            b = f"{side}.block.{i}.layer"
+            blk = {k: A_tree(v, i) for k, v in tree["blocks"].items()}
+            out[f"{b}.0.layer_norm.weight"] = blk["ln_1"]["scale"]
+            attn_out(f"{b}.0.SelfAttention", blk["self_attn"])
+            if is_decoder:
+                out[f"{b}.1.layer_norm.weight"] = blk["ln_cross"]["scale"]
+                attn_out(f"{b}.1.EncDecAttention", blk["cross_attn"])
+                ff = 2
+            else:
+                ff = 1
+            out[f"{b}.{ff}.layer_norm.weight"] = blk["ln_2"]["scale"]
+            mlp_out(f"{b}.{ff}.DenseReluDense", blk["mlp"])
+        out[f"{side}.final_layer_norm.weight"] = A(tree["ln_f"]["scale"])
+        # HF keeps the relative bias on block 0 only
+        out[f"{side}.block.0.layer.0.SelfAttention.relative_attention_bias.weight"] = A(
+            tree["rel_bias"]
+        )
+
+    shared = A(params["shared"]["wte"])
+    out["shared.weight"] = shared
+    out["encoder.embed_tokens.weight"] = shared
+    out["decoder.embed_tokens.weight"] = shared
+    stack_out("encoder", params["encoder"], cfg.n_layer, False)
+    stack_out("decoder", params["decoder"], cfg.n_decoder_layer, True)
+    if "lm_head" in params:
+        out["lm_head.weight"] = A(params["lm_head"]["kernel"]).T
+    else:  # tied: HF still carries the (shared) lm_head tensor
+        out["lm_head.weight"] = shared
+    return out
+
+
 def load_pretrained_seq2seq(path: str, dtype=None, param_dtype=None):
     """Load an HF-layout T5 checkpoint directory -> (T5LM, params)."""
     import transformers
@@ -765,7 +821,10 @@ def save_pretrained_hf(
     import torch
 
     os.makedirs(path, exist_ok=True)
-    sd = state_dict_from_params(params, cfg, model_type)
+    if model_type in ("t5", "mt5"):
+        sd = t5_state_dict_from_params(params, cfg)
+    else:
+        sd = state_dict_from_params(params, cfg, model_type)
     torch.save({k: torch.from_numpy(np.asarray(v)) for k, v in sd.items()},
                os.path.join(path, "pytorch_model.bin"))
     hf_config.save_pretrained(path)

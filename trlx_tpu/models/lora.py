@@ -26,15 +26,19 @@ import numpy as np
 
 Array = jnp.ndarray
 
-# (path regex, n leading stack dims, n input dims, n output dims)
+# (path regex, n leading stack dims, n input dims, n output dims);
+# self_attn/cross_attn cover the seq2seq (T5) stacks
 _SPLITS: List[Tuple[str, int, int, int]] = [
-    (r"blocks/attn/[qkv]/kernel$", 1, 1, 2),   # [L, E, H, D]
-    (r"blocks/attn/o/kernel$", 1, 2, 1),       # [L, H, D, E]
+    (r"blocks/(self_|cross_)?attn/[qkv]/kernel$", 1, 1, 2),  # [L, E, H, D]
+    (r"blocks/(self_|cross_)?attn/o/kernel$", 1, 2, 1),      # [L, H, D, E]
     (r"blocks/mlp/fc_(in|gate|out)/kernel$", 1, 1, 1),  # [L, in, out]
     (r"lm_head/kernel$", 0, 1, 1),             # [E, V]
 ]
 
-DEFAULT_TARGETS = r"blocks/attn/[qkv]/kernel$|blocks/attn/o/kernel$"
+DEFAULT_TARGETS = (
+    r"blocks/(self_|cross_)?attn/[qkv]/kernel$"
+    r"|blocks/(self_|cross_)?attn/o/kernel$"
+)
 
 
 def normalize_peft_config(peft_config: Any) -> Dict[str, Any]:

@@ -284,8 +284,8 @@ class Seq2SeqLMWithValueHead:
         remat: bool = False,
     ) -> Dict[str, Array]:
         out = self.lm(
-            params["base"], input_ids, attention_mask, decoder_input_ids,
-            decoder_attention_mask, remat=remat,
+            _effective_base(self, params), input_ids, attention_mask,
+            decoder_input_ids, decoder_attention_mask, remat=remat,
         )
         values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
         return dict(out, values=values)

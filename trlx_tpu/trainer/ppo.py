@@ -121,16 +121,18 @@ class TPUPPOTrainer(TPUBaseTrainer):
         self.seq2seq = self.config.model.model_arch_type == "seq2seq"
         k = self.config.model.num_layers_unfrozen
         if self.config.model.peft_config is not None:
-            if self.seq2seq:
+            from trlx_tpu.models.peft import normalize_peft_config
+
+            pc = normalize_peft_config(self.config.model.peft_config)
+            if self.seq2seq and pc["peft_type"] != "LORA":
+                # matches the reference matrix: its own peft tests skip
+                # seq2seq x {PROMPT,PREFIX} (peft 0.3.0 bugs)
                 raise NotImplementedError(
-                    "peft_config with model_arch_type='seq2seq' is not supported yet"
+                    "seq2seq supports peft_type='LORA' only"
                 )
             # with adapters the reference model is the disabled-adapter
             # base, not a hydra branch (reference peft contract)
             k = -1
-            from trlx_tpu.models.peft import normalize_peft_config
-
-            pc = normalize_peft_config(self.config.model.peft_config)
             if (
                 pc["peft_type"] in ("PROMPT_TUNING", "PREFIX_TUNING")
                 and self.config.method.num_value_layers_unfrozen
@@ -156,8 +158,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
         self.rng, key = jax.random.split(self.rng)
         params = self.model.init_params(key, base_params)
         params.update(getattr(self, "_loaded_aux", None) or {})
-        if not self.seq2seq:
-            params = self.attach_lora(params)
+        params = self.attach_peft(params)
         self.params = shard_params(self.mesh, params)
         # frozen in-process reference: the top-k branch (hydra) or a full
         # copy when everything is trainable (reference :74-77); with LoRA
