@@ -2,16 +2,29 @@
 
 Parity: /root/reference/trlx/sweep.py:17-348 — same YAML schema (per-param
 `strategy` + `values`, `tune_config` with metric/mode/search_alg/
-num_samples) and the same contract with examples (`main(hparams)` with
-dotted-path overrides). The Ray Tune backend is replaced by a first-party
-sequential runner: a TPU slice is one shared resource, so trials run one
-after another on the full mesh instead of fighting over device shards;
-random + grid search are built in (bayesopt degrades to random with a
-warning — no skopt dependency in the TPU image).
+scheduler/num_samples) and the same contract with examples
+(`main(hparams)` with dotted-path overrides). The Ray Tune backend is
+replaced by a first-party sequential runner: a TPU slice is one shared
+resource, so trials run one after another on the full mesh instead of
+fighting over device shards.
 
-Each trial's metrics come from the JSONL tracker (utils/trackers.py); a
-markdown + JSON report replaces the reference's W&B report builder.
-"""
+Search algorithms (reference get_search_alg :102-134):
+  random / grid   built-in sampling
+  bayesopt, bohb  first-party TPE (Tree-structured Parzen Estimator):
+                  after a few seed trials, model good vs bad observations
+                  with Parzen windows per parameter and pick the
+                  candidate maximizing the good/bad likelihood ratio —
+                  the same ask/tell shape as Ray's BayesOptSearch/BOHB
+                  without the skopt/hpbandster deps (absent in the image).
+
+Scheduler (reference get_scheduler :136-159): `hyperband` runs successive
+halving over `train.total_steps` budgets (eta=3): each rung reruns the
+surviving configs at 3x the budget, keeping the top third.
+
+Each trial's metrics come from the JSONL tracker (utils/trackers.py); the
+JSON + markdown report includes per-parameter importance (|Spearman
+correlation| with the objective), replacing the reference's W&B report
+builder (:228-348)."""
 
 from __future__ import annotations
 
@@ -22,7 +35,7 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 import yaml
@@ -100,6 +113,131 @@ def generate_trials(param_space: Dict[str, Any], tune_config: Dict[str, Any], se
 
 
 # ---------------------------------------------------------------------------
+# search algorithms (ask/tell)
+# ---------------------------------------------------------------------------
+
+
+class RandomSearch:
+    """Independent draws from the param space (reference search_alg=None)."""
+
+    def __init__(self, param_space: Dict[str, Any], seed: int = 0):
+        self.space = {
+            k: v for k, v in param_space.items() if v["strategy"] != "grid"
+        }
+        self.rng = np.random.default_rng(seed)
+
+    def ask(self) -> Dict[str, Any]:
+        return {k: _sample_strategy(self.rng, v) for k, v in self.space.items()}
+
+    def tell(self, hparams: Dict[str, Any], score) -> None:
+        pass
+
+
+class TPESearch(RandomSearch):
+    """Tree-structured Parzen Estimator over the sampled axes.
+
+    Observations are split at the `gamma` quantile into good/bad sets;
+    each numeric axis gets a Parzen window (Gaussian KDE) per set, choice
+    axes get add-one categorical frequencies. Ask draws `n_candidates`
+    from the good model and returns the argmax of l_good/l_bad. Runs as
+    pure numpy — this is what bayesopt/bohb resolve to."""
+
+    def __init__(
+        self,
+        param_space: Dict[str, Any],
+        mode: str = "max",
+        seed: int = 0,
+        n_initial: int = 5,
+        gamma: float = 0.25,
+        n_candidates: int = 32,
+    ):
+        super().__init__(param_space, seed)
+        self.mode = mode
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.obs: List[Tuple[Dict[str, Any], float]] = []
+
+    def tell(self, hparams: Dict[str, Any], score) -> None:
+        if score is not None and np.isfinite(score):
+            self.obs.append((hparams, float(score)))
+
+    def _split(self):
+        scores = np.asarray([s for _, s in self.obs])
+        order = np.argsort(scores)
+        if self.mode == "max":
+            order = order[::-1]
+        n_good = max(1, int(np.ceil(self.gamma * len(order))))
+        good = [self.obs[i][0] for i in order[:n_good]]
+        bad = [self.obs[i][0] for i in order[n_good:]] or good
+        return good, bad
+
+    @staticmethod
+    def _kde_logpdf(x: np.ndarray, data: np.ndarray) -> np.ndarray:
+        sd = np.std(data) or 1.0
+        bw = max(1.06 * sd * len(data) ** -0.2, 1e-6 * max(abs(sd), 1.0))
+        d = (x[:, None] - data[None, :]) / bw
+        return np.log(
+            np.mean(np.exp(-0.5 * d * d), axis=1) / (bw * np.sqrt(2 * np.pi))
+            + 1e-300
+        )
+
+    def ask(self) -> Dict[str, Any]:
+        if len(self.obs) < self.n_initial:
+            return super().ask()
+        good, bad = self._split()
+        cand = [super(TPESearch, self).ask() for _ in range(self.n_candidates)]
+        ratio = np.zeros(len(cand))
+        for k, spec in self.space.items():
+            cvals = [c[k] for c in cand]
+            if spec["strategy"] == "choice":
+                choices = list(spec["values"])
+
+                def cat_logp(vals, data):
+                    counts = np.asarray(
+                        [sum(d == c for d in data) + 1.0 for c in choices]
+                    )
+                    p = counts / counts.sum()
+                    idx = [choices.index(v) for v in vals]
+                    return np.log(p[idx])
+
+                ratio += cat_logp(cvals, [g[k] for g in good])
+                ratio -= cat_logp(cvals, [b[k] for b in bad])
+            else:
+                x = np.asarray(cvals, float)
+                log = spec["strategy"] in (
+                    "loguniform", "qloguniform", "lograndint", "qlograndint"
+                )
+                f = np.log if log else (lambda v: v)
+                ratio += self._kde_logpdf(f(x), f(np.asarray([g[k] for g in good], float)))
+                ratio -= self._kde_logpdf(f(x), f(np.asarray([b[k] for b in bad], float)))
+        return cand[int(np.argmax(ratio))]
+
+
+def make_search_alg(name, param_space, tune_config, seed: int = 0):
+    mode = tune_config.get("mode", "max")
+    if name in (None, "random", "grid"):
+        return RandomSearch(param_space, seed)
+    if name in ("bayesopt", "bohb", "tpe"):
+        return TPESearch(param_space, mode=mode, seed=seed)
+    raise ValueError(f"unknown search_alg {name!r}")
+
+
+def hyperband_rungs(max_budget: int, eta: int = 3, min_budget: Optional[int] = None):
+    """Successive-halving rungs [(n_configs_multiplier, budget), ...]:
+    budgets grow by eta, survivors shrink by eta (reference
+    HyperBandScheduler semantics on the total_steps resource)."""
+    min_budget = min_budget or max(max_budget // (eta * eta), 1)
+    budgets = []
+    b = min_budget
+    while b < max_budget:
+        budgets.append(int(b))
+        b *= eta
+    budgets.append(int(max_budget))
+    return budgets
+
+
+# ---------------------------------------------------------------------------
 # trial execution
 # ---------------------------------------------------------------------------
 
@@ -112,34 +250,85 @@ def _load_main(script_path: str):
     return module.main
 
 
+def _spearman(x: np.ndarray, y: np.ndarray) -> float:
+    """Rank correlation (no scipy in the hot path)."""
+
+    def rank(a):
+        order = np.argsort(a)
+        r = np.empty(len(a))
+        r[order] = np.arange(len(a))
+        return r
+
+    rx, ry = rank(x), rank(y)
+    sx, sy = rx.std(), ry.std()
+    if sx == 0 or sy == 0:
+        return 0.0
+    return float(np.corrcoef(rx, ry)[0, 1])
+
+
+def param_importance(results: List[Dict], metric: str) -> Dict[str, float]:
+    """|Spearman| of each numeric hparam vs the objective (the W&B
+    report's parameter-importance panel, air-gapped)."""
+    scored = [r for r in results if r[metric] is not None]
+    if len(scored) < 3:
+        return {}
+    out = {}
+    keys = {
+        k for r in scored for k, v in r["hparams"].items()
+        if isinstance(v, (int, float)) and not k.startswith("train.checkpoint")
+        and not k.startswith("train.logging")
+    }
+    y = np.asarray([r[metric] for r in scored], float)
+    for k in sorted(keys):
+        x = np.asarray(
+            [float(r["hparams"].get(k, np.nan)) for r in scored], float
+        )
+        ok = np.isfinite(x)
+        if ok.sum() >= 3 and np.std(x[ok]) > 0:
+            out[k] = abs(_spearman(x[ok], y[ok]))
+    return out
+
+
 def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict[str, Any]:
     tune_config = config.pop("tune_config")
     metric = tune_config.get("metric", "reward/mean")
     mode = tune_config.get("mode", "max")
-    if tune_config.get("search_alg") not in (None, "random", "grid"):
-        logger.warning(
-            "search_alg %r not available in the TPU runner; using random search",
-            tune_config.get("search_alg"),
-        )
-    trials = generate_trials(config, tune_config)
-    logger.info("Running %d trials sequentially on the full mesh", len(trials))
+    num_samples = int(tune_config.get("num_samples", 1))
+    seed = int(tune_config.get("seed", 0))
+    alg = make_search_alg(tune_config.get("search_alg"), config, tune_config, seed)
+    budget_key = tune_config.get("budget_key", "train.total_steps")
+
+    grid_axes = {
+        k: v["values"] for k, v in config.items() if v["strategy"] == "grid"
+    }
+    grid_points: List[Dict[str, Any]] = [{}]
+    if grid_axes:
+        keys = list(grid_axes)
+        grid_points = [
+            dict(zip(keys, combo))
+            for combo in itertools.product(*(grid_axes[k] for k in keys))
+        ]
 
     main = _load_main(script_path)
     os.makedirs(output_dir, exist_ok=True)
-    results = []
-    for i, hparams in enumerate(trials):
+    results: List[Dict[str, Any]] = []
+
+    def run_trial(hparams: Dict[str, Any], budget: Optional[int] = None):
+        i = len(results)
         trial_dir = os.path.join(output_dir, f"trial_{i:03d}")
-        hparams = dict(
+        full = dict(
             hparams, **{
                 "train.checkpoint_dir": trial_dir,
                 "train.logging_dir": os.path.join(trial_dir, "logs"),
             }
         )
-        logger.info("trial %d/%d: %s", i + 1, len(trials), hparams)
+        if budget is not None:
+            full[budget_key] = int(budget)
+        logger.info("trial %d: %s", i, full)
         t0 = time.time()
         status = "ok"
         try:
-            main(hparams)
+            main(full)
         except Exception as e:  # a failed trial shouldn't kill the sweep
             logger.warning("trial %d failed: %s", i, e)
             status = f"error: {e}"
@@ -154,34 +343,76 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
             if values:
                 score = max(values) if mode == "max" else min(values)
         results.append(
-            {"trial": i, "hparams": hparams, metric: score,
-             "status": status, "time": time.time() - t0}
+            {"trial": i, "hparams": full, metric: score,
+             "status": status, "budget": budget, "time": time.time() - t0}
         )
+        alg.tell(hparams, score)
+        return score
+
+    if tune_config.get("scheduler") == "hyperband":
+        max_budget = int(tune_config.get("max_budget", 0))
+        if not max_budget:
+            raise ValueError(
+                "scheduler=hyperband needs tune_config.max_budget (the "
+                f"largest {budget_key} to train a surviving config for)"
+            )
+        eta = int(tune_config.get("eta", 3))
+        budgets = hyperband_rungs(max_budget, eta)
+        for point in grid_points:
+            configs = [dict(point, **alg.ask()) for _ in range(num_samples)]
+            for rung, budget in enumerate(budgets):
+                logger.info(
+                    "hyperband rung %d: %d configs at %s=%d",
+                    rung, len(configs), budget_key, budget,
+                )
+                scored = [(hp, run_trial(hp, budget)) for hp in configs]
+                if rung == len(budgets) - 1:
+                    break
+                ok = [(hp, s) for hp, s in scored if s is not None]
+                ok.sort(key=lambda t: t[1], reverse=(mode == "max"))
+                keep = max(1, int(np.ceil(len(ok) / eta)))
+                configs = [hp for hp, _ in ok[:keep]]
+                if not configs:
+                    break
+    else:
+        for point in grid_points:
+            n = num_samples if alg.space or not grid_axes else 1
+            for _ in range(n):
+                run_trial(dict(point, **alg.ask()))
 
     scored = [r for r in results if r[metric] is not None]
     best = (max if mode == "max" else min)(
         scored, key=lambda r: r[metric], default=None
     ) if scored else None
+    importance = param_importance(results, metric)
     report = {
         "script": script_path,
         "metric": metric,
         "mode": mode,
+        "search_alg": tune_config.get("search_alg") or "random",
+        "scheduler": tune_config.get("scheduler") or "fifo",
         "best": best,
+        "param_importance": importance,
         "trials": results,
     }
     with open(os.path.join(output_dir, "report.json"), "w") as f:
         json.dump(report, f, indent=2)
     with open(os.path.join(output_dir, "report.md"), "w") as f:
         f.write(f"# Sweep report: {os.path.basename(script_path)}\n\n")
-        f.write(f"metric: `{metric}` ({mode})\n\n")
-        f.write("| trial | " + metric + " | time (s) | hparams |\n|---|---|---|---|\n")
+        f.write(f"metric: `{metric}` ({mode}) | search: "
+                f"{report['search_alg']} | scheduler: {report['scheduler']}\n\n")
+        f.write("| trial | " + metric + " | budget | time (s) | hparams |\n|---|---|---|---|---|\n")
         for r in results:
             f.write(
-                f"| {r['trial']} | {r[metric]} | {r['time']:.0f} | "
-                f"`{json.dumps({k: v for k, v in r['hparams'].items() if not k.startswith('train.checkpoint')})}` |\n"
+                f"| {r['trial']} | {r[metric]} | {r['budget'] or ''} | {r['time']:.0f} | "
+                f"`{json.dumps({k: v for k, v in r['hparams'].items() if not k.startswith('train.checkpoint') and not k.startswith('train.logging')})}` |\n"
             )
         if best is not None:
             f.write(f"\nbest: trial {best['trial']} with {metric}={best[metric]}\n")
+        if importance:
+            f.write("\n## Parameter importance (|Spearman| vs objective)\n\n")
+            for k, v in sorted(importance.items(), key=lambda kv: -kv[1]):
+                f.write(f"- `{k}`: {v:.3f}\n")
     logger.info("sweep report written to %s", output_dir)
     return report
 
