@@ -1,0 +1,59 @@
+"""PPO GPT2 on IMDB with a LoRA adapter (parity:
+/root/reference/examples/ppo_sentiments_peft.py). Only the adapters and
+the value head train; the frozen base doubles as the KL reference, so
+the hydra branch (and its memory) disappears entirely. Swap peft_config
+for {"peft_type": "PROMPT_TUNING"/"PREFIX_TUNING", "num_virtual_tokens": 10}
+to use virtual-token adapters instead.
+"""
+
+from typing import Dict, List
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_ppo_config
+
+
+def get_positive_score(scores: List[Dict[str, float]]) -> float:
+    return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_ppo_config().to_dict(), hparams)
+
+    # any HF-peft-style dict works here (reference passes a peft.LoraConfig)
+    config.model.peft_config = {
+        "peft_type": "LORA",
+        "r": 8,
+        "lora_alpha": 32,
+    }
+
+    from datasets import load_dataset
+    from transformers import pipeline as hf_pipeline
+
+    sentiment_fn = hf_pipeline(
+        "sentiment-analysis",
+        "lvwerra/distilbert-imdb",
+        top_k=2,
+        truncation=True,
+        batch_size=256,
+    )
+
+    def reward_fn(samples: List[str], **kwargs) -> List[float]:
+        return list(map(get_positive_score, sentiment_fn(samples)))
+
+    imdb = load_dataset("imdb", split="train+test")
+    prompts = [" ".join(review.split()[:4]) for review in imdb["text"]]
+
+    return trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,147 @@
+"""PPO T5 on WMT en->de translation (parity:
+/root/reference/examples/ppo_translation_t5.py). The reference optimizes
+COMET with BLEU/chrF side metrics via `evaluate`/`unbabel-comet`; those
+models need hub access, so the reward here is pluggable: COMET when the
+packages are importable, otherwise a chrF-style character n-gram F-score
+against the references computed locally (same reward shape, zero deps).
+"""
+
+from collections import Counter
+from typing import List
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.method_configs import PPOConfig
+
+
+def default_config() -> TRLConfig:
+    return TRLConfig(
+        train=TrainConfig(
+            seq_length=612,
+            epochs=100,
+            total_steps=100000,
+            batch_size=12,
+            checkpoint_interval=10000,
+            eval_interval=200,
+            pipeline="PromptPipeline",
+            trainer="TPUPPOTrainer",
+        ),
+        model=ModelConfig(
+            model_path="t5-large", model_arch_type="seq2seq", num_layers_unfrozen=-1
+        ),
+        tokenizer=TokenizerConfig(
+            tokenizer_path="t5-large", padding_side="right", truncation_side="right"
+        ),
+        optimizer=OptimizerConfig(
+            name="adamw",
+            kwargs={"lr": 2.0e-6, "betas": [0.9, 0.999], "eps": 1.0e-8,
+                    "weight_decay": 1.0e-6},
+        ),
+        scheduler=SchedulerConfig(
+            name="cosine_annealing", kwargs={"T_max": 10000, "eta_min": 1.0e-6}
+        ),
+        method=PPOConfig(
+            name="PPOConfig",
+            num_rollouts=256,
+            chunk_size=12,
+            ppo_epochs=4,
+            init_kl_coef=0.05,
+            target=6,
+            horizon=10000,
+            gamma=0.99,
+            lam=0.95,
+            cliprange=0.2,
+            cliprange_value=0.2,
+            vf_coef=1.0,
+            scale_reward=None,
+            ref_mean=None,
+            ref_std=None,
+            cliprange_reward=10,
+            gen_kwargs={"max_new_tokens": 100},
+            gen_experience_kwargs={
+                "max_new_tokens": 100, "do_sample": False, "num_beams": 1,
+                "temperature": 1.0,
+            },
+        ),
+    )
+
+
+def chrf(hyp: str, ref: str, n: int = 6, beta: float = 2.0) -> float:
+    """Character n-gram F-score (local stand-in for the COMET reward)."""
+    if not hyp or not ref:
+        return 0.0
+    precisions, recalls = [], []
+    for k in range(1, n + 1):
+        h = Counter(hyp[i : i + k] for i in range(len(hyp) - k + 1))
+        r = Counter(ref[i : i + k] for i in range(len(ref) - k + 1))
+        overlap = sum((h & r).values())
+        if sum(h.values()):
+            precisions.append(overlap / sum(h.values()))
+        if sum(r.values()):
+            recalls.append(overlap / sum(r.values()))
+    if not precisions or not recalls:
+        return 0.0
+    p, rc = sum(precisions) / len(precisions), sum(recalls) / len(recalls)
+    if p + rc == 0:
+        return 0.0
+    return (1 + beta**2) * p * rc / (beta**2 * p + rc)
+
+
+def make_reward_fn(translation_map):
+    try:
+        import evaluate
+
+        comet_metric = evaluate.load("comet", "wmt20-comet-da", progress_bar=False)
+
+        def reward_fn(samples, prompts, outputs, **kwargs) -> List[float]:
+            originals = [translation_map[p.strip()]["src"] for p in prompts]
+            refs = [translation_map[p.strip()]["ref"] for p in prompts]
+            scores = comet_metric.compute(
+                predictions=outputs, references=refs, sources=originals
+            )["scores"]
+            return [float(s) for s in scores]
+
+    except Exception:
+
+        def reward_fn(samples, prompts, outputs, **kwargs) -> List[float]:
+            refs = [translation_map[p.strip()]["ref"] for p in prompts]
+            return [chrf(o.strip(), r) for o, r in zip(outputs, refs)]
+
+    return reward_fn
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config().to_dict(), hparams)
+
+    from datasets import load_dataset
+
+    ds = load_dataset("wmt16", "de-en", split="train[:20000]")
+    prefix = "translate English to German: "
+    prompts, translation_map = [], {}
+    for row in ds["translation"]:
+        prompt = prefix + row["en"]
+        prompts.append(prompt)
+        translation_map[prompt.strip()] = {"src": row["en"], "ref": row["de"]}
+
+    reward_fn = make_reward_fn(translation_map)
+    return trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts[:-256],
+        eval_prompts=prompts[-256:],
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

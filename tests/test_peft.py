@@ -321,3 +321,30 @@ def test_ppo_learn_with_prompt_tuning(tmp_path):
     trainer = trlx_tpu.train(reward_fn=reward_fn, prompts=prompts, config=config)
     assert trainer.iter_count == 2
     assert "prefix" in trainer.params
+
+
+@pytest.mark.slow
+def test_ppo_llama_arch_with_lora(tmp_path):
+    # llama architecture (rmsnorm + rotary + SwiGLU) x LoRA x PPO — the
+    # combination examples/ppo_sentiments_llama.py + _peft.py exercise on
+    # real weights, here air-gapped on a tiny random model
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=2, checkpoint_interval=10,
+            seq_length=12, tracker=None, checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=tiny_model_cfg(
+            peft_config=PEFT,
+            norm="rmsnorm", pos_embed="rotary", mlp_gated=True,
+            use_attn_bias=False, activation="silu",
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    prompts = ["hello", "the cat", "ab", "xyz", "what", "I am", "go", "ok"]
+    trainer = trlx_tpu.train(reward_fn=count_reward, prompts=prompts, config=config)
+    assert trainer.iter_count == 2
+    assert "lora" in trainer.params
