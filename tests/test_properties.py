@@ -1,0 +1,132 @@
+"""Hypothesis property tests (parity: reference
+tests/test_models.py:435-604 — batched_index_select, ILQL head indexing
+and shapes, ILQL loss robustness, Polyak sync)."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from trlx_tpu.models.heads import (
+    apply_ilql_heads,
+    init_ilql_heads,
+    sync_target_q_heads,
+)
+from trlx_tpu.ops.common import batched_index_select
+
+COMMON = dict(deadline=None, max_examples=25)
+
+
+@settings(**COMMON)
+@given(
+    st.integers(1, 8), st.integers(1, 16), st.integers(1, 16), st.integers(1, 8)
+)
+def test_batched_index_select(batch, seq_len, num_idxes, hidden):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, seq_len, hidden)), jnp.float32)
+    idxs = jnp.asarray(rng.integers(0, seq_len, (batch, num_idxes)))
+    out = np.asarray(batched_index_select(x, idxs, dim=1))
+
+    expect = np.zeros((batch, num_idxes, hidden), np.float32)
+    for i in range(batch):
+        expect[i] = np.asarray(x)[i, np.asarray(idxs)[i]]
+    np.testing.assert_array_equal(out, expect)
+
+
+@settings(**COMMON)
+@given(
+    st.integers(1, 8), st.integers(1, 16), st.integers(1, 8), st.integers(1, 8),
+    st.integers(2, 16), st.integers(2, 24), st.booleans(),
+)
+def test_ilql_heads_indexing_and_shapes(
+    batch, seq_len, n_act, n_state, hidden, vocab, two_qs
+):
+    heads = init_ilql_heads(jax.random.PRNGKey(0), hidden, vocab, two_qs)
+    rng = np.random.default_rng(1)
+    h = jnp.asarray(rng.normal(size=(batch, seq_len, hidden)), jnp.float32)
+    actions_ixs = jnp.asarray(rng.integers(0, seq_len, (batch, n_act)))
+    states_ixs = jnp.asarray(rng.integers(0, seq_len, (batch, n_state)))
+
+    qs, target_qs, vs = apply_ilql_heads(heads, h, states_ixs, actions_ixs)
+
+    assert len(qs) == len(target_qs) == (2 if two_qs else 1)
+    assert qs[0].shape == (batch, n_act, vocab)
+    assert target_qs[0].shape == (batch, n_act, vocab)
+    assert vs.shape[:2] == (batch, n_state)
+
+    # indexing after a full-sequence pass == indexed pass
+    all_ixs = jnp.tile(jnp.arange(seq_len)[None], (batch, 1))
+    qs_f, tqs_f, vs_f = apply_ilql_heads(heads, h, all_ixs, all_ixs)
+    for q, qf in zip(qs, qs_f):
+        np.testing.assert_allclose(
+            np.asarray(q),
+            np.asarray(batched_index_select(qf, actions_ixs, dim=1)),
+            atol=1e-6,
+        )
+    np.testing.assert_allclose(
+        np.asarray(vs),
+        np.asarray(batched_index_select(vs_f, states_ixs, dim=1)),
+        atol=1e-6,
+    )
+
+
+@settings(**COMMON)
+@given(st.floats(0.0, 1.0), st.booleans())
+def test_polyak_sync_alpha(alpha, two_qs):
+    heads = init_ilql_heads(jax.random.PRNGKey(2), 8, 12, two_qs)
+    synced = sync_target_q_heads(heads, alpha)
+    for q, tq, sq in zip(
+        jax.tree_util.tree_leaves(heads["q_heads"]),
+        jax.tree_util.tree_leaves(heads["target_q_heads"]),
+        jax.tree_util.tree_leaves(synced["target_q_heads"]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(sq),
+            alpha * np.asarray(q) + (1 - alpha) * np.asarray(tq),
+            atol=1e-6,
+        )
+
+
+@settings(**COMMON)
+@given(
+    st.integers(1, 4), st.integers(1, 6), st.integers(4, 12),
+    st.floats(0.1, 0.9), st.booleans(),
+)
+def test_ilql_loss_is_finite(batch, n_act, vocab, tau, two_qs):
+    from trlx_tpu.data import ILQLBatch
+    from trlx_tpu.ops.ilql import ilql_loss
+
+    rng = np.random.default_rng(3)
+    n_state = n_act + 1
+    seq = n_state + 1
+    logits = jnp.asarray(rng.normal(size=(batch, n_act, vocab)), jnp.float32)
+    qs = tuple(
+        jnp.asarray(rng.normal(size=(batch, n_act, vocab)), jnp.float32)
+        for _ in range(2 if two_qs else 1)
+    )
+    target_qs = tuple(jnp.asarray(np.asarray(q) + 0.1) for q in qs)
+    vs = jnp.asarray(rng.normal(size=(batch, n_state, 1)), jnp.float32)
+
+    labels = ILQLBatch(
+        input_ids=jnp.asarray(rng.integers(0, vocab, (batch, seq))),
+        attention_mask=jnp.ones((batch, seq), jnp.int32),
+        rewards=jnp.asarray(rng.normal(size=(batch, n_act)), jnp.float32),
+        states_ixs=jnp.asarray(rng.integers(0, seq, (batch, n_state))),
+        actions_ixs=jnp.asarray(rng.integers(0, seq - 1, (batch, n_act))),
+        dones=jnp.concatenate(
+            [jnp.ones((batch, n_state - 1), jnp.int32),
+             jnp.zeros((batch, 1), jnp.int32)], axis=1
+        ),
+    )
+    loss, stats = ilql_loss(
+        logits, qs, target_qs, vs, labels,
+        tau=tau, gamma=0.99, cql_scale=0.1, awac_scale=1.0, beta=0.0,
+        two_qs=two_qs,
+    )
+    assert np.isfinite(float(loss))
+    for k, v in stats.items():
+        assert np.isfinite(float(v)), k

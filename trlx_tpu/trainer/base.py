@@ -657,9 +657,13 @@ class TPUBaseTrainer(BaseRLTrainer):
         for k, x in stats.items():
             if k.startswith("reward") or k.startswith("metrics"):
                 title += f" {k}: {significant(x)}"
-        logger.info(title)
-        for row in table_rows[: max(3, len(sweep_values))]:
-            logger.info(" | ".join(str(significant(x))[:64] for x in row))
+        shown = table_rows[: max(8, len(sweep_values))]
+        logger.info(
+            "\n%s",
+            logging.format_table(
+                title, columns, [[significant(x) for x in row] for row in shown]
+            ),
+        )
 
         self.nth_evaluation += 1
         return stats

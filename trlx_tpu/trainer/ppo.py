@@ -336,6 +336,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
         accumulated_stats: List[Dict[str, float]] = []
         method = self.config.method
 
+        pbar = logging.progress(total=num_rollouts, desc="rollouts")
         while n_collected < num_rollouts:
             stats: Dict[str, float] = {}
             batch: PromptBatch = next(self.prompt_iterator)
@@ -503,12 +504,16 @@ class TPUPPOTrainer(TPUBaseTrainer):
 
             self.push_to_store(rollout_batch)
             n_collected += len(sequences) * mh.process_count()
+            if hasattr(pbar, "update"):
+                pbar.update(len(sequences) * mh.process_count())
             logger.info("[rollout %d / %d]", n_collected, num_rollouts)
 
         stats = {
             k: sum(xs[k] for xs in accumulated_stats) / len(accumulated_stats)
             for k in accumulated_stats[-1]
         }
+        if hasattr(pbar, "close"):
+            pbar.close()
         stats["kl_ctl_value"] = self.kl_ctl.value
         self.mean_kl = stats["policy/sqrt_kl"] ** 2
         self.tracker.log(stats, step=iter_count)

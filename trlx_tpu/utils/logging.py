@@ -132,3 +132,51 @@ INFO = logging.INFO
 WARNING = logging.WARNING
 ERROR = logging.ERROR
 CRITICAL = logging.CRITICAL
+
+
+def format_table(title, columns, rows, max_cell=48):
+    """Fixed-width box table for eval samples (parity: the reference's
+    rich.Table console output, accelerate_base_trainer.py:480-492)."""
+
+    def clip(x):
+        s = str(x)
+        s = s.replace("\n", " ")
+        return s if len(s) <= max_cell else s[: max_cell - 1] + "…"
+
+    cells = [[clip(c) for c in row] for row in rows]
+    widths = [
+        max([len(str(col))] + [len(r[i]) for r in cells])
+        for i, col in enumerate(columns)
+    ]
+
+    def line(l, m, r):
+        return l + m.join("─" * (w + 2) for w in widths) + r
+
+    def fmt(row):
+        return "│" + "│".join(f" {c:<{w}} " for c, w in zip(row, widths)) + "│"
+
+    out = [title, line("┌", "┬", "┐"), fmt([str(c) for c in columns]),
+           line("├", "┼", "┤")]
+    out += [fmt(r) for r in cells]
+    out.append(line("└", "┴", "┘"))
+    return "\n".join(out)
+
+
+def progress(iterable=None, total=None, desc=None):
+    """tqdm on process 0, plain passthrough elsewhere/on failure
+    (parity: reference logging.tqdm, utils/logging.py:278-341)."""
+    try:
+        import jax
+
+        main = jax.process_index() == 0
+    except Exception:
+        main = True
+    if not main:
+        return iterable if iterable is not None else range(total or 0)
+    try:
+        from tqdm import tqdm
+
+        return tqdm(iterable, total=total, desc=desc, leave=False,
+                    dynamic_ncols=True)
+    except Exception:
+        return iterable if iterable is not None else range(total or 0)
