@@ -1,0 +1,112 @@
+"""Blockwise 8-bit AdamW: the bitsandbytes replacement, as a first-party
+optax transformation.
+
+Parity: the reference offers `adamw_8bit_bnb` through bitsandbytes'
+CUDA kernels (/root/reference/trlx/utils/__init__.py:104-123,
+accelerate_base_trainer.py:183-191). The TPU-native shape is the same
+math with the moment states held in int8 + per-block fp32 absmax scales
+(block 256, bnb's default): m is symmetric int8, v (non-negative) uses
+the positive half. Dequantize -> fused adam update -> requantize runs
+inside the jitted train step; XLA fuses the (de)quantization into the
+update elementwise pass, so the win is the 4x smaller optimizer state in
+HBM (the dominant term beyond params for fsdp-sharded training), not
+kernel time.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+
+BLOCK = 256
+
+
+@flax.struct.dataclass
+class Q8:
+    q: jnp.ndarray  # int8 payload, flattened + padded to BLOCK
+    scale: jnp.ndarray  # f32 per-block absmax
+    shape: tuple = flax.struct.field(pytree_node=False)  # original (static)
+
+
+def _quantize(x: jnp.ndarray) -> Q8:
+    """Blockwise companded int8: q = sign * 127 * sqrt(|x| / absmax).
+
+    The sqrt companding matches bitsandbytes' non-linear dynamic map in
+    spirit: Adam's second moment spans orders of magnitude within one
+    block, and a LINEAR absmax code wipes out the small entries, which
+    visibly corrupts the update direction (sqrt(vhat) sits in the
+    denominator)."""
+    shape = x.shape
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % BLOCK
+    flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, BLOCK)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    norm = jnp.abs(blocks) / jnp.maximum(scale, 1e-30)
+    q = jnp.round(jnp.sign(blocks) * jnp.sqrt(norm) * 127.0)
+    return Q8(q.astype(jnp.int8), scale[:, 0], shape)
+
+
+def _dequantize(s: Q8) -> jnp.ndarray:
+    u = s.q.astype(jnp.float32) / 127.0
+    blocks = jnp.sign(u) * u * u * s.scale[:, None]
+    flat = blocks.reshape(-1)
+    n = 1
+    for d in s.shape:
+        n *= d
+    return flat[:n].reshape(s.shape)
+
+
+class Adam8bitState(NamedTuple):
+    count: jnp.ndarray
+    m: optax.Params  # tree of Q8
+    v: optax.Params  # tree of Q8
+
+
+def scale_by_adam_8bit(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """optax transformation holding both Adam moments in blockwise int8."""
+
+    def init(params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: _quantize(jnp.zeros(p.shape, jnp.float32)), params
+        )
+        return Adam8bitState(count=jnp.zeros([], jnp.int32), m=zeros, v=zeros)
+
+    def update(updates, state, params=None):
+        count = state.count + 1
+
+        def one(g, mq, vq):
+            g = g.astype(jnp.float32)
+            m = b1 * _dequantize(mq) + (1 - b1) * g
+            v = b2 * _dequantize(vq) + (1 - b2) * g * g
+            mhat = m / (1 - b1 ** count.astype(jnp.float32))
+            vhat = v / (1 - b2 ** count.astype(jnp.float32))
+            step = mhat / (jnp.sqrt(vhat) + eps)
+            return step, _quantize(m), _quantize(v)
+
+        flat_u, tdef = jax.tree_util.tree_flatten(updates)
+        flat_m = tdef.flatten_up_to(state.m)
+        flat_v = tdef.flatten_up_to(state.v)
+        out = [one(g, m, v) for g, m, v in zip(flat_u, flat_m, flat_v)]
+        steps = tdef.unflatten([o[0] for o in out])
+        new_m = tdef.unflatten([o[1] for o in out])
+        new_v = tdef.unflatten([o[2] for o in out])
+        return steps, Adam8bitState(count=count, m=new_m, v=new_v)
+
+    return optax.GradientTransformation(init, update)
+
+
+def adamw_8bit(
+    learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+    weight_decay: float = 0.0,
+):
+    """AdamW with int8 moment states (drop-in for optax.adamw)."""
+    chain = [scale_by_adam_8bit(b1=b1, b2=b2, eps=eps)]
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    chain.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*chain)

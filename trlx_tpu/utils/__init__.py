@@ -79,7 +79,7 @@ class Clock:
 class OptimizerName(str, Enum):
     ADAM = "adam"
     ADAMW = "adamw"
-    ADAMW_8BIT_BNB = "adamw_8bit_bnb"  # accepted for config compat; maps to adamw
+    ADAMW_8BIT_BNB = "adamw_8bit_bnb"  # first-party int8-state adamw (ops/adam8bit.py)
     SGD = "sgd"
     LION = "lion"
 
@@ -109,8 +109,12 @@ def get_optimizer_class(name: str):
             return optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps, **kw)
 
         return make_adam
-    if name in (OptimizerName.ADAMW, OptimizerName.ADAMW_8BIT_BNB):
+    if name == OptimizerName.ADAMW:
         return _adamish(optax.adamw)
+    if name == OptimizerName.ADAMW_8BIT_BNB:
+        from trlx_tpu.ops.adam8bit import adamw_8bit
+
+        return _adamish(adamw_8bit)
     if name == OptimizerName.LION:
         def make_lion(lr, betas=(0.9, 0.99), weight_decay=0.0, **kw):
             return optax.lion(lr, b1=betas[0], b2=betas[1], weight_decay=weight_decay, **kw)
