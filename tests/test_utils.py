@@ -1,0 +1,92 @@
+"""Utils coverage (reference analog: tests/test_utils.py — optimizer/
+scheduler getters, RunningMoments vs torch.var_mean, Clock)."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from trlx_tpu.ops.common import RunningMoments, running_moments_update
+from trlx_tpu.utils import (
+    Clock,
+    get_optimizer_class,
+    get_scheduler_class,
+    significant,
+)
+
+
+@pytest.mark.parametrize(
+    "name", ["adam", "adamw", "adamw_8bit_bnb", "sgd", "lion"]
+)
+def test_optimizer_getters(name):
+    make = get_optimizer_class(name)
+    tx = make(1e-4)
+    assert isinstance(tx, optax.GradientTransformation)
+    p = {"w": jnp.ones((4, 4))}
+    st = tx.init(p)
+    g = jax.tree_util.tree_map(jnp.ones_like, p)
+    u, _ = tx.update(g, st, p)
+    assert jax.tree_util.tree_leaves(u)[0].shape == (4, 4)
+
+
+@pytest.mark.parametrize(
+    "name", ["cosine_annealing", "linear", "constant"]
+)
+def test_scheduler_getters(name):
+    make = get_scheduler_class(name)
+    if name == "cosine_annealing":
+        sched = make(1e-3, T_max=100, eta_min=1e-5)
+        assert abs(float(sched(0)) - 1e-3) < 1e-9
+        assert float(sched(100)) <= 1e-3
+    elif name == "linear":
+        sched = make(1e-3, total_steps=100)
+        assert float(sched(0)) >= float(sched(99))
+    else:
+        sched = make(1e-3)
+        assert float(sched(0)) == float(sched(50))
+
+
+def test_running_moments_matches_torch_var_mean():
+    # parity target: reference utils/modeling.py RunningMoments.update,
+    # asserted against torch.var_mean in reference tests/test_utils.py:95-112
+    torch = pytest.importorskip("torch")
+
+    rng = np.random.default_rng(0)
+    rm = RunningMoments(
+        mean=jnp.float32(0.0), std=jnp.float32(1.0),
+        var=jnp.float32(1.0), count=jnp.float32(1e-24),
+    )
+    all_xs = []
+    for _ in range(5):
+        xs = rng.normal(size=(64,)).astype(np.float32) * 2.0 + 0.5
+        all_xs.append(xs)
+        rm, batch_mean, batch_std = running_moments_update(rm, jnp.asarray(xs))
+        t_var, t_mean = torch.var_mean(torch.tensor(xs), unbiased=True)
+        np.testing.assert_allclose(float(batch_mean), t_mean.item(), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(batch_std), t_var.sqrt().item(), rtol=1e-3
+        )
+    full = np.concatenate(all_xs)
+    t_var, t_mean = torch.var_mean(torch.tensor(full), unbiased=True)
+    np.testing.assert_allclose(float(rm.mean), t_mean.item(), rtol=1e-4)
+    np.testing.assert_allclose(
+        float(rm.std), t_var.sqrt().item(), rtol=1e-2
+    )
+
+
+def test_clock_ticks():
+    clock = Clock()
+    dt = clock.tick()
+    assert dt >= 0.0
+    assert clock.tick() >= 0.0
+
+
+def test_significant():
+    assert significant(0.123456) == 0.12
+    assert significant(1234.5) == 1200.0
+    assert significant(0.0) == 0.0
+    assert significant("str") == "str"
