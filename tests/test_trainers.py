@@ -14,6 +14,7 @@ import trlx_tpu
 from trlx_tpu.data.default_configs import (
     default_ilql_config,
     default_ppo_config,
+    default_rft_config,
     default_sft_config,
 )
 
@@ -296,3 +297,28 @@ def test_ppo_save_load_roundtrip(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(out_a["logits"]), np.asarray(out_b["logits"])
     )
+
+
+@pytest.mark.slow
+def test_rft_learn(tmp_path):
+    config = default_rft_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=10, checkpoint_interval=10,
+            seq_length=16, epochs=2, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=tiny_model_cfg(),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            n_generations_per_prompt=2, start_percentile=0.1, end_percentile=0.9,
+            n_improve_steps=2,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    prompts = ["hello world", "the cat", "a b", "xyz", "what is", "I am", "go", "ok"]
+    trainer = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=prompts, config=config
+    )
+    assert trainer.iter_count >= 1
+    # the generation pool got filled and selection produced a train set
+    assert trainer.generations_per_prompt

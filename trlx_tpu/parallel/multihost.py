@@ -203,6 +203,30 @@ def barrier(name: str) -> None:
     multihost_utils.sync_global_devices(name)
 
 
+def allgather_object(obj) -> list:
+    """Gather one JSON-serializable host object per process; every
+    process receives the list in process order (the reference's
+    torch.distributed all_gather_object, e.g. RFT generations —
+    accelerate_rft_trainer.py:127-144)."""
+    if not is_multihost():
+        return [obj]
+    import json
+
+    from jax.experimental import multihost_utils
+
+    data = np.frombuffer(json.dumps(obj).encode("utf-8"), np.uint8)
+    lengths = np.asarray(
+        multihost_utils.process_allgather(np.asarray([len(data)], np.int32))
+    ).reshape(-1)
+    padded = np.zeros(int(lengths.max()), np.uint8)
+    padded[: len(data)] = data
+    rows = np.asarray(multihost_utils.process_allgather(padded))
+    return [
+        json.loads(bytes(row[:n]).decode("utf-8"))
+        for row, n in zip(rows, lengths)
+    ]
+
+
 def gather_params(tree):
     """Materialize a (possibly fsdp/tp-sharded) param tree as host numpy
     on EVERY process (collective: all processes must call). Used by the

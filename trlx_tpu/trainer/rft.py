@@ -20,6 +20,7 @@ from trlx_tpu.data import SFTBatch
 from trlx_tpu.data.method_configs import RFTConfig
 from trlx_tpu.models.wrappers import CausalLM
 from trlx_tpu.parallel import shard_params
+from trlx_tpu.parallel import multihost as mh
 from trlx_tpu.pipeline.offline_pipeline import DialogStore, tokenize_dialogue
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
@@ -83,7 +84,12 @@ class TPURFTTrainer(TPUBaseTrainer):
         return sft_loss(out["logits"], labels)
 
     def add_prompt_pipeline(self, pipeline) -> None:
-        self.prompt_dataloader = pipeline.create_loader(self.config.train.batch_size)
+        # multi-host: each process generates/scores its strided slice;
+        # selection happens on the all-gathered pool below
+        pipeline = mh.shard_pipeline(pipeline)
+        self.prompt_dataloader = pipeline.create_loader(
+            max(self.config.train.batch_size // mh.process_count(), 1)
+        )
 
     def make_experience(self, samples=None, rewards=None, seq_length=None) -> None:
         """Regenerate + rescore + reselect the training set (parity:
@@ -94,10 +100,10 @@ class TPURFTTrainer(TPUBaseTrainer):
             for batch in self.prompt_dataloader:
                 for _ in range(method.n_generations_per_prompt):
                     out = self.generate(batch.input_ids, batch.attention_mask)
-                    sequences = np.asarray(out["sequences"])
+                    sequences = mh.local_rows(out["sequences"])
                     _, str_prompts, str_outputs = self.decode(
                         np.asarray(batch.input_ids), sequences,
-                        [batch.input_ids.shape[1]] * len(sequences),
+                        [np.shape(batch.input_ids)[1]] * len(sequences),
                         append_eos_token=True,
                     )
                     generations.extend(
@@ -110,10 +116,18 @@ class TPURFTTrainer(TPUBaseTrainer):
                 prompts=[g["prompt"] for g in generations],
                 outputs=[g["output"] for g in generations],
             )
-            for g, s in zip(generations, scores):
-                self.generations_per_prompt[g["prompt"]].append(
-                    {"output": g["output"], "score": float(s)}
-                )
+            scored = [
+                {"prompt": g["prompt"], "output": g["output"], "score": float(s)}
+                for g, s in zip(generations, scores)
+            ]
+            # multi-host: pool every host's generations so threshold
+            # selection sees the full set (reference all_gather_object,
+            # accelerate_rft_trainer.py:127-144)
+            for part in mh.allgather_object(scored):
+                for g in part:
+                    self.generations_per_prompt[g["prompt"]].append(
+                        {"output": g["output"], "score": g["score"]}
+                    )
 
         per_prompt_scores = [
             [x["score"] for x in self.generations_per_prompt[p]]
@@ -144,6 +158,15 @@ class TPURFTTrainer(TPUBaseTrainer):
         )
 
         if samples_selected:
+            # wrap-pad to a full multiple of the global batch so every
+            # train batch is rectangular and divides the mesh's data ways
+            # (a ragged final batch cannot be sharded)
+            bs = self.config.train.batch_size
+            target = -(-len(samples_selected) // bs) * bs
+            i = 0
+            while len(samples_selected) < target:
+                samples_selected.append(samples_selected[i])
+                i += 1
             dialogs = [
                 tokenize_dialogue(list(pair), self.tokenizer, self.config.train.seq_length)
                 for pair in samples_selected
