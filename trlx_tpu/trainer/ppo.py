@@ -247,7 +247,8 @@ class TPUPPOTrainer(TPUBaseTrainer):
             return self._experience_fns[key]
         model = self.model
 
-        def seq2seq_fn(params, ref_params, enc_ids, enc_mask, dec_ids, response_mask, scores, scores_mask, kl_coef, n_valid):
+        def seq2seq_fn(params, ref_params, enc_ids, enc_mask, dec_ids, response_mask, scores, scores_mask, kl_coef, n_valid, scale_div):
+            scores = scores / jnp.maximum(scale_div, 1e-8)
             mask = response_mask.astype(jnp.float32)
             dec_mask = jnp.concatenate(
                 [jnp.ones_like(dec_ids[:, :1]), response_mask.astype(jnp.int32)], axis=1
@@ -284,7 +285,10 @@ class TPUPPOTrainer(TPUBaseTrainer):
             self._experience_fns[key] = jax.jit(seq2seq_fn)
             return self._experience_fns[key]
 
-        def fn(params, ref_params, tokens, attention_mask, response_mask, scores, scores_mask, kl_coef, n_valid):
+        def fn(params, ref_params, tokens, attention_mask, response_mask, scores, scores_mask, kl_coef, n_valid, scale_div):
+            # reward scaling happens IN-GRAPH so the running std never has
+            # to round-trip to the host inside the rollout loop
+            scores = scores / jnp.maximum(scale_div, 1e-8)
             out = model.forward_train(params, ref_params, tokens, attention_mask)
             logprobs_full = logprobs_of_labels(out["logits"][:, :-1], tokens[:, 1:])
             ref_logprobs_full = logprobs_of_labels(out["ref_logits"][:, :-1], tokens[:, 1:])
@@ -422,24 +426,23 @@ class TPUPPOTrainer(TPUBaseTrainer):
             self.running_moments, scores_mean, scores_std = running_moments_update(
                 self.running_moments, score_sums
             )
-            # one fetch for all four score scalars (vs four round-trips)
-            sm, ss, rmean, rstd = np.asarray(
-                jnp.stack(
-                    [
-                        scores_mean, scores_std,
-                        self.running_moments.mean, self.running_moments.std,
-                    ]
-                )
-            ).tolist()
-            stats["rollout_scores/mean"] = sm
-            stats["rollout_scores/std"] = ss
-            stats["rollout_scores/running_mean"] = rmean
-            stats["rollout_scores/running_std"] = rstd
+            # stats stay DEVICE scalars until the single packed fetch at
+            # the end of make_experience (each host read costs a full
+            # round-trip on a remote-tunneled chip)
+            stats["rollout_scores/mean"] = scores_mean
+            stats["rollout_scores/std"] = scores_std
+            stats["rollout_scores/running_mean"] = self.running_moments.mean
+            stats["rollout_scores/running_std"] = self.running_moments.std
 
+            # reward scaling happens inside the experience fn: pass the
+            # divisor as a device scalar instead of fetching the running
+            # std to the host
             if method.scale_reward == "running":
-                scores /= max(rstd, 1e-8)
+                scale_div = self.running_moments.std
             elif method.scale_reward == "ref":
-                scores /= max(self.ref_std, 1e-8)
+                scale_div = jnp.float32(max(self.ref_std, 1e-8))
+            else:
+                scale_div = jnp.float32(1.0)
 
             # pad rows to the data-parallel multiple for sharding; the
             # extra rows are trimmed off the rollout batch afterwards.
@@ -483,6 +486,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
                     mh.global_from_local(rpad(scores_mask), sharding),
                     jnp.float32(self.kl_ctl.value),
                     jnp.float32(B * mh.process_count()),
+                    scale_div,
                 )
             if target != B:
                 # trim the sharding-pad rows ON DEVICE (the store keeps
@@ -491,14 +495,12 @@ class TPUPPOTrainer(TPUBaseTrainer):
                     lambda x: x[:B], rollout_batch
                 )
 
-            # one fetch for both KL scalars
-            mean_kl, mean_kl_per_token = np.asarray(
-                jnp.stack([kl_stats["mean_kl"], kl_stats["mean_kl_per_token"]])
-            ).tolist()
             stats["time/rollout_time"] = clock.tick()
-            stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
-            stats["policy/kl_per_token"] = float(
-                np.sqrt(max(mean_kl_per_token, 0.0))
+            stats["policy/sqrt_kl"] = jnp.sqrt(
+                jnp.maximum(kl_stats["mean_kl"], 0.0)
+            )
+            stats["policy/kl_per_token"] = jnp.sqrt(
+                jnp.maximum(kl_stats["mean_kl_per_token"], 0.0)
             )
             accumulated_stats.append(stats)
 
@@ -508,10 +510,19 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 pbar.update(len(sequences) * mh.process_count())
             logger.info("[rollout %d / %d]", n_collected, num_rollouts)
 
-        stats = {
+        agg = {
             k: sum(xs[k] for xs in accumulated_stats) / len(accumulated_stats)
             for k in accumulated_stats[-1]
         }
+        # ONE packed fetch for every accumulated device scalar
+        keys = list(agg)
+        vals = [agg[k] for k in keys]
+        dev_ix = [i for i, v in enumerate(vals) if isinstance(v, jax.Array)]
+        if dev_ix:
+            fetched = np.asarray(jnp.stack([vals[i] for i in dev_ix]))
+            for i, f in zip(dev_ix, fetched.tolist()):
+                vals[i] = f
+        stats = {k: float(v) for k, v in zip(keys, vals)}
         if hasattr(pbar, "close"):
             pbar.close()
         stats["kl_ctl_value"] = self.kl_ctl.value
