@@ -1,0 +1,51 @@
+"""Subprocess driver: SFT + ILQL under 2-process jax.distributed (the
+offline-data trainers; each process holds the identical dataset and
+device_put shards rows onto the global mesh). Run via
+tests/test_multihost.py."""
+
+import os
+import sys
+
+pid, nproc, port, workdir = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from trlx_tpu.parallel import multihost as mh
+mh.initialize(f"127.0.0.1:{port}", nproc, pid)
+
+import numpy as np
+import trlx_tpu
+from trlx_tpu.data.default_configs import default_sft_config, default_ilql_config
+
+config = default_sft_config().evolve(
+    train=dict(batch_size=8, total_steps=2, tracker=None, seq_length=16,
+               checkpoint_interval=100, eval_interval=100,
+               checkpoint_dir=os.path.join(workdir, "sft_ckpts"), mesh={"dp": -1}),
+    model=dict(model_path="random",
+               model_extra_configs={"transformer": dict(hidden_size=16, n_layer=2, n_head=2, n_positions=64)}),
+    tokenizer=dict(tokenizer_path="byte"),
+    method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=False)),
+)
+samples = [("q", "a b c"), ("w", "d e"), ("e", "f g"), ("r", "h i"),
+           ("t", "j k"), ("y", "l m"), ("u", "n o"), ("i", "p q")]
+t = trlx_tpu.train(samples=samples, config=config)
+print(f"SFT_MH_OK pid={pid} iter={t.iter_count}", flush=True)
+
+config2 = default_ilql_config().evolve(
+    train=dict(batch_size=8, total_steps=2, tracker=None, seq_length=16,
+               checkpoint_interval=100, eval_interval=100,
+               checkpoint_dir=os.path.join(workdir, "ilql_ckpts"), mesh={"dp": -1}),
+    model=dict(model_path="random",
+               model_extra_configs={"transformer": dict(hidden_size=16, n_layer=2, n_head=2, n_positions=64)}),
+    tokenizer=dict(tokenizer_path="byte"),
+    method=dict(gen_kwargs=dict(max_new_tokens=4, beta=1.0)),
+)
+t2 = trlx_tpu.train(
+    samples=["a b", "c d", "e f", "g h", "i j", "k l", "m n", "o p"],
+    rewards=[1.0, 0.5, 0.2, 0.9, 0.1, 0.8, 0.3, 0.7],
+    config=config2,
+)
+print(f"ILQL_MH_OK pid={pid} iter={t2.iter_count}", flush=True)

@@ -63,3 +63,28 @@ def test_ppo_learn_two_processes(tmp_path):
     metrics_fp = os.path.join(str(tmp_path), "ckpts", "logs", "metrics.jsonl")
     recs = [json.loads(l) for l in open(metrics_fp)]
     assert any("reward/mean" in r for r in recs)
+
+
+@pytest.mark.slow
+def test_sft_ilql_two_processes(tmp_path):
+    # the offline trainers (SFT/ILQL): identical per-host datasets,
+    # device_put row-sharding onto the global mesh
+    driver = os.path.join(REPO, "tests", "multihost_offline_driver.py")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, driver, str(pid), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=560)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+        assert f"SFT_MH_OK pid={pid}" in out
+        assert f"ILQL_MH_OK pid={pid}" in out
