@@ -1,0 +1,17 @@
+#!/bin/bash
+# Launch a training script on every worker of a TPU pod slice
+# (parity: /root/reference/scripts/slurm_train.sh — the reference's
+# multi-node SLURM launcher; on TPU VMs the launcher is gcloud).
+#
+# Usage: TPU=<name> ZONE=<zone> scripts/tpu_pod_train.sh examples/ppo_sentiments.py '{"train.mesh": {"fsdp": 8}}'
+set -euo pipefail
+
+TPU="${TPU:?set TPU=<tpu-vm name>}"
+ZONE="${ZONE:?set ZONE=<gce zone>}"
+SCRIPT="${1:?usage: tpu_pod_train.sh <script.py> [hparams-json]}"
+HPARAMS="${2:-{}}"
+
+# every worker runs the identical SPMD program; jax.distributed
+# auto-detects the pod topology from the TPU runtime env
+gcloud compute tpus tpu-vm ssh "$TPU" --zone "$ZONE" --worker=all \
+  --command "cd ~/trlx_tpu && python $SCRIPT '$HPARAMS'"
