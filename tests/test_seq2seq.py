@@ -181,3 +181,34 @@ def test_seq2seq_ppo_lora_learn(tmp_path):
         jax.tree_util.tree_leaves(trainer.ref_params),
     ):
         np.testing.assert_allclose(np.asarray(b), np.asarray(r), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_seq2seq_ilql_lora_learn(tmp_path):
+    # ILQL x seq2seq x LORA — part of the reference peft matrix
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_ilql_config
+
+    config = default_ilql_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=10, checkpoint_interval=10,
+            seq_length=16, tracker=None, checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=dict(
+            model_path="random", model_arch_type="seq2seq",
+            peft_config={"peft_type": "LORA", "r": 2, "lora_alpha": 4},
+            model_extra_configs={
+                "seq2seq": dict(d_model=16, n_layer=2, n_head=2, d_kv=8, d_ff=32,
+                                relative_attention_num_buckets=8)
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, beta=1.0)),
+    )
+    trainer = trlx_tpu.train(
+        samples=[["a b", "c d"], ["e f", "g h"], ["i j", "k l"], ["m n", "o p"]] * 2,
+        rewards=[1.0, 0.5, 0.2, 0.9] * 2,
+        config=config,
+    )
+    assert trainer.iter_count == 2
+    assert "lora" in trainer.params

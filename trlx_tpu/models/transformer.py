@@ -86,10 +86,10 @@ class TransformerConfig:
     # ops.ring_attention over the mesh's `sp` axis — requires
     # TransformerLM.mesh to be set and seq divisible by sp; decode steps
     # and non-plain-bias architectures fall back to XLA).
-    # Note: the pallas path's custom_vjp recomputes attention in plain XLA
-    # on the backward pass, so gradient-taking forwards (PPO/SFT train
-    # steps) see no HBM saving from it — the win is on no-grad forwards
-    # (rollout scoring, hydra/ref logits, eval).
+    # The pallas path is fused in BOTH directions (online-softmax forward
+    # + chunked flash backward, ops/flash_attention.py): the [B,H,T,S]
+    # score tensor never exists, so training at 8k+ tokens is where it
+    # pays for itself.
     attention_impl: str = "xla"
 
     def __post_init__(self):

@@ -140,7 +140,16 @@ class TPUILQLTrainer(TPUBaseTrainer):
         method = self.config.method
         if self.seq2seq:
             if self.config.model.peft_config is not None:
-                raise NotImplementedError("peft with seq2seq ILQL is not supported")
+                from trlx_tpu.models.peft import normalize_peft_config
+
+                if normalize_peft_config(self.config.model.peft_config)[
+                    "peft_type"
+                ] != "LORA":
+                    # matches the reference matrix (its peft tests skip
+                    # seq2seq x {PROMPT,PREFIX}, peft 0.3.0 bugs)
+                    raise NotImplementedError(
+                        "seq2seq ILQL supports peft_type='LORA' only"
+                    )
             self.model = Seq2SeqLMWithILQLHeads(
                 cfg, two_qs=method.two_qs, alpha=method.alpha
             )
@@ -159,8 +168,7 @@ class TPUILQLTrainer(TPUBaseTrainer):
                     heads[k] = [heads[k][i] for i in sorted(heads[k], key=int)]
             aux = dict(aux, heads=heads)
         params.update(aux)
-        if not self.seq2seq:
-            params = self.attach_lora(params)
+        params = self.attach_peft(params)
         self.params = shard_params(self.mesh, params)
 
     def trainable_mask(self):
