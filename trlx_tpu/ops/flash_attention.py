@@ -41,7 +41,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
-CHUNK = 512  # key/query chunk for the in-kernel loops
+# key/query chunk for the in-kernel loops: each fp32 score tile is
+# [block, CHUNK]. 1024 runs the 8k fwd+bwd ~3x faster than 512 on v5e
+# (better MXU occupancy per DMA) while keeping tiles ~1 MB in VMEM.
+CHUNK = 1024
 
 
 def _interpret() -> bool:
@@ -334,7 +337,7 @@ def _flash_backward(q, k, v, key_mask, o, m, l, g, causal, sm_scale, block_q):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def flash_attention(q, k, v, key_mask, causal=True, sm_scale=None, block_q=128):
+def flash_attention(q, k, v, key_mask, causal=True, sm_scale=None, block_q=256):
     """Fused attention. q/k/v: [B, H, T|S, D]; key_mask: [B, S] (1=real).
 
     Causality compares PHYSICAL slots with queries right-aligned against
