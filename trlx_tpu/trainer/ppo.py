@@ -341,13 +341,28 @@ class TPUPPOTrainer(TPUBaseTrainer):
         method = self.config.method
 
         pbar = logging.progress(total=num_rollouts, desc="rollouts")
+        # one-chunk lookahead: generation for chunk i+1 is DISPATCHED
+        # before chunk i's host work (decode + reward_fn), so the device
+        # samples while the host scores — the reference's rollout loop is
+        # fully serial here (SURVEY §7 "host-device choreography")
+        next_batch: Optional[PromptBatch] = next(self.prompt_iterator)
+        rollout_generate_time = time()
+        next_gen = self.generate(next_batch.input_ids, next_batch.attention_mask)
+        next_gen_time = time() - rollout_generate_time
+        chunk_rows = len(next_batch.input_ids) * mh.process_count()
         while n_collected < num_rollouts:
             stats: Dict[str, float] = {}
-            batch: PromptBatch = next(self.prompt_iterator)
-
-            rollout_generate_time = time()
-            gen_out = self.generate(batch.input_ids, batch.attention_mask)
-            stats["time/rollout_generate"] = time() - rollout_generate_time
+            batch, gen_out = next_batch, next_gen
+            stats["time/rollout_generate"] = next_gen_time
+            if n_collected + chunk_rows < num_rollouts:
+                next_batch = next(self.prompt_iterator)
+                rollout_generate_time = time()
+                next_gen = self.generate(
+                    next_batch.input_ids, next_batch.attention_mask
+                )
+                next_gen_time = time() - rollout_generate_time
+            else:
+                next_batch, next_gen = None, None
 
             prompt_tensors = np.asarray(batch.input_ids)
             # ONE packed device->host fetch: a remote-tunneled chip pays
