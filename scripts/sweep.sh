@@ -1,0 +1,11 @@
+#!/bin/bash
+# Sweep entry (parity: /root/reference/scripts/sweep-cw.sh — the
+# reference dispatched ray workers; trials here run sequentially on the
+# full mesh).
+#
+# Usage: scripts/sweep.sh configs/sweeps/ppo_sweep.yml examples/ppo_sentiments.py [output-dir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+CONFIG="${1:?usage: sweep.sh <sweep.yml> <script.py> [output-dir]}"
+SCRIPT="${2:?usage: sweep.sh <sweep.yml> <script.py> [output-dir]}"
+python -m trlx_tpu.sweep "$SCRIPT" --config "$CONFIG" --output "${3:-sweeps_out}"

@@ -322,3 +322,32 @@ def test_rft_learn(tmp_path):
     assert trainer.iter_count >= 1
     # the generation pool got filled and selection produced a train set
     assert trainer.generations_per_prompt
+
+
+@pytest.mark.slow
+def test_ppo_dense_rewards_learn(tmp_path):
+    # per-token reward vectors exercise the S>1 branch of the experience
+    # fn (parity: examples/ppo_dense_sentiments.py)
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=10, checkpoint_interval=10,
+            seq_length=12, epochs=2, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=tiny_model_cfg(num_layers_unfrozen=1),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+
+    def dense_reward(samples, prompts, outputs, **kw):
+        # one reward per generated character chunk: a vector per sample
+        return [np.linspace(0.0, 1.0, max(len(o), 1)) for o in outputs]
+
+    prompts = ["hello world", "the cat", "a b", "xyz", "what is", "I am", "go", "ok"]
+    trainer = trlx_tpu.train(
+        reward_fn=dense_reward, prompts=prompts, config=config
+    )
+    assert trainer.iter_count == 2
