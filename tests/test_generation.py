@@ -101,3 +101,31 @@ def test_from_gen_kwargs_ignores_foreign_keys():
         eos_token_id=3, pad_token_id=0,
     )
     assert s.max_new_tokens == 4 and s.top_k == 5 and s.eos_token_id == 3
+
+
+def test_early_exit_pads_after_all_eos(tiny_lm):
+    # once every row emits EOS the while_loop exits; remaining columns
+    # must be pad with mask 0, identical to running the full trip count
+    lm, params = tiny_lm
+    EOS, PAD, N = 7, 0, 10
+
+    def force_eos_at_1(hidden, logits):
+        # first sampled token free, everything after forced to EOS
+        return jnp.full_like(logits, -1e9).at[:, EOS].set(0.0)
+
+    settings = SamplerSettings(
+        max_new_tokens=N, do_sample=False, eos_token_id=EOS, pad_token_id=PAD
+    )
+    B, P = 2, 4
+    ids = jnp.ones((B, P), jnp.int32)
+    mask = jnp.ones((B, P), jnp.int32)
+    out = generate(
+        lm, params, ids, mask, jax.random.PRNGKey(0), settings,
+        logits_processor=force_eos_at_1,
+    )
+    resp = np.asarray(out["response_ids"])
+    rmask = np.asarray(out["response_mask"])
+    # col 0: EOS (real), cols 1..: pad, not real
+    assert (resp[:, 0] == EOS).all()
+    assert (resp[:, 1:] == PAD).all()
+    assert rmask[:, 0].all() and not rmask[:, 1:].any()

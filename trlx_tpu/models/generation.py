@@ -196,28 +196,50 @@ def generate(
         sub, out["hidden_states"][:, -1], out["logits"][:, -1], finished0
     )
 
-    def step(carry, rng_t):
-        cache, tok, pos, finished, was_real = carry
-        step_out = model(params, tok[:, None], positions=pos[:, None], cache=cache)
-        next_tok, now_finished = pick_next(
-            rng_t, step_out["hidden_states"][:, -1], step_out["logits"][:, -1], finished
-        )
-        # the token we just *emitted* (tok) was real iff its sequence had
-        # not finished before it was sampled
-        y = (tok, was_real)
-        return (step_out["cache"], next_tok, pos + 1, now_finished, ~finished), y
-
     if N > 1:
-        step_rngs = jax.random.split(rng, N - 1)
         pos0 = prompt_len  # next token's real position
-        carry0 = (out["cache"], tok0, pos0, finished0, jnp.ones((B,), bool))
-        (cache_f, tok_last, _, finished_f, last_real), (toks, reals) = jax.lax.scan(
-            step, carry0, step_rngs
+        ids_buf = jnp.full((B, N), jnp.int32(settings.pad_token_id))
+        mask_buf = jnp.zeros((B, N), bool)
+        ids_buf = ids_buf.at[:, 0].set(tok0)
+        mask_buf = mask_buf.at[:, 0].set(True)
+
+        # lax.while_loop instead of a fixed-trip scan: once every row has
+        # emitted its EOS the loop exits early — real tasks' responses
+        # average well under max_new_tokens, and SPMD makes the early
+        # exit safe (every host runs the same global condition; the
+        # reference needed synced_gpus/no-early-break workarounds —
+        # SURVEY §7 hard parts)
+        def cond(state):
+            _, _, _, finished, t, _, _, _ = state
+            return (t < N) & ~jnp.all(finished)
+
+        def body(state):
+            cache, tok, pos, finished, t, rng, ids_buf, mask_buf = state
+            step_out = model(
+                params, tok[:, None], positions=pos[:, None], cache=cache
+            )
+            rng, sub = jax.random.split(rng)
+            next_tok, now_finished = pick_next(
+                sub, step_out["hidden_states"][:, -1], step_out["logits"][:, -1],
+                finished,
+            )
+            real = ~finished  # next_tok is real iff not finished before it
+            ids_buf = jax.lax.dynamic_update_slice_in_dim(
+                ids_buf, next_tok[:, None], t, axis=1
+            )
+            mask_buf = jax.lax.dynamic_update_slice_in_dim(
+                mask_buf, real[:, None], t, axis=1
+            )
+            return (
+                step_out["cache"], next_tok, pos + 1, now_finished, t + 1,
+                rng, ids_buf, mask_buf,
+            )
+
+        state = (out["cache"], tok0, pos0, finished0, jnp.int32(1), rng,
+                 ids_buf, mask_buf)
+        (_, _, _, _, _, _, response_ids, response_mask) = jax.lax.while_loop(
+            cond, body, state
         )
-        response_ids = jnp.concatenate(
-            [toks.T, tok_last[:, None]], axis=1
-        )  # [B, N]: t0..t_{N-2} emitted by scan ys, t_{N-1} from final carry
-        response_mask = jnp.concatenate([reals.T, last_real[:, None]], axis=1)
     else:
         response_ids = tok0[:, None]
         response_mask = jnp.ones((B, 1), bool)
