@@ -1,80 +1,53 @@
 """PPO Llama-2-7B on IMDB sentiment continuation (parity:
-/root/reference/examples/ppo_sentiments_llama.py). The llama mapping
-(models/hf.py: rmsnorm + rotary + SwiGLU, untied head) plus the frozen
-top-2-layer hydra reference, on a tp+fsdp mesh sized for a 7B policy.
-Requires HF hub access; for an air-gapped llama-architecture smoke test,
-set model_path="random" with a "transformer" dict using
-norm="rmsnorm", pos_embed="rotary", mlp_gated=True.
+/root/reference/examples/ppo_sentiments_llama.py). Exercises the llama
+mapping (models/hf.py: rmsnorm + rotary + SwiGLU, untied head) with the
+frozen top-2-layer hydra reference, on a tp+fsdp mesh sized for a 7B
+policy. Requires HF hub access; for an air-gapped llama-architecture
+smoke test see tests/test_peft.py::test_ppo_llama_arch_with_lora
+(random weights, same architecture switches).
 """
 
 from typing import Dict, List
 
 import trlx_tpu
-from trlx_tpu.data.configs import (
-    ModelConfig,
-    OptimizerConfig,
-    SchedulerConfig,
-    TokenizerConfig,
-    TrainConfig,
-    TRLConfig,
-)
-from trlx_tpu.data.method_configs import PPOConfig
+from trlx_tpu.data.default_configs import TRLConfig, default_ppo_config
 
-
-def get_positive_score(scores: List[Dict[str, float]]) -> float:
-    return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
+LLAMA = "NousResearch/Llama-2-7b-hf"
 
 
 def llama_config() -> TRLConfig:
-    return TRLConfig(
-        train=TrainConfig(
+    return default_ppo_config().evolve(
+        train=dict(
             seq_length=1024,
-            epochs=100,
             total_steps=400,
             batch_size=32,
-            checkpoint_interval=10000,
             eval_interval=100,
-            pipeline="PromptPipeline",
-            trainer="TPUPPOTrainer",
             save_best=False,
-            # 7B on a pod slice: shard params over fsdp, attention heads
-            # over tp; dp absorbs the rest
+            # 7B policy: params/opt-state sharded over fsdp, attention
+            # heads over tp; dp absorbs the remaining chips
             mesh={"dp": -1, "fsdp": 4, "tp": 2},
             compute_dtype="bfloat16",
         ),
-        model=ModelConfig(
-            model_path="NousResearch/Llama-2-7b-hf", num_layers_unfrozen=2
-        ),
-        tokenizer=TokenizerConfig(
-            tokenizer_path="NousResearch/Llama-2-7b-hf", truncation_side="right"
-        ),
-        optimizer=OptimizerConfig(
+        model=dict(model_path=LLAMA, num_layers_unfrozen=2),
+        tokenizer=dict(tokenizer_path=LLAMA, truncation_side="right"),
+        optimizer=dict(
             name="adamw",
-            kwargs=dict(lr=1e-5, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6),
+            kwargs=dict(lr=1e-5, betas=(0.9, 0.95), eps=1e-8, weight_decay=1e-6),
         ),
-        scheduler=SchedulerConfig(
-            name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=1.0e-5)
+        scheduler=dict(
+            name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=1e-5)
         ),
-        method=PPOConfig(
-            name="PPOConfig",
+        method=dict(
             num_rollouts=128,
             chunk_size=128,
-            ppo_epochs=4,
             init_kl_coef=0.001,
-            target=6,
-            horizon=10000,
-            gamma=1,
-            lam=0.95,
-            cliprange=0.2,
-            cliprange_value=0.2,
-            vf_coef=1,
-            scale_reward="ignored",
-            ref_mean=None,
-            ref_std=None,
-            cliprange_reward=10,
             gen_kwargs=dict(max_new_tokens=40, top_k=0, top_p=1.0, do_sample=True),
         ),
     )
+
+
+def positive_score(scores: List[Dict[str, float]]) -> float:
+    return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
 
 
 def main(hparams={}):
@@ -92,7 +65,7 @@ def main(hparams={}):
     )
 
     def reward_fn(samples: List[str], **kwargs) -> List[float]:
-        return list(map(get_positive_score, sentiment_fn(samples)))
+        return [positive_score(s) for s in sentiment_fn(samples)]
 
     imdb = load_dataset("imdb", split="train+test")
     prompts = [" ".join(review.split()[:4]) for review in imdb["text"]]
@@ -109,5 +82,4 @@ if __name__ == "__main__":
     import json
     import sys
 
-    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
-    main(hparams)
+    main({} if len(sys.argv) == 1 else json.loads(sys.argv[1]))
