@@ -18,11 +18,9 @@ LLAMA = "NousResearch/Llama-2-7b-hf"
 def llama_config() -> TRLConfig:
     return default_ppo_config().evolve(
         train=dict(
-            seq_length=1024,
             total_steps=400,
-            batch_size=32,
-            eval_interval=100,
             save_best=False,
+            tracker="tensorboard",
             # 7B policy: params/opt-state sharded over fsdp, attention
             # heads over tp; dp absorbs the remaining chips
             mesh={"dp": -1, "fsdp": 4, "tp": 2},
@@ -37,12 +35,9 @@ def llama_config() -> TRLConfig:
         scheduler=dict(
             name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=1e-5)
         ),
-        method=dict(
-            num_rollouts=128,
-            chunk_size=128,
-            init_kl_coef=0.001,
-            gen_kwargs=dict(max_new_tokens=40, top_k=0, top_p=1.0, do_sample=True),
-        ),
+        # adaptive KL: init_kl_coef=0.001 is the default; target=6 turns the
+        # fixed controller into AdaptiveKLController(0.001, 6, 10000)
+        method=dict(target=6),
     )
 
 

@@ -71,3 +71,67 @@ def test_method_registry():
 def test_mesh_defaults():
     cfg = default_ppo_config()
     assert cfg.train.mesh == {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1}
+
+
+def test_method_loss_delegates_match_ops():
+    """PPOConfig.loss / .get_advantages_and_returns and ILQLConfig.loss are
+    thin hyperparameter-binding facades over ops/{ppo,ilql}.py — assert they
+    produce the exact op outputs (they are public API surface, reference
+    modeling_ppo.py:136-238, modeling_ilql.py:94-166)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trlx_tpu.data import ILQLBatch
+    from trlx_tpu.ops.ilql import ilql_loss
+    from trlx_tpu.ops.ppo import gae_advantages_and_returns, ppo_loss
+
+    rng = np.random.default_rng(0)
+    B, T = 3, 6
+    f32 = lambda *s: jnp.array(rng.normal(size=s).astype(np.float32))
+
+    mcfg = PPOConfig(
+        name="PPOConfig", cliprange=0.15, cliprange_value=0.25, vf_coef=0.7, gamma=0.9, lam=0.8
+    )
+    values, rewards = f32(B, T), f32(B, T)
+    adv_c, ret_c = mcfg.get_advantages_and_returns(values, rewards, T)
+    adv_o, ret_o = gae_advantages_and_returns(values, rewards, gamma=0.9, lam=0.8)
+    np.testing.assert_array_equal(np.asarray(adv_c), np.asarray(adv_o))
+    np.testing.assert_array_equal(np.asarray(ret_c), np.asarray(ret_o))
+
+    lp, v, olp, ov = f32(B, T), f32(B, T), f32(B, T), f32(B, T)
+    mask = jnp.ones((B, T), jnp.float32)
+    loss_c, stats_c = mcfg.loss(lp, v, olp, ov, adv_o, ret_o, mask)
+    loss_o, stats_o = ppo_loss(
+        lp, v, olp, ov, adv_o, ret_o, mask,
+        cliprange=0.15, cliprange_value=0.25, vf_coef=0.7,
+    )
+    assert float(loss_c) == float(loss_o)
+    assert set(stats_c) == set(stats_o)
+    for k in stats_o:
+        np.testing.assert_array_equal(np.asarray(stats_c[k]), np.asarray(stats_o[k]))
+
+    V, n_actions, n_states = 11, 4, 5
+    icfg = ILQLConfig(
+        name="ILQLConfig", tau=0.6, gamma=0.95, cql_scale=0.2, awac_scale=0.5, beta=0.1
+    )
+    qs = [f32(B, n_actions, V) for _ in range(2)]
+    tqs = [q + 0.1 for q in qs]
+    vs = f32(B, n_states, 1)
+    logits = f32(B, n_actions, V)
+    batch = ILQLBatch(
+        input_ids=jnp.array(rng.integers(0, V, size=(B, T))),
+        attention_mask=jnp.ones((B, T), jnp.int32),
+        rewards=f32(B, n_actions),
+        states_ixs=jnp.array(rng.integers(0, T - 1, size=(B, n_states))),
+        actions_ixs=jnp.array(np.sort(rng.integers(0, T - 1, size=(B, n_actions)), axis=-1)),
+        dones=jnp.ones((B, n_states), jnp.int32),
+    )
+    loss_c, stats_c = icfg.loss((logits, (qs, tqs, vs)), batch)
+    loss_o, stats_o = ilql_loss(
+        logits, qs, tqs, vs, batch,
+        tau=0.6, gamma=0.95, cql_scale=0.2, awac_scale=0.5, beta=0.1, two_qs=True,
+    )
+    assert float(loss_c) == float(loss_o)
+    assert set(stats_c) == set(stats_o)
+    for k in stats_o:
+        np.testing.assert_array_equal(np.asarray(stats_c[k]), np.asarray(stats_o[k]))
