@@ -43,10 +43,11 @@ def test_param_pspec_rules():
     params = TransformerLM(cfg).init(jax.random.PRNGKey(0))
     specs = infer_param_pspecs(params)
     assert specs["embed"]["wte"] == P("tp", "fsdp")
-    assert specs["blocks"]["attn"]["q"]["kernel"] == P(None, "fsdp", "tp", None)
-    assert specs["blocks"]["attn"]["o"]["kernel"] == P(None, "tp", None, "fsdp")
-    assert specs["blocks"]["mlp"]["fc_in"]["kernel"] == P(None, "fsdp", "tp")
-    assert specs["blocks"]["mlp"]["fc_out"]["kernel"] == P(None, "tp", "fsdp")
+    assert specs["blocks"]["attn"]["q"]["kernel"] == P("pp", "fsdp", "tp", None)
+    assert specs["blocks"]["attn"]["o"]["kernel"] == P("pp", "tp", None, "fsdp")
+    assert specs["blocks"]["mlp"]["fc_in"]["kernel"] == P("pp", "fsdp", "tp")
+    assert specs["blocks"]["mlp"]["fc_out"]["kernel"] == P("pp", "tp", "fsdp")
+    assert specs["blocks"]["ln_1"]["scale"] == P("pp")
     assert specs["lm_head"]["kernel"] == P("fsdp", "tp")
     assert specs["ln_f"]["scale"] == P()
 
@@ -80,7 +81,7 @@ def test_indivisible_dims_fall_back_replicated():
     )
     params = TransformerLM(cfg).init(jax.random.PRNGKey(0))
     specs = infer_param_pspecs(params, mesh)
-    assert specs["blocks"]["attn"]["q"]["kernel"] == P(None, "fsdp", None, None)
+    assert specs["blocks"]["attn"]["q"]["kernel"] == P("pp", "fsdp", None, None)
 
 
 def test_opt_state_shards_like_params():
@@ -102,7 +103,7 @@ def test_opt_state_shards_like_params():
         opt_state = init_sharded_opt_state(mesh, optax.adamw(1e-4), sharded)
     mu = opt_state[0].mu
     assert mu["embed"]["wte"].sharding.spec == P("tp", "fsdp")
-    assert mu["blocks"]["attn"]["q"]["kernel"].sharding.spec == P(None, "fsdp", "tp", None)
+    assert mu["blocks"]["attn"]["q"]["kernel"].sharding.spec == P("pp", "fsdp", "tp", None)
     # every opt leaf must be mesh-wide (no single-device stragglers)
     for leaf in jax.tree_util.tree_leaves(opt_state):
         assert len(leaf.sharding.device_set) == mesh.size

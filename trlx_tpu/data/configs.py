@@ -148,7 +148,9 @@ class TrainConfig(_Section):
     # Mesh axis sizes; any axis set to -1 absorbs the remaining devices.
     # dp: data parallel, fsdp: param/opt-state sharded data parallel
     # (ZeRO-3 parity), tp: tensor parallel (Megatron parity), sp: sequence
-    # (context) parallel for long sequences (ring attention).
+    # (context) parallel for long sequences (ring attention), pp: pipeline
+    # parallel (GPipe microbatching over the stacked layer axis; mutually
+    # exclusive with sp).
     mesh: Dict[str, int] = field(default_factory=lambda: {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1})
     # Precision of params/compute; optimizer state stays fp32.
     param_dtype: str = "float32"

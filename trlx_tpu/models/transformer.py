@@ -91,6 +91,10 @@ class TransformerConfig:
     # score tensor never exists, so training at 8k+ tokens is where it
     # pays for itself.
     attention_impl: str = "xla"
+    # pipeline parallelism: microbatches per pipelined forward when the
+    # mesh has a pp axis > 1 (0 = one microbatch per pipeline stage).
+    # The bubble fraction is (pp-1)/(M+pp-1); raise M to amortize it.
+    pp_microbatches: int = 0
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -489,6 +493,87 @@ class TransformerLM:
             return None
         return self.mesh
 
+    def _pp_mesh(self, batch: int, cache) -> Optional[Any]:
+        """The mesh to pipeline the layer stack over, or None for the
+        sequential scan. Static (trace-time) decision. Pipelining needs a
+        teacher-forced forward (decode steps thread a KV cache through
+        every layer sequentially anyway) and divisible shapes; ring
+        attention (sp) composes with dp/fsdp/tp but not with pp."""
+        cfg = self.cfg
+        if self.mesh is None or cache is not None:
+            return None
+        m = self.mesh.shape
+        if m.get("pp", 1) <= 1:
+            return None
+        if m.get("sp", 1) > 1:
+            raise ValueError(
+                "pp and sp are mutually exclusive: ring attention shards the "
+                f"sequence inside each layer, pipelining shards the layers (mesh {dict(m)})"
+            )
+        n_mb = cfg.pp_microbatches or m["pp"]
+        if cfg.n_layer % m["pp"] or batch % n_mb:
+            import warnings
+
+            warnings.warn(
+                f"pipeline parallelism requested (pp={m['pp']}) but "
+                f"n_layer={cfg.n_layer} or batch={batch} don't divide "
+                f"(microbatches={n_mb}); falling back to the sequential scan",
+                stacklevel=3,
+            )
+            return None
+        return self.mesh
+
+    def _pipeline_blocks(
+        self,
+        block_params: Dict,
+        h: Array,
+        attn_bias: Array,
+        positions: Array,
+        *,
+        remat: bool = False,
+        key_mask: Optional[Array] = None,
+        local_bias: Optional[Array] = None,
+        capture_points: Tuple[int, ...] = (),
+    ) -> Tuple[Array, Tuple[Array, ...]]:
+        """The pipelined counterpart of `_scan_blocks` over the FULL layer
+        stack: stages = contiguous slices of the stacked params on the
+        mesh's `pp` axis, GPipe microbatch schedule, captures returned for
+        the hydra/value branches (parallel/pipeline.py has the schedule)."""
+        from trlx_tpu.parallel.pipeline import pipelined_layers
+
+        cfg = self.cfg
+        flags = self._layer_flags(cfg.n_layer, 0)
+        xs: Dict[str, Any] = {"p": block_params}
+        if flags is not None:
+            xs["flag"] = flags
+        ctx = {
+            "bias": attn_bias,
+            "pos": positions,
+            "km": key_mask,
+            "lb": local_bias,
+        }
+
+        def layer_apply(layer, h, ctx_mb):
+            bias = ctx_mb["bias"]
+            if "flag" in layer:
+                bias = bias + layer["flag"] * ctx_mb["lb"]
+            out, _ = self.block.apply(
+                {"params": layer["p"]}, h, bias, ctx_mb["pos"], None,
+                ctx_mb["km"], None,
+            )
+            return out
+
+        return pipelined_layers(
+            self.mesh,
+            layer_apply,
+            xs,
+            h,
+            ctx,
+            n_microbatch=cfg.pp_microbatches or self.mesh.shape["pp"],
+            capture_points=capture_points,
+            remat=remat,
+        )
+
     # -- bias / embedding helpers ---------------------------------------
 
     def _build_bias(
@@ -681,6 +766,7 @@ class TransformerLM:
             # pad-aware positions shifted past the prefix (HF past-length
             # semantics)
             positions = n + jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+        ring = None
         if cache is not None:
             S = cache["k"].shape[2]  # [L, B, S, Hkv, D]
             q_slots = cache["index"] + jnp.arange(T)
@@ -714,12 +800,20 @@ class TransformerLM:
             h = jax.lax.dynamic_update_slice_in_dim(
                 h, h[:, :n_rows] - wte0 + soft, 0, axis=1
             )
-        h, new_cache = self._scan_blocks(
-            params["blocks"], h, bias, positions, layer_cache, remat=remat,
-            key_mask=None if cache is not None else attention_mask,
-            local_bias=local_bias,
-            ring_mesh=None if cache is not None else ring,
-        )
+        pp = None if ring is not None else self._pp_mesh(B, layer_cache)
+        if pp is not None:
+            h, _ = self._pipeline_blocks(
+                params["blocks"], h, bias, positions, remat=remat,
+                key_mask=attention_mask, local_bias=local_bias,
+            )
+            new_cache = None
+        else:
+            h, new_cache = self._scan_blocks(
+                params["blocks"], h, bias, positions, layer_cache, remat=remat,
+                key_mask=None if cache is not None else attention_mask,
+                local_bias=local_bias,
+                ring_mesh=None if cache is not None else ring,
+            )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
         logits = self._logits(params, hidden)
         if n_virtual:
@@ -767,16 +861,26 @@ class TransformerLM:
             )
         h = self._embed_h(params, input_ids, positions)
 
-        bottom = jax.tree_util.tree_map(lambda x: x[:branch_at], params["blocks"])
-        top = jax.tree_util.tree_map(lambda x: x[branch_at:], params["blocks"])
-        h_branch, _ = self._scan_blocks(
-            bottom, h, bias, positions, remat=remat, key_mask=attention_mask,
-            local_bias=local_bias, ring_mesh=ring,
-        )
-        h_top, _ = self._scan_blocks(
-            top, h_branch, bias, positions, remat=remat, key_mask=attention_mask,
-            local_bias=local_bias, layer_offset=branch_at, ring_mesh=ring,
-        )
+        pp = None if ring is not None else self._pp_mesh(B, None)
+        if pp is not None:
+            h_top, (h_branch,) = self._pipeline_blocks(
+                params["blocks"], h, bias, positions, remat=remat,
+                key_mask=attention_mask, local_bias=local_bias,
+                capture_points=(branch_at,),
+            )
+        else:
+            bottom = jax.tree_util.tree_map(
+                lambda x: x[:branch_at], params["blocks"]
+            )
+            top = jax.tree_util.tree_map(lambda x: x[branch_at:], params["blocks"])
+            h_branch, _ = self._scan_blocks(
+                bottom, h, bias, positions, remat=remat, key_mask=attention_mask,
+                local_bias=local_bias, ring_mesh=ring,
+            )
+            h_top, _ = self._scan_blocks(
+                top, h_branch, bias, positions, remat=remat, key_mask=attention_mask,
+                local_bias=local_bias, layer_offset=branch_at, ring_mesh=ring,
+            )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h_top)
         logits = self._logits(params, hidden)
         return {
@@ -815,20 +919,33 @@ class TransformerLM:
             )
         h = self._embed_h(params, input_ids, positions)
 
-        captures = []
-        prev = 0
-        for point in tuple(points) + (self.cfg.n_layer,):
-            if point > prev:
-                seg = jax.tree_util.tree_map(
-                    lambda x: x[prev:point], params["blocks"]
-                )
-                h, _ = self._scan_blocks(
-                    seg, h, bias, positions, remat=remat, key_mask=attention_mask,
-                    local_bias=local_bias, layer_offset=prev, ring_mesh=ring,
-                )
-            if point < self.cfg.n_layer:
-                captures.append(h)
-            prev = point
+        pp = None if ring is not None else self._pp_mesh(B, None)
+        if pp is not None:
+            # match the sequential path: points >= n_layer are omitted
+            # (never captured), not returned as zeros
+            in_range = tuple(p for p in points if p < self.cfg.n_layer)
+            h, caps = self._pipeline_blocks(
+                params["blocks"], h, bias, positions, remat=remat,
+                key_mask=attention_mask, local_bias=local_bias,
+                capture_points=in_range,
+            )
+            captures = list(caps)
+        else:
+            captures = []
+            prev = 0
+            for point in tuple(points) + (self.cfg.n_layer,):
+                if point > prev:
+                    seg = jax.tree_util.tree_map(
+                        lambda x: x[prev:point], params["blocks"]
+                    )
+                    h, _ = self._scan_blocks(
+                        seg, h, bias, positions, remat=remat,
+                        key_mask=attention_mask,
+                        local_bias=local_bias, layer_offset=prev, ring_mesh=ring,
+                    )
+                if point < self.cfg.n_layer:
+                    captures.append(h)
+                prev = point
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
         logits = self._logits(params, hidden)
         return {

@@ -5,10 +5,12 @@ The reference picks parallelism by choosing a backend + YAML
 here `{"dp": -1, "fsdp": 8, "tp": 4, "sp": 1}` is the whole story: one
 axis may be -1 to absorb the remaining devices.
 
-Device order: axes are laid out (dp, fsdp, tp, sp) major-to-minor so tp
-(the chattiest axis: per-matmul all-reduces) maps to physically adjacent
-devices on the ICI torus — the same reasoning as Megatron's
-tensor-parallel-innermost group layout.
+Device order: axes are laid out (pp, dp, fsdp, tp, sp) major-to-minor so
+tp (the chattiest axis: per-matmul all-reduces) maps to physically
+adjacent devices on the ICI torus, while pp (one neighbor ppermute per
+microbatch, latency hidden by the pipeline schedule) takes the outermost
+— possibly DCN-crossing — dimension. Same reasoning as Megatron's
+tensor-parallel-innermost / pipeline-outermost group layout.
 """
 
 from __future__ import annotations
@@ -19,7 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-MeshAxes = ("dp", "fsdp", "tp", "sp")
+MeshAxes = ("pp", "dp", "fsdp", "tp", "sp")
 
 
 def make_mesh(
@@ -33,11 +35,22 @@ def make_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    sizes = {"dp": -1, "fsdp": 1, "tp": 1, "sp": 1}
+    sizes = {"pp": 1, "dp": -1, "fsdp": 1, "tp": 1, "sp": 1}
     sizes.update(axis_sizes or {})
     unknown = set(sizes) - set(MeshAxes)
     if unknown:
         raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {MeshAxes}")
+
+    if sizes.get("pp", 1) > 1 and sizes.get("sp", 1) > 1:
+        # enforced here (the one chokepoint every config path goes
+        # through) because downstream gating can't see both worlds: sp>1
+        # flips attention to ring, which would silently bypass the
+        # pipelined path while params stay pp-sharded — duplicated
+        # compute, no error
+        raise ValueError(
+            f"pp and sp are mutually exclusive: ring attention shards the "
+            f"sequence inside each layer, pipelining shards the layers ({sizes})"
+        )
 
     fill = [ax for ax, s in sizes.items() if s == -1]
     if len(fill) > 1:

@@ -1,0 +1,205 @@
+"""GPipe-style pipeline parallelism over a `pp` mesh axis.
+
+The reference's model-parallel backend pipelines NeMo/Megatron stages
+across nodes (ref: configs/nemo_configs/megatron_20b.yaml
+`pipeline_model_parallel_size`, trainer/nemo_ppo_trainer.py) with
+point-to-point sends choreographed by Megatron's schedules. The TPU
+analogue here exploits the repo's scan-stacked layer layout: layer
+params already live in one array with a leading `n_layer` axis, so a
+pipeline stage is just a shard of that axis.
+
+Mechanics (microbatch pipelining, the classic GPipe schedule):
+- `jax.shard_map` manual over ONLY the `pp` axis (`axis_names={"pp"}`)
+  — dp/fsdp/tp stay under GSPMD, so FSDP gathers and tensor-parallel
+  all-reduces compose with pipelining without manual collectives.
+- Each stage holds `n_layer/pp` consecutive layers (its slice of the
+  stacked params). The batch is split into M microbatches; a scan runs
+  M + pp - 1 ticks. Per tick every stage applies its layers to one
+  microbatch and `ppermute`s the activation to the next stage — a
+  neighbor-to-neighbor ICI hop, the cheapest collective on the torus.
+- Stage 0 feeds fresh microbatches; the last stage accumulates outputs,
+  broadcast back with a masked `psum` (zeros elsewhere) so downstream
+  ops (final norm, logits) run under plain GSPMD again.
+- Hydra/value-branch captures (hidden entering layer g) accumulate on
+  whichever stage owns layer g via a one-hot mask inside the stage scan
+  and merge in the same masked-psum step.
+
+The bubble fraction is (pp-1)/(M+pp-1): raise `pp_microbatches` to
+amortize. Backward works through the `lax.scan`-of-`ppermute` transpose
+(reverse-direction permutes), which is exactly the 1F1B-ish reversed
+schedule; `remat=True` checkpoints each layer body so only per-tick
+stage inputs are stored, as in the sequential path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+
+def _microbatch_flags(tree, batch: int):
+    """Static per-leaf decision: leaves with leading dim == batch get
+    split per microbatch; broadcast-shaped aux (e.g. [1, 1, T, S] biases)
+    is passed whole to every layer call."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.ndim(x) > 0 and x.shape[0] == batch, tree
+    )
+
+
+def _split_microbatches(tree, flags, n_mb: int):
+    return jax.tree_util.tree_map(
+        lambda x, f: x.reshape((n_mb, x.shape[0] // n_mb) + x.shape[1:]) if f else x,
+        tree,
+        flags,
+    )
+
+
+def _index_microbatch(tree, flags, m: Array):
+    return jax.tree_util.tree_map(
+        lambda x, f: x[m] if f else x, tree, flags
+    )
+
+
+def pipelined_layers(
+    mesh: Mesh,
+    layer_apply: Callable[[Dict, Array, Any], Array],
+    xs: Dict,
+    h: Array,
+    ctx: Any,
+    *,
+    n_microbatch: int,
+    capture_points: Sequence[int] = (),
+    remat: bool = False,
+) -> Tuple[Array, Tuple[Array, ...]]:
+    """Run L stacked layers over the mesh's `pp` axis, pipelined.
+
+    Args:
+      layer_apply: (layer_xs_slice, h, ctx_microbatch) -> h for ONE layer.
+      xs: pytree whose every leaf has leading axis L (stacked layer
+        params + any per-layer scalars). L must divide by mesh pp size.
+      h: [B, ...] activations entering layer 0. B must divide by
+        n_microbatch (and B/n_microbatch by dp*fsdp for good layouts).
+      ctx: pytree of batch-shaped aux inputs (attention bias, positions,
+        key masks). Leaves with leading dim B are split per microbatch;
+        other leaves are passed whole to every layer call.
+      capture_points: global layer indices g; returns the hidden state
+        ENTERING layer g for each (the hydra/value-branch fork inputs).
+
+    Returns (h_out [B, ...], captures tuple aligned with capture_points).
+    """
+    n_stages = mesh.shape["pp"]
+    leaves = jax.tree_util.tree_leaves(xs)
+    n_layer = leaves[0].shape[0]
+    if n_layer % n_stages:
+        raise ValueError(
+            f"n_layer={n_layer} not divisible by pp={n_stages}"
+        )
+    B = h.shape[0]
+    M = n_microbatch
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by pp microbatches {M}")
+    points = tuple(capture_points)
+    n_pts = len(points)
+    # XLA's CPU backend crashes (AllReducePromotion CHECK) on bf16
+    # all-reduces, which both the masked-psum broadcast and the shard_map
+    # transpose of replicated inputs emit. Carry boundary activations in
+    # f32 on CPU meshes: bf16<->f32 round-trips are bit-exact, so the
+    # numerics match the sequential scan; TPU keeps bf16 on the wire.
+    compute_dtype = h.dtype
+    on_cpu = mesh.devices.flat[0].platform == "cpu"
+    io_dtype = (
+        jnp.float32 if (on_cpu and compute_dtype == jnp.bfloat16) else compute_dtype
+    )
+
+    xs = dict(xs, __g__=jnp.arange(n_layer))  # global layer index per slice row
+
+    def stage(xs_local, h, ctx_mb):
+        """Apply this stage's layer slice; accumulate capture hiddens."""
+
+        def body(carry, layer):
+            h, caps = carry
+            if n_pts:
+                g = layer["__g__"]
+                onehot = jnp.stack(
+                    [(g == p).astype(caps.dtype) for p in points]
+                ).reshape((n_pts,) + (1,) * h.ndim)
+                caps = caps + onehot * h[None].astype(caps.dtype)
+            h = layer_apply(
+                {k: v for k, v in layer.items() if k != "__g__"}, h, ctx_mb
+            )
+            return (h, caps), None
+
+        if remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        caps0 = jnp.zeros((n_pts,) + h.shape, io_dtype)
+        (h, caps), _ = jax.lax.scan(body, (h.astype(compute_dtype), caps0), xs_local)
+        return h.astype(io_dtype), caps
+
+    def pipelined(xs_local, h_mb, ctx_mb):
+        s = jax.lax.axis_index("pp")
+        last = n_stages - 1
+        buf = jnp.zeros_like(h_mb[0])
+        outs = jnp.zeros_like(h_mb)
+        caps_store = jnp.zeros((M, n_pts) + h_mb.shape[1:], h_mb.dtype)
+
+        def tick(carry, t):
+            buf, outs, caps_store = carry
+            # stage s works on microbatch t - s this tick (GPipe schedule)
+            m = t - s
+            m_c = jnp.clip(m, 0, M - 1)
+            valid = (m >= 0) & (m < M)
+            ctx_t = _index_microbatch(ctx_mb, ctx_flags, m_c)
+            h_in = jnp.where(s == 0, h_mb[jnp.clip(t, 0, M - 1)], buf)
+            y, caps = stage(xs_local, h_in, ctx_t)
+            if n_pts:
+                caps_store = caps_store.at[m_c].add(
+                    jnp.where(valid, caps, jnp.zeros_like(caps))
+                )
+            outs = outs.at[m_c].add(
+                jnp.where(valid & (s == last), y, jnp.zeros_like(y))
+            )
+            buf = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            )
+            return (buf, outs, caps_store), None
+
+        (buf, outs, caps_store), _ = jax.lax.scan(
+            tick, (buf, outs, caps_store), jnp.arange(M + n_stages - 1)
+        )
+        # only the last stage holds real outputs / the owning stage holds
+        # each capture; masked psum broadcasts both to every pp rank
+        outs = jax.lax.psum(outs, "pp")
+        caps_store = jax.lax.psum(caps_store, "pp")
+        return outs, caps_store
+
+    h_mb = h.reshape((M, B // M) + h.shape[1:]).astype(io_dtype)
+    # keep microbatch rows spread over the data axes, not gathered onto pp
+    h_mb = jax.lax.with_sharding_constraint(
+        h_mb, NamedSharding(mesh, P(None, ("dp", "fsdp")))
+    )
+    ctx_flags = _microbatch_flags(ctx, B)
+    ctx_mb = _split_microbatches(ctx, ctx_flags, M)
+
+    f = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P()),
+        out_specs=(P(), P()),
+        axis_names={"pp"},
+        check_vma=False,
+    )
+    outs, caps_store = f(xs, h_mb, ctx_mb)
+    h_out = outs.reshape((B,) + h.shape[1:]).astype(compute_dtype)
+    # caps_store: [M, n_pts, B/M, ...] -> per point [B, ...]
+    captures = tuple(
+        jnp.moveaxis(caps_store, 1, 0)[i]
+        .reshape((B,) + h.shape[1:])
+        .astype(compute_dtype)
+        for i in range(n_pts)
+    )
+    return h_out, captures

@@ -34,14 +34,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _RULES: List[Tuple[str, P]] = [
     (r"(^|/)embed/wte$", P("tp", "fsdp")),
     (r"(^|/)embed/wpe$", P(None, "fsdp")),
-    (r"(^|/)blocks/attn/[qkv]/kernel$", P(None, "fsdp", "tp", None)),
-    (r"(^|/)blocks/attn/[qkv]/bias$", P(None, "tp", None)),
-    (r"(^|/)blocks/attn/o/kernel$", P(None, "tp", None, "fsdp")),
-    (r"(^|/)blocks/attn/o/bias$", P(None, None)),
-    (r"(^|/)blocks/mlp/fc_(in|gate)/kernel$", P(None, "fsdp", "tp")),
-    (r"(^|/)blocks/mlp/fc_(in|gate)/bias$", P(None, "tp")),
-    (r"(^|/)blocks/mlp/fc_out/kernel$", P(None, "tp", "fsdp")),
-    (r"(^|/)blocks/mlp/fc_out/bias$", P(None, None)),
+    # stacked blocks [L, ...]: the leading layer axis shards over `pp` —
+    # each pipeline stage owns a contiguous slice (parallel/pipeline.py);
+    # with pp=1 (the default) the entry is a no-op
+    (r"(^|/)blocks/attn/[qkv]/kernel$", P("pp", "fsdp", "tp", None)),
+    (r"(^|/)blocks/attn/[qkv]/bias$", P("pp", "tp", None)),
+    (r"(^|/)blocks/attn/o/kernel$", P("pp", "tp", None, "fsdp")),
+    (r"(^|/)blocks/attn/o/bias$", P("pp", None)),
+    (r"(^|/)blocks/mlp/fc_(in|gate)/kernel$", P("pp", "fsdp", "tp")),
+    (r"(^|/)blocks/mlp/fc_(in|gate)/bias$", P("pp", "tp")),
+    (r"(^|/)blocks/mlp/fc_out/kernel$", P("pp", "tp", "fsdp")),
+    (r"(^|/)blocks/mlp/fc_out/bias$", P("pp", None)),
+    # any other per-layer param (layer norms): layer axis over pp only
+    (r"(^|/)blocks/", P("pp")),
     (r"(^|/)lm_head/kernel$", P("fsdp", "tp")),
     # aux heads (value / Q): small — shard the wide input dim over fsdp only
     (r"(^|/)(v_head|q_heads(/\d+)?|target_q_heads(/\d+)?)/fc_in/kernel$", P("fsdp", None)),

@@ -88,9 +88,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         # subclass hook: builds self.model (wrapper), self.params and any
         # auxiliary trees (e.g. PPO's frozen reference branch)
         self.setup_model()
-        # context parallelism: hand the mesh to the model so ring attention
-        # can shard_map teacher-forced forwards over the `sp` axis
-        if self.mesh.shape["sp"] > 1:
+        # context parallelism (ring attention over `sp`) and pipeline
+        # parallelism (layer stack over `pp`) both run teacher-forced
+        # forwards through shard_map and need the mesh on the model
+        if self.mesh.shape["sp"] > 1 or self.mesh.shape["pp"] > 1:
             self._lm().mesh = self.mesh
 
         tx, self.schedule = build_optimizer(config.optimizer, config.scheduler)
