@@ -336,3 +336,27 @@ def test_pp_ilql_forward_parity():
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits), atol=1e-5, rtol=1e-5)
     for a, b in zip(tuple(ref_qs) + (ref_vs,), tuple(qs) + (vs,)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5, rtol=1e-5)
+
+
+def test_pp_multihost_guard(monkeypatch):
+    """pp>1 under a multi-process runtime must fail loudly: the multihost
+    row-sharding helpers partition batch rows across processes, which is
+    wrong when stages replicate the row space."""
+    import trlx_tpu.parallel.multihost as mh
+
+    monkeypatch.setattr(mh, "is_multihost", lambda: True)
+    monkeypatch.setattr(mh, "process_count", lambda: 2)
+    config = default_sft_config().evolve(
+        train=dict(mesh={"pp": 2, "dp": 2}, tracker=None),
+        model=dict(
+            model_path="random",
+            model_extra_configs={
+                "transformer": dict(hidden_size=16, n_layer=2, n_head=2, n_positions=64)
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+    )
+    from trlx_tpu.utils.loading import get_trainer
+
+    with pytest.raises(NotImplementedError, match="single-process"):
+        get_trainer(config.train.trainer)(config=config)

@@ -80,6 +80,18 @@ class TPUBaseTrainer(BaseRLTrainer):
         super().__init__(config, reward_fn, metric_fn, stop_sequences)
         train = config.train
         self.mesh = make_mesh(train.mesh)
+        if self.mesh.shape["pp"] > 1 and mh.is_multihost():
+            # the multihost data helpers (parallel/multihost.py) partition
+            # batch rows across processes; with pp spanning processes the
+            # row space is replicated over stages instead, so per-process
+            # slices would silently feed different data to different
+            # pipeline stages. Fail loudly until the helpers are pp-aware.
+            raise NotImplementedError(
+                "pipeline parallelism (mesh pp>1) currently requires a "
+                "single-process runtime; across hosts use fsdp/tp "
+                f"(mesh={dict(self.mesh.shape)}, "
+                f"processes={mh.process_count()})"
+            )
         self.compute_dtype = _DTYPES[train.compute_dtype]
         self.param_dtype = _DTYPES[train.param_dtype]
         self.tokenizer = load_tokenizer(config.tokenizer)
@@ -508,12 +520,17 @@ class TPUBaseTrainer(BaseRLTrainer):
             fn = self._get_generate_fn(settings, gshape)
             self.rng, key = jax.random.split(self.rng)
             sharding = data_sharding(self.mesh)
+            device_mask = mh.global_from_local(attention_mask, sharding)
             out = fn(
                 self.params,
                 mh.global_from_local(input_ids, sharding),
-                mh.global_from_local(attention_mask, sharding),
+                device_mask,
                 key,
             )
+            # ride the prompt mask along as a DEVICE array: the PPO
+            # experience forward consumes it (+ sequences/response_mask)
+            # straight from here, skipping a host round-trip per chunk
+            out = dict(out, prompt_mask=device_mask)
         if target != B:
             out = jax.tree_util.tree_map(lambda x: x[:B], out)
         return out
@@ -897,6 +914,12 @@ class TPUBaseTrainer(BaseRLTrainer):
     def post_epoch_callback(self) -> None:
         pass
 
+    def _finish_rollout_stats(self) -> None:
+        """Hook: materialize + log any stats the rollout phase deferred
+        (PPO starts its device->host stats copy asynchronously so it can
+        overlap the train step). Called before train-step tracker logging
+        so tracker steps stay monotonic (wandb drops backdated steps)."""
+
     def add_prompt_pipeline(self, pipeline) -> None:
         raise NotImplementedError
 
@@ -1009,6 +1032,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                         if k.startswith("losses/") or k == "loss"
                     )
                     logger.info("[step %d/%d] %s", self.iter_count, self.total_steps, desc)
+                    # pending rollout stats carry an earlier step index:
+                    # flush them first so tracker steps stay monotonic
+                    self._finish_rollout_stats()
                     self.tracker.log(stats, step=self.iter_count)
 
                     if self.iter_count >= self.total_steps:

@@ -109,6 +109,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             self.kl_ctl = FixedKLController(config.method.init_kl_coef)
 
         self.mean_kl = 0.0
+        self._pending_rollout_stats = None
         self.log_rollouts = config.train.rollout_logging_dir is not None
         if self.log_rollouts:
             self.setup_rollout_logging(config)
@@ -285,16 +286,39 @@ class TPUPPOTrainer(TPUBaseTrainer):
             self._experience_fns[key] = jax.jit(seq2seq_fn)
             return self._experience_fns[key]
 
+        # causal path: composed from the SAME two jitted halves the
+        # overlapped fast path uses (fwd + score inject), so the fallback
+        # cannot numerically diverge from it
+        fwd_fn = self._get_experience_fwd_fn(P, N)
+        inject_fn = self._get_score_inject_fn(N, S)
+
         def fn(params, ref_params, tokens, attention_mask, response_mask, scores, scores_mask, kl_coef, n_valid, scale_div):
-            # reward scaling happens IN-GRAPH so the running std never has
-            # to round-trip to the host inside the rollout loop
-            scores = scores / jnp.maximum(scale_div, 1e-8)
+            pre_batch, kl_stats = fwd_fn(
+                params, ref_params, tokens, attention_mask, response_mask,
+                kl_coef, n_valid,
+            )
+            return inject_fn(pre_batch, scores, scores_mask, scale_div), kl_stats
+
+        self._experience_fns[key] = fn
+        return self._experience_fns[key]
+
+    def _get_experience_fwd_fn(self, P: int, N: int):
+        """The score-independent half of the experience step: teacher-forced
+        policy/ref/value forward + per-token KL penalty. Dispatched BEFORE
+        host scoring (it only reads device tensors the sampler produced),
+        so the heaviest rollout compute overlaps decode + reward_fn — with
+        a slow reward model the whole forward hides under scoring. The
+        score half is `_get_score_inject_fn`."""
+        key = ("fwd", P, N)
+        if key in self._experience_fns:
+            return self._experience_fns[key]
+        model = self.model
+
+        def fn(params, ref_params, tokens, attention_mask, response_mask, kl_coef, n_valid):
             out = model.forward_train(params, ref_params, tokens, attention_mask)
             logprobs_full = logprobs_of_labels(out["logits"][:, :-1], tokens[:, 1:])
             ref_logprobs_full = logprobs_of_labels(out["ref_logits"][:, :-1], tokens[:, 1:])
 
-            # the controller's KL estimate spans the whole sequence
-            # (parity: reference :457-460 masks only padding)
             full_mask = attention_mask[:, 1:].astype(jnp.float32)
             log_ratio_full = (logprobs_full - ref_logprobs_full) * full_mask
             kl = jnp.exp(log_ratio_full) - 1 - log_ratio_full
@@ -306,27 +330,40 @@ class TPUPPOTrainer(TPUBaseTrainer):
             values = out["values"][:, sl] * mask
             log_ratio = log_ratio_full[:, sl] * mask
 
-            rewards = -kl_coef * log_ratio
-            if S == 1:  # terminal reward on the last real token
-                last = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
-                rewards = rewards + scores[:, 0:1] * (
-                    jax.nn.one_hot(last, N, dtype=rewards.dtype)
-                )
-            else:  # dense per-token rewards
-                padded = jnp.zeros_like(rewards)
-                padded = padded.at[:, :S].set(scores * scores_mask)
-                rewards = rewards + padded
-            rewards = rewards * mask
-
             batch_out = PPORolloutBatch(
                 query_tensors=tokens[:, :P],
                 response_tensors=tokens[:, P:],
                 logprobs=logprobs,
                 values=values,
-                rewards=rewards,
+                rewards=-kl_coef * log_ratio,  # scores injected later
                 response_mask=mask,
             )
             return batch_out, {"mean_kl": mean_kl, "mean_kl_per_token": mean_kl_per_token}
+
+        self._experience_fns[key] = jax.jit(fn)
+        return self._experience_fns[key]
+
+    def _get_score_inject_fn(self, N: int, S: int):
+        """Apply host-computed scores to a KL-only rollout batch: scale,
+        add terminal (S=1) or dense (S>1) rewards, re-mask."""
+        key = ("inject", N, S)
+        if key in self._experience_fns:
+            return self._experience_fns[key]
+
+        def fn(batch_out, scores, scores_mask, scale_div):
+            scores = scores / jnp.maximum(scale_div, 1e-8)
+            mask = batch_out.response_mask
+            rewards = batch_out.rewards
+            if S == 1:
+                last = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+                rewards = rewards + scores[:, 0:1] * (
+                    jax.nn.one_hot(last, N, dtype=rewards.dtype)
+                )
+            else:
+                padded = jnp.zeros_like(rewards)
+                padded = padded.at[:, :S].set(scores * scores_mask)
+                rewards = rewards + padded
+            return batch_out.replace(rewards=rewards * mask)
 
         self._experience_fns[key] = jax.jit(fn)
         return self._experience_fns[key]
@@ -335,6 +372,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
         """Collect `num_rollouts` rollouts into the store (parity:
         reference make_experience :251-525; §3.2 call stack)."""
         logger.info("Collecting rollouts")
+        self._finish_rollout_stats()  # flush any deferred previous-cycle stats
         clock = Clock()
         n_collected = 0
         accumulated_stats: List[Dict[str, float]] = []
@@ -365,12 +403,17 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 next_batch, next_gen = None, None
 
             prompt_tensors = np.asarray(batch.input_ids)
-            # ONE packed device->host fetch: a remote-tunneled chip pays
-            # ~100ms latency PER transfer, so the three generation outputs
-            # ride a single concatenated array
             seq_w = gen_out["sequences"].shape[1]
             N = gen_out["response_ids"].shape[1]
-            packed = mh.local_rows(
+            P_width = prompt_tensors.shape[1]
+            B_local = gen_out["sequences"].shape[0] // mh.process_count()
+
+            # ONE packed device->host transfer for the three generation
+            # outputs (a remote-tunneled chip pays ~100ms latency PER
+            # transfer). The concatenate is enqueued FIRST — devices run
+            # FIFO, so the DMA starts as soon as generation finishes and
+            # streams while the experience forward below computes
+            packed_dev = mh.local_rows(
                 jnp.concatenate(
                     [
                         gen_out["sequences"],
@@ -380,6 +423,45 @@ class TPUPPOTrainer(TPUBaseTrainer):
                     axis=1,
                 )
             )
+            try:
+                packed_dev.copy_to_host_async()
+            except Exception:
+                pass
+
+            # fast path: the score-INDEPENDENT half of the experience step
+            # (policy/ref/value forward + KL penalty — the heaviest rollout
+            # compute) is dispatched NOW, on the device tensors the sampler
+            # just produced. It executes while the host decodes and scores
+            # the samples; the tiny score-injection jit below completes the
+            # rollout batch once reward_fn returns. Falls back to the
+            # fused experience fn when host-side token rewrites (stop
+            # sequences, seq2seq) or pad rows are needed.
+            device_gen = (
+                not self.seq2seq
+                and not self.stop_sequences
+                and B_local % self.local_ways() == 0
+            )
+            pre_batch = pre_kl_stats = None
+            if device_gen:
+                with self.mesh:
+                    fwd_fn = self._get_experience_fwd_fn(P_width, N)
+                    pre_batch, pre_kl_stats = fwd_fn(
+                        self.params,
+                        self.ref_params,
+                        gen_out["sequences"].astype(jnp.int32),
+                        jnp.concatenate(
+                            [
+                                gen_out["prompt_mask"].astype(jnp.int32),
+                                gen_out["response_mask"].astype(jnp.int32),
+                            ],
+                            axis=1,
+                        ),
+                        gen_out["response_mask"].astype(jnp.int32),
+                        jnp.float32(self.kl_ctl.value),
+                        jnp.float32(B_local * mh.process_count()),
+                    )
+
+            packed = packed_dev
             sequences = packed[:, :seq_w]
             response_ids = packed[:, seq_w : seq_w + N]
             response_mask = packed[:, seq_w + N :]
@@ -475,34 +557,48 @@ class TPUPPOTrainer(TPUBaseTrainer):
             def rpad(x):
                 return self.pad_rows(x, target)
 
-            exp_fn = self._get_experience_fn(P, N, S)
             sharding = data_sharding(self.mesh)
-            if self.seq2seq:
-                args = (
-                    rpad(prompt_tensors.astype(np.int32)),
-                    rpad(np.asarray(batch.attention_mask, np.int32)),
-                    rpad(sequences.astype(np.int32)),
-                )
+            if device_gen:
+                # the forward half has been executing since right after
+                # generation; complete it with the host-computed scores
+                with self.mesh:
+                    inject_fn = self._get_score_inject_fn(N, S)
+                    rollout_batch = inject_fn(
+                        pre_batch,
+                        mh.global_from_local(scores, sharding),
+                        mh.global_from_local(scores_mask, sharding),
+                        scale_div,
+                    )
+                kl_stats = pre_kl_stats
             else:
-                attention_mask = np.concatenate(
-                    [np.asarray(batch.attention_mask, np.int32), response_mask], axis=1
-                )
-                args = (
-                    rpad(sequences.astype(np.int32)),
-                    rpad(attention_mask),
-                )
-            with self.mesh:
-                rollout_batch, kl_stats = exp_fn(
-                    self.params,
-                    self.ref_params,
-                    *[mh.global_from_local(a, sharding) for a in args],
-                    mh.global_from_local(rpad(response_mask), sharding),
-                    mh.global_from_local(rpad(scores), sharding),
-                    mh.global_from_local(rpad(scores_mask), sharding),
-                    jnp.float32(self.kl_ctl.value),
-                    jnp.float32(B * mh.process_count()),
-                    scale_div,
-                )
+                exp_fn = self._get_experience_fn(P, N, S)
+                if self.seq2seq:
+                    args = (
+                        rpad(prompt_tensors.astype(np.int32)),
+                        rpad(np.asarray(batch.attention_mask, np.int32)),
+                        rpad(sequences.astype(np.int32)),
+                    )
+                else:
+                    attention_mask = np.concatenate(
+                        [np.asarray(batch.attention_mask, np.int32), response_mask],
+                        axis=1,
+                    )
+                    args = (
+                        rpad(sequences.astype(np.int32)),
+                        rpad(attention_mask),
+                    )
+                with self.mesh:
+                    rollout_batch, kl_stats = exp_fn(
+                        self.params,
+                        self.ref_params,
+                        *[mh.global_from_local(a, sharding) for a in args],
+                        mh.global_from_local(rpad(response_mask), sharding),
+                        mh.global_from_local(rpad(scores), sharding),
+                        mh.global_from_local(rpad(scores_mask), sharding),
+                        jnp.float32(self.kl_ctl.value),
+                        jnp.float32(B * mh.process_count()),
+                        scale_div,
+                    )
             if target != B:
                 # trim the sharding-pad rows ON DEVICE (the store keeps
                 # device-resident rollouts; no host round-trip here)
@@ -529,18 +625,41 @@ class TPUPPOTrainer(TPUBaseTrainer):
             k: sum(xs[k] for xs in accumulated_stats) / len(accumulated_stats)
             for k in accumulated_stats[-1]
         }
-        # ONE packed fetch for every accumulated device scalar
+        # ONE packed fetch for every accumulated device scalar — started
+        # asynchronously here and materialized lazily (post_backward /
+        # next make_experience): on a remote-tunneled chip the blocking
+        # read costs a full ~100ms round trip, which this way overlaps the
+        # train step instead of extending the rollout phase
         keys = list(agg)
         vals = [agg[k] for k in keys]
         dev_ix = [i for i, v in enumerate(vals) if isinstance(v, jax.Array)]
+        stacked = None
         if dev_ix:
-            fetched = np.asarray(jnp.stack([vals[i] for i in dev_ix]))
+            stacked = jnp.stack([vals[i] for i in dev_ix])
+            try:
+                stacked.copy_to_host_async()
+            except Exception:
+                pass  # transfer still happens at materialization
+        if hasattr(pbar, "close"):
+            pbar.close()
+        self._pending_rollout_stats = (
+            keys, vals, dev_ix, stacked, self.kl_ctl.value, iter_count
+        )
+
+    def _finish_rollout_stats(self) -> None:
+        """Materialize + log the deferred make_experience stats (sets
+        self.mean_kl for the KL controller). Idempotent."""
+        pending = getattr(self, "_pending_rollout_stats", None)
+        if pending is None:
+            return
+        self._pending_rollout_stats = None
+        keys, vals, dev_ix, stacked, kl_ctl_value, iter_count = pending
+        if dev_ix:
+            fetched = np.asarray(stacked)
             for i, f in zip(dev_ix, fetched.tolist()):
                 vals[i] = f
         stats = {k: float(v) for k, v in zip(keys, vals)}
-        if hasattr(pbar, "close"):
-            pbar.close()
-        stats["kl_ctl_value"] = self.kl_ctl.value
+        stats["kl_ctl_value"] = kl_ctl_value
         self.mean_kl = stats["policy/sqrt_kl"] ** 2
         self.tracker.log(stats, step=iter_count)
 
@@ -597,6 +716,10 @@ class TPUPPOTrainer(TPUBaseTrainer):
         )
 
     def post_backward_callback(self) -> None:
+        # flush the deferred rollout stats first: they carry the mean KL
+        # this controller update consumes (by now the async device->host
+        # copy has landed under the train step, so this is a free read)
+        self._finish_rollout_stats()
         self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
 
     def _fused_epoch_batch(self):
