@@ -11,6 +11,7 @@ import sys
 pid, nproc, port, workdir = (
     int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
 )
+mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
@@ -35,11 +36,15 @@ import trlx_tpu
 from trlx_tpu.data.default_configs import default_ppo_config
 
 ckpt_dir = os.path.join(workdir, "ckpts")
+# mode "pp": the pipeline axis SPANS the two processes (process 0 = stage
+# 0, process 1 = stage 1) — both processes form ONE data group holding
+# identical rows, exercising the group-keyed row distribution
+mesh = {"pp": 2, "dp": 2, "tp": 2, "fsdp": 1} if mode == "pp" else {"dp": -1}
 config = default_ppo_config().evolve(
     train=dict(
         batch_size=8, total_steps=3, eval_interval=2, checkpoint_interval=2,
         seq_length=16, epochs=3, tracker=None, checkpoint_dir=ckpt_dir,
-        mesh={"dp": -1},
+        mesh=mesh,
     ),
     model=dict(
         model_path="random", num_layers_unfrozen=-1,
@@ -61,6 +66,14 @@ def reward_fn(samples, prompts, outputs, **kw):
 
 prompts = ["hello world", "the cat", "a b c", "xyz w", "what is", "I am", "go on", "ok then"]
 trainer = trlx_tpu.train(reward_fn=reward_fn, prompts=prompts, config=config)
+
+if mode == "pp":
+    # both processes are stages of the SAME rows: one data group
+    assert mh.data_group_count(trainer.mesh) == 1, mh.data_group_info(trainer.mesh)
+    assert mh.group_representatives(trainer.mesh) == [0]
+    # blocks params actually pp-sharded across the two processes
+    spec = trainer.params["base"]["blocks"]["attn"]["q"]["kernel"].sharding.spec
+    assert spec[0] == "pp", spec
 
 assert trainer.iter_count >= 3, trainer.iter_count
 # every process must agree on the (replicated) final params

@@ -88,3 +88,37 @@ def test_sft_ilql_two_processes(tmp_path):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
         assert f"SFT_MH_OK pid={pid}" in out
         assert f"ILQL_MH_OK pid={pid}" in out
+
+
+@pytest.mark.slow
+def test_ppo_learn_two_processes_pp_stages(tmp_path):
+    """pp spans the two processes (process 0 = stage 0, process 1 = stage
+    1): row helpers must treat them as ONE data group holding identical
+    rows, and the pipelined PPO step must converge to identical params."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, DRIVER, str(pid), "2", str(port), str(tmp_path), "pp"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=560)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+        assert f"MULTIHOST_OK pid={pid}" in out, out[-2000:]
+    sums = sorted(
+        line.split("paramsum=")[1]
+        for out in outs
+        for line in out.splitlines()
+        if "MULTIHOST_OK" in line
+    )
+    assert sums[0] == sums[-1], sums

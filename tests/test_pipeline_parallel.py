@@ -338,25 +338,62 @@ def test_pp_ilql_forward_parity():
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5, rtol=1e-5)
 
 
-def test_pp_multihost_guard(monkeypatch):
-    """pp>1 under a multi-process runtime must fail loudly: the multihost
-    row-sharding helpers partition batch rows across processes, which is
-    wrong when stages replicate the row space."""
+class _FakeDev:
+    """Duck-typed device: data_group_info only reads .process_index."""
+
+    def __init__(self, p):
+        self.process_index = p
+
+
+class _FakeMesh:
+    def __init__(self, devices, axis_names):
+        self.devices = devices
+        self.axis_names = axis_names
+
+
+def test_data_group_info(monkeypatch):
+    """Row-distribution grouping (the pp x multihost contract): processes
+    on different pp stages of the same (dp, fsdp) blocks form ONE data
+    group (replica rows); processes on distinct blocks form separate
+    groups; inconsistent overlaps raise. The end-to-end version runs as a
+    real 2-process jax.distributed test (tests/test_multihost.py)."""
     import trlx_tpu.parallel.multihost as mh
 
     monkeypatch.setattr(mh, "is_multihost", lambda: True)
-    monkeypatch.setattr(mh, "process_count", lambda: 2)
-    config = default_sft_config().evolve(
-        train=dict(mesh={"pp": 2, "dp": 2}, tracker=None),
-        model=dict(
-            model_path="random",
-            model_extra_configs={
-                "transformer": dict(hidden_size=16, n_layer=2, n_head=2, n_positions=64)
-            },
-        ),
-        tokenizer=dict(tokenizer_path="byte"),
-    )
-    from trlx_tpu.utils.loading import get_trainer
+    monkeypatch.setattr(mh.jax, "process_index", lambda: 0)
+    names = ("pp", "dp", "fsdp", "tp", "sp")
 
-    with pytest.raises(NotImplementedError, match="single-process"):
-        get_trainer(config.train.trainer)(config=config)
+    def mesh_of(proc_of_idx, shape):
+        devs = np.empty(shape, dtype=object)
+        for idx in np.ndindex(*shape):
+            devs[idx] = _FakeDev(proc_of_idx(idx))
+        return _FakeMesh(devs, names)
+
+    # pp=2 spanning 2 processes: one group, rows replicated, rep = 0
+    m = mesh_of(lambda idx: idx[0], (2, 2, 1, 2, 1))  # proc = pp stage
+    assert mh.data_group_info(m) == (0, 1)
+    assert mh.group_representatives(m) == [0]
+
+    # dp=2 split across 2 processes: two groups (the historical layout)
+    m = mesh_of(lambda idx: idx[1], (1, 2, 1, 2, 1))  # proc = dp block
+    assert mh.data_group_info(m) == (0, 2)
+    assert mh.group_representatives(m) == [0, 1]
+
+    # pp=2 x dp=2 over 4 processes: 2 groups of 2 stage-processes each
+    m = mesh_of(lambda idx: idx[0] * 2 + idx[1], (2, 2, 1, 1, 1))
+    assert mh.data_group_info(m)[1] == 2
+
+    # inconsistent: a row block split across two processes that otherwise
+    # own different blocks (overlapping, non-identical block sets)
+    def bad(idx):
+        dp, fsdp, tp = idx[1], idx[2], idx[3]
+        block = dp * 2 + fsdp
+        if block == 0:
+            return 0
+        if block == 1:
+            return tp  # straddles processes 0 and 1
+        return 1
+
+    m = mesh_of(bad, (1, 2, 2, 2, 1))
+    with pytest.raises(ValueError, match="row blocks"):
+        mh.data_group_info(m)

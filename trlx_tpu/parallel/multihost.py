@@ -13,18 +13,22 @@ host.
 The helpers here are the complete host<->global bridge:
 
   initialize()            wire up jax.distributed (no-op single-host)
-  shard_list(xs)          this process's strided slice of a host list
+  data_group_info(mesh)   (group, count): processes sharing the same
+                          (dp, fsdp) row blocks — e.g. pp stages — form
+                          one group and hold identical host rows
+  shard_list(xs, mesh)    this data group's strided slice of a host list
   global_from_local(t, s) per-process local rows -> one global array
   local_rows(arr)         this process's rows of a global batch array
   allgather(x)            host-side values -> full np array everywhere
   is_main()               gate for tracker/checkpoint-metadata writes
 
 Mesh layout note: jax.devices() orders devices process-major, and
-make_mesh lays axes (dp, fsdp, tp, sp) major-to-minor, so batch rows
-land on processes in contiguous blocks — `local_rows` of a
-(dp, fsdp)-sharded batch is exactly the [p*B/P, (p+1)*B/P) row block,
-matching what `global_from_local` assembled. tp/sp shards of the same
-rows stay host-local, riding ICI not DCN.
+make_mesh lays axes (pp, dp, fsdp, tp, sp) major-to-minor, so batch rows
+land on data groups in contiguous blocks — `local_rows` of a
+(dp, fsdp)-sharded batch is exactly the group's row block, matching what
+`global_from_local` assembled. tp/sp shards of the same rows stay
+host-local, riding ICI not DCN; with pp spanning processes, stages hold
+replica shards of their group's rows.
 """
 
 from __future__ import annotations
@@ -73,13 +77,101 @@ def is_main() -> bool:
     return jax.process_index() == 0
 
 
-def shard_list(items: Sequence[Any]) -> list:
-    """This process's strided slice of a host-side list (prompts, eval
+def data_group_info(mesh=None):
+    """(group_index, group_count) for batch-row distribution.
+
+    Processes whose devices cover the same (dp, fsdp) row blocks form one
+    DATA GROUP and must hold identical host rows (their device shards are
+    replicas — e.g. different `pp` stages of the same rows). Without a
+    mesh (or when every process covers distinct blocks, the pp=1 layout)
+    this degenerates to (process_index, process_count) — the historical
+    behavior. Row distribution must key on groups, not processes: keying
+    on processes under pp>1 would feed different data to different
+    pipeline stages of the same rows.
+    """
+    info, _reps = _group_data(mesh)
+    return info
+
+
+def _group_data(mesh):
+    """((group_index, group_count), representatives) — computed together
+    so the reps can never be a stale/guessed fallback of the info."""
+    if not is_multihost():
+        return (0, 1), [0]
+    if mesh is None:
+        return (jax.process_index(), jax.process_count()), list(
+            range(jax.process_count())
+        )
+    try:
+        key = mesh  # jax Mesh is hashable; keeps a live ref (no id reuse)
+        if key in _GROUP_DATA_CACHE:
+            return _GROUP_DATA_CACHE[key]
+    except TypeError:
+        key = None
+    axis = dict(zip(mesh.axis_names, range(len(mesh.axis_names))))
+    fsdp_size = mesh.devices.shape[axis["fsdp"]]
+    blocks_by_proc: dict = {}
+    for idx in np.ndindex(*mesh.devices.shape):
+        d = mesh.devices[idx]
+        block = idx[axis["dp"]] * fsdp_size + idx[axis["fsdp"]]
+        blocks_by_proc.setdefault(d.process_index, set()).add(block)
+    mine = blocks_by_proc.get(jax.process_index())
+    if mine is None:
+        # this process owns no mesh devices (shouldn't happen in SPMD)
+        return (jax.process_index(), jax.process_count()), list(
+            range(jax.process_count())
+        )
+    groups = sorted(
+        {tuple(sorted(v)) for v in blocks_by_proc.values()},
+        key=lambda t: t[0],
+    )
+    # groups must partition the block space: any overlap between
+    # non-identical block sets means a (dp, fsdp) shard would receive
+    # conflicting rows from two groups
+    total = sum(len(g) for g in groups)
+    union = set().union(*(set(g) for g in groups))
+    if total != len(union):
+        raise ValueError(
+            "mesh device layout maps processes to OVERLAPPING but "
+            f"non-identical (dp, fsdp) row blocks ({groups}); batch "
+            "rows cannot be distributed consistently — keep each "
+            "process's devices within whole data shards"
+        )
+    info = (groups.index(tuple(sorted(mine))), len(groups))
+    # one representative process per group (the lowest), for deduping
+    # per-process host gathers when groups replicate rows
+    reps = [
+        min(p for p, v in blocks_by_proc.items() if tuple(sorted(v)) == g)
+        for g in groups
+    ]
+    if key is not None:
+        _GROUP_DATA_CACHE[key] = (info, reps)
+    return info, reps
+
+
+_GROUP_DATA_CACHE: dict = {}
+
+
+def group_representatives(mesh=None) -> list:
+    """Process indices (one per data group) whose per-process gather
+    contributions to keep; with pp>1 the other stages' entries are
+    replicas of the same rows."""
+    _info, reps = _group_data(mesh)
+    return reps
+
+
+def data_group_count(mesh=None) -> int:
+    return data_group_info(mesh)[1]
+
+
+def shard_list(items: Sequence[Any], mesh=None) -> list:
+    """This data group's strided slice of a host-side list (prompts, eval
     rows). Strided (not blocked) so truncated datasets stay balanced;
-    padded by wrap-around so every process holds the same count (SPMD
+    padded by wrap-around so every group holds the same count (SPMD
     programs must run in lockstep — a short process would deadlock the
-    collectives)."""
-    p, n = jax.process_index(), jax.process_count()
+    collectives). Processes in the same group (pp stages) get identical
+    slices."""
+    p, n = data_group_info(mesh)
     if n == 1:
         return list(items)
     local = list(items[p::n])
@@ -91,8 +183,8 @@ def shard_list(items: Sequence[Any]) -> list:
     return local
 
 
-def shard_pipeline(pipeline):
-    """Per-process view of an indexable pipeline: this process's strided
+def shard_pipeline(pipeline, mesh=None):
+    """Per-data-group view of an indexable pipeline: this group's strided
     slice of the rows, same collate/loader behavior. No-op single-host."""
     if not is_multihost():
         return pipeline
@@ -100,9 +192,9 @@ def shard_pipeline(pipeline):
 
     clone = copy.copy(pipeline)
     if hasattr(pipeline, "prompts"):
-        clone.prompts = shard_list(pipeline.prompts)
+        clone.prompts = shard_list(pipeline.prompts, mesh)
         return clone
-    idxs = shard_list(list(range(len(pipeline))))
+    idxs = shard_list(list(range(len(pipeline))), mesh)
 
     class _View(type(pipeline)):
         def __init__(self):  # bypass the parent tokenizing __init__

@@ -80,18 +80,14 @@ class TPUBaseTrainer(BaseRLTrainer):
         super().__init__(config, reward_fn, metric_fn, stop_sequences)
         train = config.train
         self.mesh = make_mesh(train.mesh)
-        if self.mesh.shape["pp"] > 1 and mh.is_multihost():
-            # the multihost data helpers (parallel/multihost.py) partition
-            # batch rows across processes; with pp spanning processes the
-            # row space is replicated over stages instead, so per-process
-            # slices would silently feed different data to different
-            # pipeline stages. Fail loudly until the helpers are pp-aware.
-            raise NotImplementedError(
-                "pipeline parallelism (mesh pp>1) currently requires a "
-                "single-process runtime; across hosts use fsdp/tp "
-                f"(mesh={dict(self.mesh.shape)}, "
-                f"processes={mh.process_count()})"
-            )
+        if mh.is_multihost():
+            # validates the process->row-block mapping up front (raises on
+            # layouts where batch rows can't be distributed consistently,
+            # e.g. a process straddling partial data shards) and warms the
+            # data-group cache: with pp>1 spanning processes, stages are
+            # REPLICAS of the same rows and every row helper keys on data
+            # groups, not processes
+            mh.data_group_info(self.mesh)
         self.compute_dtype = _DTYPES[train.compute_dtype]
         self.param_dtype = _DTYPES[train.param_dtype]
         self.tokenizer = load_tokenizer(config.tokenizer)
@@ -409,15 +405,16 @@ class TPUBaseTrainer(BaseRLTrainer):
 
     def local_ways(self) -> int:
         """Row-divisibility requirement for THIS process's block of a
-        global batch (multi-host: each process contributes 1/P of the
-        rows; mesh layout keeps those rows on this host's devices)."""
-        ways, pc = self.data_ways(), mh.process_count()
-        if ways % pc:
+        global batch (multi-host: each DATA GROUP contributes 1/G of the
+        rows; pp stages within a group replicate them; mesh layout keeps
+        a group's rows on its hosts' devices)."""
+        ways, gc = self.data_ways(), mh.data_group_count(self.mesh)
+        if ways % gc:
             raise ValueError(
-                f"dp*fsdp={ways} must be divisible by process_count={pc} "
-                "(each host must own whole data shards)"
+                f"dp*fsdp={ways} must be divisible by the data-group "
+                f"count {gc} (each host must own whole data shards)"
             )
-        return ways // pc
+        return ways // gc
 
     @staticmethod
     def pad_rows(arr: np.ndarray, target_rows: int) -> np.ndarray:
@@ -492,7 +489,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         # eval batch then reuses the cached executable instead of
         # recompiling the whole decode loop
         B, P = input_ids.shape
-        pc = mh.process_count()
+        pc = mh.data_group_count(self.mesh)
         target = B + (-B) % self.local_ways()
         # cache keys hold GLOBAL row counts; compare in local terms
         compiled = [

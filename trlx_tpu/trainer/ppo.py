@@ -387,7 +387,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
         rollout_generate_time = time()
         next_gen = self.generate(next_batch.input_ids, next_batch.attention_mask)
         next_gen_time = time() - rollout_generate_time
-        chunk_rows = len(next_batch.input_ids) * mh.process_count()
+        chunk_rows = len(next_batch.input_ids) * mh.data_group_count(self.mesh)
         while n_collected < num_rollouts:
             stats: Dict[str, float] = {}
             batch, gen_out = next_batch, next_gen
@@ -406,7 +406,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             seq_w = gen_out["sequences"].shape[1]
             N = gen_out["response_ids"].shape[1]
             P_width = prompt_tensors.shape[1]
-            B_local = gen_out["sequences"].shape[0] // mh.process_count()
+            B_local = gen_out["sequences"].shape[0] // mh.data_group_count(self.mesh)
 
             # ONE packed device->host transfer for the three generation
             # outputs (a remote-tunneled chip pays ~100ms latency PER
@@ -458,7 +458,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
                         ),
                         gen_out["response_mask"].astype(jnp.int32),
                         jnp.float32(self.kl_ctl.value),
-                        jnp.float32(B_local * mh.process_count()),
+                        jnp.float32(gen_out["sequences"].shape[0]),
                     )
 
             packed = packed_dev
@@ -596,7 +596,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
                         mh.global_from_local(rpad(scores), sharding),
                         mh.global_from_local(rpad(scores_mask), sharding),
                         jnp.float32(self.kl_ctl.value),
-                        jnp.float32(B * mh.process_count()),
+                        jnp.float32(B * mh.data_group_count(self.mesh)),
                         scale_div,
                     )
             if target != B:
@@ -616,9 +616,9 @@ class TPUPPOTrainer(TPUBaseTrainer):
             accumulated_stats.append(stats)
 
             self.push_to_store(rollout_batch)
-            n_collected += len(sequences) * mh.process_count()
+            n_collected += len(sequences) * mh.data_group_count(self.mesh)
             if hasattr(pbar, "update"):
-                pbar.update(len(sequences) * mh.process_count())
+                pbar.update(len(sequences) * mh.data_group_count(self.mesh))
             logger.info("[rollout %d / %d]", n_collected, num_rollouts)
 
         agg = {
@@ -684,8 +684,8 @@ class TPUPPOTrainer(TPUBaseTrainer):
         # prompts at chunk_size/P rows; generation reassembles the global
         # chunk (the reference scatters from rank 0 instead —
         # accelerate_ppo_trainer.py:292-341)
-        pipeline = mh.shard_pipeline(pipeline)
-        chunk = max(self.config.method.chunk_size // mh.process_count(), 1)
+        pipeline = mh.shard_pipeline(pipeline, self.mesh)
+        chunk = max(self.config.method.chunk_size // mh.data_group_count(self.mesh), 1)
         # drop_last keeps chunk shapes static: one compiled sampler
         loader = pipeline.create_loader(
             chunk, shuffle=True, drop_last=True,
@@ -698,8 +698,8 @@ class TPUPPOTrainer(TPUBaseTrainer):
         self.prompt_iterator = infinite_loader(loader)
 
     def prepare_learning(self) -> None:
-        self.eval_dataloader = mh.shard_pipeline(self.eval_pipeline).create_loader(
-            max(self.config.method.chunk_size // mh.process_count(), 1)
+        self.eval_dataloader = mh.shard_pipeline(self.eval_pipeline, self.mesh).create_loader(
+            max(self.config.method.chunk_size // mh.data_group_count(self.mesh), 1)
         )
         self.make_experience(self.config.method.num_rollouts)
         self.n_inner_epochs = self.config.method.ppo_epochs

@@ -86,9 +86,9 @@ class TPURFTTrainer(TPUBaseTrainer):
     def add_prompt_pipeline(self, pipeline) -> None:
         # multi-host: each process generates/scores its strided slice;
         # selection happens on the all-gathered pool below
-        pipeline = mh.shard_pipeline(pipeline)
+        pipeline = mh.shard_pipeline(pipeline, self.mesh)
         self.prompt_dataloader = pipeline.create_loader(
-            max(self.config.train.batch_size // mh.process_count(), 1)
+            max(self.config.train.batch_size // mh.data_group_count(self.mesh), 1)
         )
 
     def make_experience(self, samples=None, rewards=None, seq_length=None) -> None:
@@ -120,10 +120,15 @@ class TPURFTTrainer(TPUBaseTrainer):
                 {"prompt": g["prompt"], "output": g["output"], "score": float(s)}
                 for g, s in zip(generations, scores)
             ]
-            # multi-host: pool every host's generations so threshold
+            # multi-host: pool every DATA GROUP's generations so threshold
             # selection sees the full set (reference all_gather_object,
-            # accelerate_rft_trainer.py:127-144)
-            for part in mh.allgather_object(scored):
+            # accelerate_rft_trainer.py:127-144). Processes on other pp
+            # stages of the same rows contribute replicas — keep one
+            # representative per group to avoid double-counting.
+            keep = set(mh.group_representatives(self.mesh))
+            for proc, part in enumerate(mh.allgather_object(scored)):
+                if proc not in keep:
+                    continue
                 for g in part:
                     self.generations_per_prompt[g["prompt"]].append(
                         {"output": g["output"], "score": g["score"]}
