@@ -397,3 +397,88 @@ def test_data_group_info(monkeypatch):
     m = mesh_of(bad, (1, 2, 2, 2, 1))
     with pytest.raises(ValueError, match="row blocks"):
         mh.data_group_info(m)
+
+
+def test_pp_t5_forward_parity():
+    """Encoder and decoder stacks of the seq2seq (T5) family pipeline
+    over pp with identical teacher-forced outputs, including the hydra
+    branch capture."""
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM
+
+    cfg = Seq2SeqConfig(
+        vocab_size=97, d_model=32, d_kv=8, d_ff=64, n_layer=4,
+        n_decoder_layer=4, n_head=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=20,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    lm = T5LM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    B, S, T = 8, 7, 5
+    enc_ids = rng.integers(0, 97, (B, S)).astype(np.int32)
+    enc_mask = np.ones((B, S), np.int32)
+    enc_mask[: B // 2, -2:] = 0
+    dec_ids = rng.integers(0, 97, (B, T)).astype(np.int32)
+    dec_ids[:, 0] = 0
+
+    lm.mesh = None
+    ref = jax.jit(lambda p: lm(p, enc_ids, enc_mask, dec_ids))(params)
+    ref_cap = jax.jit(
+        lambda p: lm.forward_with_branch_capture(p, enc_ids, enc_mask, dec_ids, None, 2)
+    )(params)
+
+    mesh = make_mesh({"pp": 2, "dp": 2, "tp": 2})
+    lm.mesh = mesh
+    with mesh:
+        sp = shard_params(mesh, params)
+        out = jax.jit(lambda p: lm(p, enc_ids, enc_mask, dec_ids))(sp)
+        out_cap = jax.jit(
+            lambda p: lm.forward_with_branch_capture(
+                p, enc_ids, enc_mask, dec_ids, None, 2
+            )
+        )(sp)
+    np.testing.assert_allclose(
+        np.asarray(out["logits"]), np.asarray(ref["logits"]), atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_cap["branch_hidden"]), np.asarray(ref_cap["branch_hidden"]),
+        atol=1e-5, rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_cap["logits"]), np.asarray(ref_cap["logits"]),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_pp_t5_bf16_grad_compiles():
+    """bf16 ctx leaves (T5 encoder_hidden) cross the shard_map boundary:
+    their cotangent psum must not hit the XLA CPU bf16 AllReducePromotion
+    crash (regression: teacher-forced T5 training under pp aborted the
+    process on CPU meshes in bf16)."""
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM
+
+    cfg = Seq2SeqConfig(
+        vocab_size=97, d_model=32, d_kv=8, d_ff=64, n_layer=2,
+        n_decoder_layer=2, n_head=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=20, dtype=jnp.bfloat16,
+    )
+    lm = T5LM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    enc_ids = rng.integers(0, 97, (8, 6)).astype(np.int32)
+    enc_mask = np.ones((8, 6), np.int32)
+    dec_ids = rng.integers(0, 97, (8, 4)).astype(np.int32)
+
+    mesh = make_mesh({"pp": 2, "dp": 2, "tp": 2})
+    lm.mesh = mesh
+
+    def loss(p):
+        out = lm(p, enc_ids, enc_mask, dec_ids)
+        return (out["logits"].astype(jnp.float32) ** 2).mean()
+
+    with mesh:
+        g = jax.jit(jax.grad(loss))(shard_params(mesh, params))
+    assert all(
+        np.isfinite(np.asarray(x, np.float32)).all()
+        for x in jax.tree_util.tree_leaves(g)
+    )

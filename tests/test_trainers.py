@@ -351,3 +351,32 @@ def test_ppo_dense_rewards_learn(tmp_path):
         reward_fn=dense_reward, prompts=prompts, config=config
     )
     assert trainer.iter_count == 2
+
+
+@pytest.mark.slow
+def test_ppo_short_final_chunk_indivisible_rows(tmp_path):
+    """A prompt dataset smaller than chunk_size yields a short rollout
+    chunk whose row count does not divide dp*fsdp (regression: the
+    per-row score vector was device_put with a (dp, fsdp) sharding and
+    crashed on the 8-device mesh; generation pads rows but score
+    bookkeeping must not — padding would bias the running moments)."""
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=2, checkpoint_interval=2,
+            seq_length=12, epochs=2, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=tiny_model_cfg(num_layers_unfrozen=1),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=16, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    # 10 prompts < chunk_size 16 -> one 10-row chunk; 10 % 8 ways != 0
+    prompts = ["hello world", "the cat", "a b", "xyz", "what is",
+               "I am", "go", "ok", "more", "last one"]
+    trainer = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=prompts, config=config
+    )
+    assert trainer.iter_count == 2

@@ -47,6 +47,10 @@ class Seq2SeqConfig:
     decoder_start_token_id: int = 0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # pipeline parallelism: microbatches per pipelined stack when the
+    # mesh has a pp axis > 1 (0 = one per stage); raise to shrink the
+    # (pp-1)/(M+pp-1) bubble — mirrors TransformerConfig.pp_microbatches
+    pp_microbatches: int = 0
 
     def __post_init__(self):
         if self.n_decoder_layer is None:
@@ -225,6 +229,10 @@ class T5LM:
         self.enc_block = T5Block(cfg, is_decoder=False)
         self.dec_block = T5Block(cfg, is_decoder=True)
         self.norm = T5Norm(cfg)
+        # set by the trainer when the mesh has a pp axis > 1: encoder and
+        # decoder stacks pipeline over it (parallel/pipeline.py); decode
+        # steps (cache path) stay sequential
+        self.mesh = None
 
     # -- init ------------------------------------------------------------
 
@@ -288,6 +296,59 @@ class T5LM:
             new_cache = dict(new_kvs, index=cache["index"] + 1)
         return h, new_cache
 
+    def _pp_stages(self, n_layer: int, batch: int) -> int:
+        """Pipeline stage count for a stack, or 0 for the sequential scan
+        (trace-time decision, mirroring TransformerLM._pp_mesh)."""
+        if self.mesh is None:
+            return 0
+        m = dict(self.mesh.shape)
+        pp = m.get("pp", 1)
+        if pp <= 1:
+            return 0
+        if m.get("sp", 1) > 1:
+            raise ValueError(
+                "pp and sp are mutually exclusive: ring attention shards the "
+                f"sequence inside each layer, pipelining shards the layers (mesh {m})"
+            )
+        n_mb = self.cfg.pp_microbatches or pp
+        if n_layer % pp or batch % n_mb:
+            import warnings
+
+            warnings.warn(
+                f"pipeline parallelism requested (pp={pp}) but n_layer="
+                f"{n_layer} or batch={batch} don't divide; falling back to "
+                "the sequential scan",
+                stacklevel=3,
+            )
+            return 0
+        return pp
+
+    def _pp_scan(
+        self,
+        block: nn.Module,
+        stacked: Dict,
+        h: Array,
+        args: tuple,
+        capture_points: tuple = (),
+    ):
+        """Pipelined counterpart of `_scan` for teacher-forced stacks:
+        `args` (biases / encoder hidden) ride as per-microbatch ctx."""
+        from trlx_tpu.parallel.pipeline import pipelined_layers
+
+        def layer_apply(layer, h, ctx_mb):
+            out, _ = block.apply({"params": layer["p"]}, h, *ctx_mb, cache=None)
+            return out
+
+        return pipelined_layers(
+            self.mesh,
+            layer_apply,
+            {"p": stacked},
+            h,
+            tuple(args),
+            n_microbatch=self.cfg.pp_microbatches or dict(self.mesh.shape)["pp"],
+            capture_points=capture_points,
+        )
+
     def _logits(self, params: Dict, hidden: Array) -> Array:
         if "lm_head" in params:
             kernel = params["lm_head"]["kernel"]
@@ -311,7 +372,12 @@ class T5LM:
         )
         bias = bias + jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
         h = self._embed(params, input_ids)
-        h, _ = self._scan(self.enc_block, params["encoder"]["blocks"], h, bias)
+        if self._pp_stages(cfg.n_layer, h.shape[0]):
+            h, _ = self._pp_scan(
+                self.enc_block, params["encoder"]["blocks"], h, (bias,)
+            )
+        else:
+            h, _ = self._scan(self.enc_block, params["encoder"]["blocks"], h, bias)
         return self.norm.apply({"params": params["encoder"]["ln_f"]}, h)
 
     def __call__(
@@ -345,10 +411,16 @@ class T5LM:
         cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
 
         h = self._embed(params, decoder_input_ids)
-        h, _ = self._scan(
-            self.dec_block, params["decoder"]["blocks"], h, self_bias,
-            encoder_hidden, cross_bias,
-        )
+        if self._pp_stages(cfg.n_decoder_layer, B):
+            h, _ = self._pp_scan(
+                self.dec_block, params["decoder"]["blocks"], h,
+                (self_bias, encoder_hidden, cross_bias),
+            )
+        else:
+            h, _ = self._scan(
+                self.dec_block, params["decoder"]["blocks"], h, self_bias,
+                encoder_hidden, cross_bias,
+            )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h)
         return {
             "logits": self._logits(params, hidden),
@@ -387,15 +459,26 @@ class T5LM:
             )
         cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
 
-        bottom = jax.tree_util.tree_map(
-            lambda x: x[:branch_at], params["decoder"]["blocks"]
-        )
-        top = jax.tree_util.tree_map(
-            lambda x: x[branch_at:], params["decoder"]["blocks"]
-        )
         h = self._embed(params, decoder_input_ids)
-        h_branch, _ = self._scan(self.dec_block, bottom, h, self_bias, encoder_hidden, cross_bias)
-        h_top, _ = self._scan(self.dec_block, top, h_branch, self_bias, encoder_hidden, cross_bias)
+        if self._pp_stages(cfg.n_decoder_layer, B):
+            h_top, (h_branch,) = self._pp_scan(
+                self.dec_block, params["decoder"]["blocks"], h,
+                (self_bias, encoder_hidden, cross_bias),
+                capture_points=(branch_at,),
+            )
+        else:
+            bottom = jax.tree_util.tree_map(
+                lambda x: x[:branch_at], params["decoder"]["blocks"]
+            )
+            top = jax.tree_util.tree_map(
+                lambda x: x[branch_at:], params["decoder"]["blocks"]
+            )
+            h_branch, _ = self._scan(
+                self.dec_block, bottom, h, self_bias, encoder_hidden, cross_bias
+            )
+            h_top, _ = self._scan(
+                self.dec_block, top, h_branch, self_bias, encoder_hidden, cross_bias
+            )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h_top)
         return {
             "logits": self._logits(params, hidden),

@@ -154,6 +154,11 @@ def pipelined_layers(
             m_c = jnp.clip(m, 0, M - 1)
             valid = (m >= 0) & (m < M)
             ctx_t = _index_microbatch(ctx_mb, ctx_flags, m_c)
+            # restore boundary-promoted ctx leaves to their compute dtype
+            # (bf16<->f32 round-trips are bit-exact)
+            ctx_t = jax.tree_util.tree_map(
+                lambda x, d: x.astype(d) if x.dtype != d else x, ctx_t, ctx_dtypes
+            )
             h_in = jnp.where(s == 0, h_mb[jnp.clip(t, 0, M - 1)], buf)
             y, caps = stage(xs_local, h_in, ctx_t)
             if n_pts:
@@ -183,6 +188,15 @@ def pipelined_layers(
         h_mb, NamedSharding(mesh, P(None, ("dp", "fsdp")))
     )
     ctx_flags = _microbatch_flags(ctx, B)
+    # the bf16-all-reduce CPU workaround applies to ctx leaves too: the
+    # shard_map transpose of a replicated-in bf16 leaf (e.g. a T5
+    # encoder_hidden) emits a bf16 psum over pp for its cotangent
+    ctx_dtypes = jax.tree_util.tree_map(lambda x: x.dtype, ctx)
+    if on_cpu:
+        ctx = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x,
+            ctx,
+        )
     ctx_mb = _split_microbatches(ctx, ctx_flags, M)
 
     f = jax.shard_map(

@@ -39,7 +39,7 @@ from trlx_tpu.ops.common import (
 from trlx_tpu.ops.ppo import gae_advantages_and_returns, ppo_loss
 from trlx_tpu.parallel import data_sharding, shard_params
 from trlx_tpu.parallel import multihost as mh
-from trlx_tpu.parallel.mesh import vector_sharding
+from trlx_tpu.parallel.mesh import replicated_sharding, vector_sharding
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
@@ -513,9 +513,27 @@ class TPUPPOTrainer(TPUBaseTrainer):
 
             # local per-row sums -> one GLOBAL vector; the running-moment
             # update then reduces over every host's rows in-graph (the
-            # reference all-gathers scores to rank 0 instead)
+            # reference all-gathers scores to rank 0 instead). A short
+            # final chunk (prompt dataset smaller than chunk_size) may not
+            # divide dp*fsdp — keep the tiny vector replicated then
+            # (padding would bias the running reward moments). Multi-host
+            # can't replicate per-group-different rows: reject the short
+            # chunk HERE, before any moment update could consume
+            # cross-host-inconsistent values (the later pad-row check
+            # would raise anyway, but only after poisoning the moments)
+            local_sums = (scores * scores_mask).sum(axis=1)
+            rows = len(local_sums) * mh.data_group_count(self.mesh)
+            if rows % self.data_ways() and mh.is_multihost():
+                raise ValueError(
+                    f"multi-host rollout chunk of {len(local_sums)} rows per "
+                    f"data group does not divide dp*fsdp={self.data_ways()}; "
+                    "size the prompt dataset / chunk_size for clean shards"
+                )
             score_sums = mh.global_from_local(
-                (scores * scores_mask).sum(axis=1), vector_sharding(self.mesh)
+                local_sums,
+                vector_sharding(self.mesh)
+                if rows % self.data_ways() == 0
+                else replicated_sharding(self.mesh),
             )
             if self.ref_mean is None:
                 self.ref_mean = float(score_sums.mean())
