@@ -102,6 +102,21 @@ def sample_token(rng: jax.Array, logits: Array, settings: SamplerSettings) -> Ar
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+
+def cast_params_for_decode(params: Dict, compute_dtype) -> Dict:
+    """Hoist the per-matmul param casts out of a decode loop: every step
+    re-reads every weight, so pre-casting float leaves to the compute
+    dtype halves decode HBM traffic when params are stored fp32
+    (training precision). No-op for fp32-compute configs; logits still
+    accumulate in fp32. Shared by the causal and seq2seq samplers."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(compute_dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        params,
+    )
+
+
 def generate(
     model: TransformerLM,
     params: Dict,
@@ -132,6 +147,7 @@ def generate(
     N = settings.max_new_tokens
     if N < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    params = cast_params_for_decode(params, model.cfg.dtype)
     n_virt = 0
     if soft_prompt is not None:
         n_virt = soft_prompt.shape[0]

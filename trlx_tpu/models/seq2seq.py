@@ -296,32 +296,15 @@ class T5LM:
             new_cache = dict(new_kvs, index=cache["index"] + 1)
         return h, new_cache
 
-    def _pp_stages(self, n_layer: int, batch: int) -> int:
-        """Pipeline stage count for a stack, or 0 for the sequential scan
-        (trace-time decision, mirroring TransformerLM._pp_mesh)."""
-        if self.mesh is None:
-            return 0
-        m = dict(self.mesh.shape)
-        pp = m.get("pp", 1)
-        if pp <= 1:
-            return 0
-        if m.get("sp", 1) > 1:
-            raise ValueError(
-                "pp and sp are mutually exclusive: ring attention shards the "
-                f"sequence inside each layer, pipelining shards the layers (mesh {m})"
-            )
-        n_mb = self.cfg.pp_microbatches or pp
-        if n_layer % pp or batch % n_mb:
-            import warnings
+    def _pp_microbatches(self, n_layer: int, batch: int) -> int:
+        """Microbatch count for a pipelined stack, or 0 for the
+        sequential scan — same shared gate as TransformerLM
+        (parallel.pipeline.pp_microbatch_count)."""
+        from trlx_tpu.parallel.pipeline import pp_microbatch_count
 
-            warnings.warn(
-                f"pipeline parallelism requested (pp={pp}) but n_layer="
-                f"{n_layer} or batch={batch} don't divide; falling back to "
-                "the sequential scan",
-                stacklevel=3,
-            )
-            return 0
-        return pp
+        return pp_microbatch_count(
+            self.mesh, n_layer, batch, self.cfg.pp_microbatches
+        )
 
     def _pp_scan(
         self,
@@ -329,6 +312,7 @@ class T5LM:
         stacked: Dict,
         h: Array,
         args: tuple,
+        n_microbatch: int,
         capture_points: tuple = (),
     ):
         """Pipelined counterpart of `_scan` for teacher-forced stacks:
@@ -345,7 +329,7 @@ class T5LM:
             {"p": stacked},
             h,
             tuple(args),
-            n_microbatch=self.cfg.pp_microbatches or dict(self.mesh.shape)["pp"],
+            n_microbatch=n_microbatch,
             capture_points=capture_points,
         )
 
@@ -372,9 +356,10 @@ class T5LM:
         )
         bias = bias + jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
         h = self._embed(params, input_ids)
-        if self._pp_stages(cfg.n_layer, h.shape[0]):
+        n_mb = self._pp_microbatches(cfg.n_layer, h.shape[0])
+        if n_mb:
             h, _ = self._pp_scan(
-                self.enc_block, params["encoder"]["blocks"], h, (bias,)
+                self.enc_block, params["encoder"]["blocks"], h, (bias,), n_mb
             )
         else:
             h, _ = self._scan(self.enc_block, params["encoder"]["blocks"], h, bias)
@@ -411,10 +396,11 @@ class T5LM:
         cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
 
         h = self._embed(params, decoder_input_ids)
-        if self._pp_stages(cfg.n_decoder_layer, B):
+        n_mb = self._pp_microbatches(cfg.n_decoder_layer, B)
+        if n_mb:
             h, _ = self._pp_scan(
                 self.dec_block, params["decoder"]["blocks"], h,
-                (self_bias, encoder_hidden, cross_bias),
+                (self_bias, encoder_hidden, cross_bias), n_mb,
             )
         else:
             h, _ = self._scan(
@@ -460,10 +446,11 @@ class T5LM:
         cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
 
         h = self._embed(params, decoder_input_ids)
-        if self._pp_stages(cfg.n_decoder_layer, B):
+        n_mb = self._pp_microbatches(cfg.n_decoder_layer, B)
+        if n_mb:
             h_top, (h_branch,) = self._pp_scan(
                 self.dec_block, params["decoder"]["blocks"], h,
-                (self_bias, encoder_hidden, cross_bias),
+                (self_bias, encoder_hidden, cross_bias), n_mb,
                 capture_points=(branch_at,),
             )
         else:
@@ -579,6 +566,9 @@ def generate_seq2seq(
     cfg = model.cfg
     B = input_ids.shape[0]
     N = settings.max_new_tokens
+    from trlx_tpu.models.generation import cast_params_for_decode
+
+    params = cast_params_for_decode(params, cfg.dtype)
     enc = model.encode(params, input_ids, attention_mask)
     cache = model.init_cache(B, N + 1)
     start = jnp.full((B, 1), cfg.decoder_start_token_id, jnp.int32)

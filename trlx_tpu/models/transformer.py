@@ -493,35 +493,21 @@ class TransformerLM:
             return None
         return self.mesh
 
-    def _pp_mesh(self, batch: int, cache) -> Optional[Any]:
-        """The mesh to pipeline the layer stack over, or None for the
+    def _pp_microbatches(self, batch: int, cache) -> int:
+        """Microbatch count for a pipelined forward, or 0 for the
         sequential scan. Static (trace-time) decision. Pipelining needs a
         teacher-forced forward (decode steps thread a KV cache through
         every layer sequentially anyway) and divisible shapes; ring
-        attention (sp) composes with dp/fsdp/tp but not with pp."""
-        cfg = self.cfg
-        if self.mesh is None or cache is not None:
-            return None
-        m = self.mesh.shape
-        if m.get("pp", 1) <= 1:
-            return None
-        if m.get("sp", 1) > 1:
-            raise ValueError(
-                "pp and sp are mutually exclusive: ring attention shards the "
-                f"sequence inside each layer, pipelining shards the layers (mesh {dict(m)})"
-            )
-        n_mb = cfg.pp_microbatches or m["pp"]
-        if cfg.n_layer % m["pp"] or batch % n_mb:
-            import warnings
+        attention (sp) composes with dp/fsdp/tp but not with pp —
+        eligibility rules live in parallel.pipeline.pp_microbatch_count,
+        shared with the seq2seq stacks."""
+        from trlx_tpu.parallel.pipeline import pp_microbatch_count
 
-            warnings.warn(
-                f"pipeline parallelism requested (pp={m['pp']}) but "
-                f"n_layer={cfg.n_layer} or batch={batch} don't divide "
-                f"(microbatches={n_mb}); falling back to the sequential scan",
-                stacklevel=3,
-            )
-            return None
-        return self.mesh
+        if cache is not None:
+            return 0
+        return pp_microbatch_count(
+            self.mesh, self.cfg.n_layer, batch, self.cfg.pp_microbatches
+        )
 
     def _pipeline_blocks(
         self,
@@ -530,6 +516,7 @@ class TransformerLM:
         attn_bias: Array,
         positions: Array,
         *,
+        n_microbatch: int,
         remat: bool = False,
         key_mask: Optional[Array] = None,
         local_bias: Optional[Array] = None,
@@ -569,7 +556,7 @@ class TransformerLM:
             xs,
             h,
             ctx,
-            n_microbatch=cfg.pp_microbatches or self.mesh.shape["pp"],
+            n_microbatch=n_microbatch,
             capture_points=capture_points,
             remat=remat,
         )
@@ -800,11 +787,11 @@ class TransformerLM:
             h = jax.lax.dynamic_update_slice_in_dim(
                 h, h[:, :n_rows] - wte0 + soft, 0, axis=1
             )
-        pp = None if ring is not None else self._pp_mesh(B, layer_cache)
-        if pp is not None:
+        n_mb = 0 if ring is not None else self._pp_microbatches(B, layer_cache)
+        if n_mb:
             h, _ = self._pipeline_blocks(
-                params["blocks"], h, bias, positions, remat=remat,
-                key_mask=attention_mask, local_bias=local_bias,
+                params["blocks"], h, bias, positions, n_microbatch=n_mb,
+                remat=remat, key_mask=attention_mask, local_bias=local_bias,
             )
             new_cache = None
         else:
@@ -861,11 +848,11 @@ class TransformerLM:
             )
         h = self._embed_h(params, input_ids, positions)
 
-        pp = None if ring is not None else self._pp_mesh(B, None)
-        if pp is not None:
+        n_mb = 0 if ring is not None else self._pp_microbatches(B, None)
+        if n_mb:
             h_top, (h_branch,) = self._pipeline_blocks(
-                params["blocks"], h, bias, positions, remat=remat,
-                key_mask=attention_mask, local_bias=local_bias,
+                params["blocks"], h, bias, positions, n_microbatch=n_mb,
+                remat=remat, key_mask=attention_mask, local_bias=local_bias,
                 capture_points=(branch_at,),
             )
         else:
@@ -919,14 +906,14 @@ class TransformerLM:
             )
         h = self._embed_h(params, input_ids, positions)
 
-        pp = None if ring is not None else self._pp_mesh(B, None)
-        if pp is not None:
+        n_mb = 0 if ring is not None else self._pp_microbatches(B, None)
+        if n_mb:
             # match the sequential path: points >= n_layer are omitted
             # (never captured), not returned as zeros
             in_range = tuple(p for p in points if p < self.cfg.n_layer)
             h, caps = self._pipeline_blocks(
-                params["blocks"], h, bias, positions, remat=remat,
-                key_mask=attention_mask, local_bias=local_bias,
+                params["blocks"], h, bias, positions, n_microbatch=n_mb,
+                remat=remat, key_mask=attention_mask, local_bias=local_bias,
                 capture_points=in_range,
             )
             captures = list(caps)

@@ -42,6 +42,43 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 Array = jax.Array
 
 
+def pp_microbatch_count(
+    mesh,
+    n_layer: int,
+    batch: int,
+    pp_microbatches: int = 0,
+    stacklevel: int = 4,
+) -> int:
+    """Shared trace-time pp gate: the microbatch count to pipeline a
+    stack with, or 0 for the sequential scan. One definition so the
+    causal and seq2seq models cannot drift on eligibility rules, and so
+    the divisibility check guards the exact value `pipelined_layers`
+    receives."""
+    if mesh is None:
+        return 0
+    m = dict(mesh.shape)
+    pp = m.get("pp", 1)
+    if pp <= 1:
+        return 0
+    if m.get("sp", 1) > 1:
+        raise ValueError(
+            "pp and sp are mutually exclusive: ring attention shards the "
+            f"sequence inside each layer, pipelining shards the layers (mesh {m})"
+        )
+    n_mb = pp_microbatches or pp
+    if n_layer % pp or batch % n_mb:
+        import warnings
+
+        warnings.warn(
+            f"pipeline parallelism requested (pp={pp}) but n_layer={n_layer} "
+            f"or batch={batch} don't divide (microbatches={n_mb}); falling "
+            "back to the sequential scan",
+            stacklevel=stacklevel,
+        )
+        return 0
+    return n_mb
+
+
 def _microbatch_flags(tree, batch: int):
     """Static per-leaf decision: leaves with leading dim == batch get
     split per microbatch; broadcast-shaped aux (e.g. [1, 1, T, S] biases)
