@@ -482,3 +482,25 @@ def test_pp_t5_bf16_grad_compiles():
         np.isfinite(np.asarray(x, np.float32)).all()
         for x in jax.tree_util.tree_leaves(g)
     )
+
+
+def test_pp_prompt_tuning_parity():
+    """Teacher-forced prompt tuning (soft tokens as leading positions)
+    rides through the pipelined forward unchanged."""
+    cfg = tiny_cfg()
+    lm = TransformerLM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    soft = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (4, cfg.hidden_size)), np.float32
+    )
+    ids, mask = padded_batch()
+
+    lm.mesh = None
+    ref = jax.jit(lambda p: lm(p, ids, mask, prefix_embeds=soft)["logits"])(params)
+    mesh = make_mesh({"pp": 2, "dp": 2})
+    lm.mesh = mesh
+    with mesh:
+        out = jax.jit(lambda p: lm(p, ids, mask, prefix_embeds=soft)["logits"])(
+            shard_params(mesh, params)
+        )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)

@@ -105,16 +105,29 @@ def sample_token(rng: jax.Array, logits: Array, settings: SamplerSettings) -> Ar
 
 def cast_params_for_decode(params: Dict, compute_dtype) -> Dict:
     """Hoist the per-matmul param casts out of a decode loop: every step
-    re-reads every weight, so pre-casting float leaves to the compute
-    dtype halves decode HBM traffic when params are stored fp32
-    (training precision). No-op for fp32-compute configs; logits still
-    accumulate in fp32. Shared by the causal and seq2seq samplers."""
-    return jax.tree_util.tree_map(
-        lambda x: x.astype(compute_dtype)
-        if jnp.issubdtype(x.dtype, jnp.floating)
-        else x,
-        params,
-    )
+    re-reads every weight, so pre-casting MATMUL leaves to the compute
+    dtype halves decode weight traffic when params are stored fp32
+    (training precision). Only rank>=2 kernels/embeddings are cast — the
+    model already casts exactly those at each use (flax dtype=cfg.dtype),
+    so numerics are bit-identical to the uncast forward; 1-D norm
+    scales/biases and the T5 rel_bias table stay fp32 BY DESIGN (their
+    math runs in fp32), keeping the sampling policy exactly equal to the
+    teacher-forced scorer's. Shared by the causal and seq2seq samplers."""
+
+    # whitelist exactly the weights the forward casts per use (flax
+    # DenseGeneral kernels + embedding tables); norm scales (stacked
+    # [L, E] under blocks), biases and rel_bias tables keep fp32
+    matmul_keys = ("kernel", "wte", "wpe")
+
+    def cast(path, x):
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        last = getattr(path[-1], "key", None) if path else None
+        if last not in matmul_keys:
+            return x
+        return x.astype(compute_dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
 
 
 def generate(

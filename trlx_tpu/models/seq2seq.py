@@ -561,13 +561,11 @@ def generate_seq2seq(
     """Sample decoder continuations (analog of models.generation.generate
     for the encoder-decoder path). Output starts with
     `decoder_start_token_id` (the <pad> HF T5 convention)."""
-    from trlx_tpu.models.generation import sample_token
+    from trlx_tpu.models.generation import cast_params_for_decode, sample_token
 
     cfg = model.cfg
     B = input_ids.shape[0]
     N = settings.max_new_tokens
-    from trlx_tpu.models.generation import cast_params_for_decode
-
     params = cast_params_for_decode(params, cfg.dtype)
     enc = model.encode(params, input_ids, attention_mask)
     cache = model.init_cache(B, N + 1)
