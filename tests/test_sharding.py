@@ -140,8 +140,17 @@ def test_loss_invariant_across_meshes():
         ("dp", {"dp": -1}),
         ("fsdp", {"dp": 2, "fsdp": 4}),
         ("tp", {"dp": 2, "fsdp": 2, "tp": 2}),
+        ("pp", {"pp": 2, "dp": 2, "tp": 2}),
     ]:
         mesh = make_mesh(axes)
+        # the pipelined forward engages only when the model holds the mesh
+        lm.mesh = mesh if axes.get("pp", 1) > 1 else None
+        if lm.mesh is not None:
+            # guard against vacuous passes: the gate must actually accept
+            # this config, or the forward silently runs sequential
+            from trlx_tpu.parallel.pipeline import pp_microbatch_count
+
+            assert pp_microbatch_count(mesh, cfg.n_layer, len(ids)) > 0
         with mesh:
             params = shard_params(mesh, params_host)
             batch = jax.device_put(ids, data_sharding(mesh))
@@ -155,3 +164,4 @@ def test_loss_invariant_across_meshes():
             losses[name] = float(loss_fn(params, batch))
     assert abs(losses["dp"] - losses["fsdp"]) < 1e-5, losses
     assert abs(losses["dp"] - losses["tp"]) < 1e-4, losses
+    assert abs(losses["dp"] - losses["pp"]) < 1e-4, losses
