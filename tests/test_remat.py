@@ -1,0 +1,104 @@
+"""Selective activation-checkpointing policies (trlx_tpu/ops/remat.py).
+
+Rematerialization must never change the math — only which intermediates
+the backward pass recomputes. These tests pin loss/grad equality across
+every policy on a tiny causal model and on the T5 stack (whose remat
+hooks landed with the policy work), plus config validation.
+
+Reference analog: NeMo's activation-checkpointing granularity knobs
+(configs/nemo_configs/megatron_20b.yaml:76-80) have no tests in the
+reference; the policy-equivalence property is the TPU-side contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.ops.remat import checkpoint_policy, resolve_remat, wrap_remat
+
+POLICIES = ["full", "save_nothing", "dots_saveable", "dots_with_no_batch_dims"]
+
+
+def _tiny_lm():
+    cfg = TransformerConfig(
+        vocab_size=61, hidden_size=32, n_layer=3, n_head=2, n_positions=32,
+        dtype=jnp.float32,
+    )
+    lm = TransformerLM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 61)
+    mask = jnp.ones_like(ids)
+    return lm, params, ids, mask
+
+
+def _loss_and_grad(lm, params, ids, mask, remat):
+    def loss_fn(p):
+        logits = lm(p, ids, mask, remat=remat)["logits"]
+        return jnp.mean(jax.nn.log_softmax(logits) ** 2)
+
+    return jax.value_and_grad(loss_fn)(params)
+
+
+def test_resolve_remat():
+    assert resolve_remat("none") is False
+    assert resolve_remat("full") == "full"
+    assert resolve_remat(True) is True  # legacy bool call sites
+    with pytest.raises(ValueError, match="remat_policy"):
+        resolve_remat("selective")  # NeMo's name, not ours — must be loud
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_causal_grad_parity_across_policies(policy):
+    lm, params, ids, mask = _tiny_lm()
+    base_loss, base_grad = _loss_and_grad(lm, params, ids, mask, False)
+    loss, grad = _loss_and_grad(lm, params, ids, mask, policy)
+    np.testing.assert_allclose(loss, base_loss, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        grad, base_grad,
+    )
+
+
+def test_offload_policy_resolves():
+    # the offload policy object builds without error; numeric execution
+    # needs a backend with pinned_host memory space (TPU), so CPU CI only
+    # checks construction + resolution here
+    assert checkpoint_policy("offload") is not None
+    assert resolve_remat("offload") == "offload"
+
+
+def test_wrap_remat_none_is_identity():
+    fn = lambda x: x * 2
+    assert wrap_remat(fn, False) is fn
+    assert wrap_remat(fn, "none") is fn
+
+
+def test_seq2seq_grad_parity_across_policies():
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM
+
+    cfg = Seq2SeqConfig(
+        vocab_size=61, d_model=32, d_ff=64, n_layer=2, n_decoder_layer=2,
+        n_head=2, relative_attention_num_buckets=8, dtype=jnp.float32,
+    )
+    lm = T5LM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    enc_ids = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 61)
+    dec_ids = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, 61)
+    mask = jnp.ones_like(enc_ids)
+
+    def loss_fn(p, remat):
+        logits = lm(p, enc_ids, mask, dec_ids, remat=remat)["logits"]
+        return jnp.mean(jax.nn.log_softmax(logits) ** 2)
+
+    base_loss, base_grad = jax.value_and_grad(loss_fn)(params, False)
+    for policy in ["full", "dots_saveable"]:
+        loss, grad = jax.value_and_grad(loss_fn)(params, policy)
+        np.testing.assert_allclose(loss, base_loss, rtol=1e-6)
+        # recompute reorders fp32 reductions (XLA re-fuses the checkpointed
+        # body), so grads match to reassociation noise, not bit-exactly
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
+            grad, base_grad,
+        )

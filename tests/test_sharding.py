@@ -165,3 +165,96 @@ def test_loss_invariant_across_meshes():
     assert abs(losses["dp"] - losses["fsdp"]) < 1e-5, losses
     assert abs(losses["dp"] - losses["tp"]) < 1e-4, losses
     assert abs(losses["dp"] - losses["pp"]) < 1e-4, losses
+
+
+def test_unshard_axis_strips_pp():
+    """unshard_axis drops `pp` from every leaf's layout (eagerly and under
+    jit) while leaving the other axes in place — the decode-time weight
+    gather hoist (docs/architecture.md, ADVICE r2)."""
+    from trlx_tpu.parallel.sharding import unshard_axis, unshard_for_decode
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, n_layer=4, n_head=2, n_positions=32,
+        dtype=jnp.float32,
+    )
+    params = TransformerLM(cfg).init(jax.random.PRNGKey(0))
+    mesh = make_mesh({"pp": 2, "dp": 2, "fsdp": 2})
+    sharded = shard_params(mesh, params)
+    assert "pp" in str(sharded["blocks"]["attn"]["q"]["kernel"].sharding.spec)
+
+    with mesh:
+        gathered = jax.jit(lambda p: unshard_axis(p, mesh, "pp"))(sharded)
+    q = gathered["blocks"]["attn"]["q"]["kernel"]
+    assert "pp" not in str(q.sharding.spec)
+    # non-pp axes survive the strip (fsdp still shards the E dim)
+    assert "fsdp" in str(q.sharding.spec)
+    np.testing.assert_array_equal(
+        np.asarray(q), np.asarray(params["blocks"]["attn"]["q"]["kernel"])
+    )
+
+    # the sampler-side gate: identity without a pp axis
+    no_pp = make_mesh({"dp": 2})
+    assert unshard_for_decode(params, no_pp) is params
+    assert unshard_for_decode(params, None) is params
+
+
+def test_unshard_for_decode_greedy_parity():
+    """Greedy decode on a pp mesh (gathered decode weights) bit-matches
+    the meshless sampler."""
+    from trlx_tpu.models.generation import SamplerSettings, make_generate_fn
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, n_layer=4, n_head=2, n_positions=64,
+        dtype=jnp.float32,
+    )
+    lm = TransformerLM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    settings = SamplerSettings(max_new_tokens=6, do_sample=False,
+                               eos_token_id=2, pad_token_id=0)
+    ids = jnp.array([[5, 6, 7, 8], [9, 10, 11, 12]], jnp.int32)
+    mask = jnp.ones_like(ids)
+    rng = jax.random.PRNGKey(1)
+    base = make_generate_fn(lm, settings)(params, ids, mask, rng)
+
+    mesh = make_mesh({"pp": 2, "dp": 2, "fsdp": 2})
+    lm.mesh = mesh
+    with mesh:
+        out = make_generate_fn(lm, settings)(
+            shard_params(mesh, params), ids, mask, rng
+        )
+    np.testing.assert_array_equal(
+        np.asarray(base["sequences"]), np.asarray(out["sequences"])
+    )
+
+
+def test_seq2seq_unshard_for_decode_greedy_parity():
+    """Seq2seq decode on a pp mesh unshards ONLY the decoder subtree
+    (the encoder stays pp-sharded for the pipelined encode) and still
+    bit-matches the meshless sampler."""
+    from trlx_tpu.models.generation import SamplerSettings
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM, generate_seq2seq
+
+    cfg = Seq2SeqConfig(
+        vocab_size=64, d_model=32, d_ff=64, n_layer=2, n_decoder_layer=4,
+        n_head=2, relative_attention_num_buckets=8, dtype=jnp.float32,
+    )
+    lm = T5LM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    settings = SamplerSettings(max_new_tokens=5, do_sample=False,
+                               eos_token_id=1, pad_token_id=0)
+    ids = jnp.array([[5, 6, 7, 8], [9, 10, 11, 12]], jnp.int32)
+    mask = jnp.ones_like(ids)
+    rng = jax.random.PRNGKey(1)
+    base = jax.jit(
+        lambda p, i, m, r: generate_seq2seq(lm, p, i, m, r, settings)
+    )(params, ids, mask, rng)
+
+    mesh = make_mesh({"pp": 2, "dp": 2, "fsdp": 2})
+    lm.mesh = mesh
+    with mesh:
+        out = jax.jit(
+            lambda p, i, m, r: generate_seq2seq(lm, p, i, m, r, settings)
+        )(shard_params(mesh, params), ids, mask, rng)
+    np.testing.assert_array_equal(
+        np.asarray(base["response_ids"]), np.asarray(out["response_ids"])
+    )

@@ -155,8 +155,13 @@ class TrainConfig(_Section):
     # Precision of params/compute; optimizer state stays fp32.
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
-    # Rematerialization policy for transformer blocks: "none" | "full" |
-    # "dots_saveable" (NeMo selective-checkpointing parity).
+    # Rematerialization policy for transformer blocks (NeMo activation-
+    # checkpointing granularity parity — megatron_20b.yaml:76-80):
+    # "none" | "full" (= "save_nothing": keep layer boundaries only) |
+    # "dots_saveable" (keep matmul outputs, recompute elementwise —
+    # NeMo "selective") | "dots_with_no_batch_dims" (keep weight-
+    # stationary matmul results only) | "offload" (same, saved to
+    # pinned host memory). See trlx_tpu/ops/remat.py.
     remat_policy: str = "none"
     # When set, a jax.profiler trace of train steps [profile_start,
     # profile_stop) is written here (the reference exposes Nsight knobs in

@@ -108,11 +108,20 @@ def cast_params_for_decode(params: Dict, compute_dtype) -> Dict:
     re-reads every weight, so pre-casting MATMUL leaves to the compute
     dtype halves decode weight traffic when params are stored fp32
     (training precision). Only rank>=2 kernels/embeddings are cast — the
-    model already casts exactly those at each use (flax dtype=cfg.dtype),
-    so numerics are bit-identical to the uncast forward; 1-D norm
-    scales/biases and the T5 rel_bias table stay fp32 BY DESIGN (their
-    math runs in fp32), keeping the sampling policy exactly equal to the
-    teacher-forced scorer's. Shared by the causal and seq2seq samplers."""
+    model casts exactly those at each use (flax dtype=cfg.dtype) — and
+    1-D norm scales/biases and the T5 rel_bias table stay fp32 BY DESIGN
+    (their math runs in fp32).
+
+    Numerics: bit-identical to the uncast forward for rotary/alibi/none
+    position embeddings. For `pos_embed="learned"` the uncast forward
+    adds take(wte)+take(wpe) in fp32 *before* rounding to the compute
+    dtype, while the pre-cast version adds two pre-rounded operands — an
+    ulp-level divergence in the sampled policy only. PPO correctness is
+    unaffected: old/new logprob ratios both come from the teacher-forced
+    scorer (which never sees pre-cast params), so the ratio is computed
+    consistently either way; we keep the cast because the tied wte is
+    the largest single matrix read per decode step (e.g. 39% of GPT-2's
+    weights). Shared by the causal and seq2seq samplers."""
 
     # whitelist exactly the weights the forward casts per use (flax
     # DenseGeneral kernels + embedding tables); norm scales (stacked
@@ -161,6 +170,12 @@ def generate(
     if N < 1:
         raise ValueError("max_new_tokens must be >= 1")
     params = cast_params_for_decode(params, model.cfg.dtype)
+    # decode runs the sequential layer scan even when training is
+    # pipelined; gather each stage's layer slice ONCE here instead of
+    # on every decode step (parallel/sharding.py:unshard_axis)
+    from trlx_tpu.parallel.sharding import unshard_for_decode
+
+    params = unshard_for_decode(params, getattr(model, "mesh", None))
     n_virt = 0
     if soft_prompt is not None:
         n_virt = soft_prompt.shape[0]

@@ -279,7 +279,8 @@ class T5LM:
     def _embed(self, params: Dict, ids: Array) -> Array:
         return jnp.take(params["shared"]["wte"], ids, axis=0).astype(self.cfg.dtype)
 
-    def _scan(self, block: nn.Module, stacked: Dict, h: Array, *args, cache=None):
+    def _scan(self, block: nn.Module, stacked: Dict, h: Array, *args, cache=None,
+              remat=False):
         def body(hidden, layer):
             if cache is not None:
                 lp, layer_kv = layer
@@ -289,6 +290,10 @@ class T5LM:
             out, new_kv = block.apply({"params": lp}, hidden, *args, cache=layer_cache)
             return out, new_kv
 
+        if cache is None:
+            from trlx_tpu.ops.remat import wrap_remat
+
+            body = wrap_remat(body, remat)
         xs = (stacked, {"k": cache["k"], "v": cache["v"]}) if cache is not None else stacked
         h, new_kvs = jax.lax.scan(body, h, xs)
         new_cache = None
@@ -314,6 +319,7 @@ class T5LM:
         args: tuple,
         n_microbatch: int,
         capture_points: tuple = (),
+        remat=False,
     ):
         """Pipelined counterpart of `_scan` for teacher-forced stacks:
         `args` (biases / encoder hidden) ride as per-microbatch ctx."""
@@ -331,6 +337,7 @@ class T5LM:
             tuple(args),
             n_microbatch=n_microbatch,
             capture_points=capture_points,
+            remat=remat,
         )
 
     def _logits(self, params: Dict, hidden: Array) -> Array:
@@ -346,7 +353,8 @@ class T5LM:
 
     # -- forward ---------------------------------------------------------
 
-    def encode(self, params: Dict, input_ids: Array, attention_mask: Array) -> Array:
+    def encode(self, params: Dict, input_ids: Array, attention_mask: Array,
+               remat=False) -> Array:
         cfg = self.cfg
         T = input_ids.shape[1]
         pos = jnp.arange(T)
@@ -359,10 +367,12 @@ class T5LM:
         n_mb = self._pp_microbatches(cfg.n_layer, h.shape[0])
         if n_mb:
             h, _ = self._pp_scan(
-                self.enc_block, params["encoder"]["blocks"], h, (bias,), n_mb
+                self.enc_block, params["encoder"]["blocks"], h, (bias,), n_mb,
+                remat=remat,
             )
         else:
-            h, _ = self._scan(self.enc_block, params["encoder"]["blocks"], h, bias)
+            h, _ = self._scan(self.enc_block, params["encoder"]["blocks"], h, bias,
+                              remat=remat)
         return self.norm.apply({"params": params["encoder"]["ln_f"]}, h)
 
     def __call__(
@@ -377,10 +387,10 @@ class T5LM:
     ) -> Dict[str, Array]:
         """Teacher-forced forward. `encoder_hidden` may be reused across
         calls (e.g. computed once during rollout generation)."""
-        del remat  # seq2seq remat hooks follow in a later pass
         cfg = self.cfg
         if encoder_hidden is None:
-            encoder_hidden = self.encode(params, input_ids, attention_mask)
+            encoder_hidden = self.encode(params, input_ids, attention_mask,
+                                         remat=remat)
         B, T = decoder_input_ids.shape
         pos = jnp.arange(T)
         self_bias = compute_position_bias(
@@ -400,12 +410,12 @@ class T5LM:
         if n_mb:
             h, _ = self._pp_scan(
                 self.dec_block, params["decoder"]["blocks"], h,
-                (self_bias, encoder_hidden, cross_bias), n_mb,
+                (self_bias, encoder_hidden, cross_bias), n_mb, remat=remat,
             )
         else:
             h, _ = self._scan(
                 self.dec_block, params["decoder"]["blocks"], h, self_bias,
-                encoder_hidden, cross_bias,
+                encoder_hidden, cross_bias, remat=remat,
             )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h)
         return {
@@ -424,13 +434,15 @@ class T5LM:
         decoder_input_ids: Array,
         decoder_attention_mask: Optional[Array],
         branch_at: int,
+        remat=False,
     ) -> Dict[str, Array]:
         """Teacher-forced forward that also returns the decoder hidden
         state entering layer `branch_at` plus the biases needed to re-run
         the top branch (parity: the reference's frozen `T5Branch`,
         modeling_ppo.py:1483-1592, which re-runs top decoder blocks)."""
         cfg = self.cfg
-        encoder_hidden = self.encode(params, input_ids, attention_mask)
+        encoder_hidden = self.encode(params, input_ids, attention_mask,
+                                     remat=remat)
         B, T = decoder_input_ids.shape
         pos = jnp.arange(T)
         self_bias = compute_position_bias(
@@ -451,7 +463,7 @@ class T5LM:
             h_top, (h_branch,) = self._pp_scan(
                 self.dec_block, params["decoder"]["blocks"], h,
                 (self_bias, encoder_hidden, cross_bias), n_mb,
-                capture_points=(branch_at,),
+                capture_points=(branch_at,), remat=remat,
             )
         else:
             bottom = jax.tree_util.tree_map(
@@ -461,10 +473,12 @@ class T5LM:
                 lambda x: x[branch_at:], params["decoder"]["blocks"]
             )
             h_branch, _ = self._scan(
-                self.dec_block, bottom, h, self_bias, encoder_hidden, cross_bias
+                self.dec_block, bottom, h, self_bias, encoder_hidden, cross_bias,
+                remat=remat,
             )
             h_top, _ = self._scan(
-                self.dec_block, top, h_branch, self_bias, encoder_hidden, cross_bias
+                self.dec_block, top, h_branch, self_bias, encoder_hidden, cross_bias,
+                remat=remat,
             )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h_top)
         return {
@@ -483,11 +497,12 @@ class T5LM:
         self_bias: Array,
         encoder_hidden: Array,
         cross_bias: Array,
+        remat=False,
     ) -> Dict[str, Array]:
         """Run a frozen top-k decoder branch from a captured hidden state."""
         h, _ = self._scan(
             self.dec_block, branch_params["blocks"], branch_hidden, self_bias,
-            encoder_hidden, cross_bias,
+            encoder_hidden, cross_bias, remat=remat,
         )
         hidden = self.norm.apply({"params": branch_params["ln_f"]}, h)
         return {"logits": self._logits(branch_params, hidden)}
@@ -567,6 +582,15 @@ def generate_seq2seq(
     B = input_ids.shape[0]
     N = settings.max_new_tokens
     params = cast_params_for_decode(params, cfg.dtype)
+    # same pp-decode weight-gather hoist as models.generation.generate,
+    # restricted to the decoder stack: the encoder runs ONCE (pipelined
+    # when pp>1) and its pp-sharded blocks are never read by the decode
+    # loop, so gathering them would spend cross-stage (possibly DCN)
+    # bandwidth and pp× encoder-param memory for nothing
+    from trlx_tpu.parallel.sharding import unshard_for_decode
+
+    mesh = getattr(model, "mesh", None)
+    params = dict(params, decoder=unshard_for_decode(params["decoder"], mesh))
     enc = model.encode(params, input_ids, attention_mask)
     cache = model.init_cache(B, N + 1)
     start = jnp.full((B, 1), cfg.decoder_start_token_id, jnp.int32)

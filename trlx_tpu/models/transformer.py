@@ -666,8 +666,9 @@ class TransformerLM:
             )
             return out, new_kv
 
-        if remat:
-            body = jax.checkpoint(body, prevent_cse=False)
+        from trlx_tpu.ops.remat import wrap_remat
+
+        body = wrap_remat(body, remat)
 
         xs: Dict[str, Any] = {"p": block_params}
         if cache is not None:

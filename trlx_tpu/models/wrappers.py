@@ -307,12 +307,12 @@ class Seq2SeqLMWithValueHead:
             )
             ref_out = self.lm(
                 ref_params, input_ids, attention_mask, decoder_input_ids,
-                decoder_attention_mask,
+                decoder_attention_mask, remat=remat,
             )
             return dict(out, ref_logits=jax.lax.stop_gradient(ref_out["logits"]))
         out = self.lm.forward_with_branch_capture(
             params["base"], input_ids, attention_mask, decoder_input_ids,
-            decoder_attention_mask, self.branch_at,
+            decoder_attention_mask, self.branch_at, remat=remat,
         )
         values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
         ref_out = self.lm.forward_from_layer(
@@ -321,6 +321,7 @@ class Seq2SeqLMWithValueHead:
             out["self_bias"],
             jax.lax.stop_gradient(out["encoder_hidden"]),
             out["cross_bias"],
+            remat=remat,
         )
         return dict(
             out, values=values, ref_logits=jax.lax.stop_gradient(ref_out["logits"])

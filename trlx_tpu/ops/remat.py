@@ -1,0 +1,85 @@
+"""Selective activation-checkpointing policies.
+
+Parity: the reference's NeMo backend exposes activation-checkpointing
+granularity (selective / uniform / block) per
+/root/reference/configs/nemo_configs/megatron_20b.yaml:76-80, toggled in
+/root/reference/trlx/models/modeling_nemo_ppo.py:788-817. On TPU the
+same levers are `jax.checkpoint` rematerialization policies applied to
+the scanned layer body — the policy decides which intermediates XLA
+keeps across the forward->backward boundary and which it recomputes
+(or offloads to host memory) instead:
+
+  none          keep everything (no remat; fastest forward, peak memory)
+  full          keep only layer boundaries; recompute everything inside
+                each block on the backward pass (NeMo "uniform" with one
+                block per layer). `save_nothing` is an alias.
+  dots_saveable keep matmul outputs, recompute elementwise/norm/softmax
+                chains (NeMo "selective" — the flash-attention-friendly
+                middle ground: backward skips the matmul re-FLOPs but
+                the big activations still never live all-layers-long)
+  dots_with_no_batch_dims
+                keep only batch-free matmul results (weight-stationary
+                contractions); attention score/context matmuls (batched)
+                are recomputed. Lower memory than dots_saveable.
+  offload       dots_with_no_batch_dims, but offload the saved results
+                to pinned host memory instead of keeping them in HBM —
+                trades PCIe/DMA bandwidth for HBM at very long context.
+
+Trainers resolve `train.remat_policy` once via `resolve_remat` (so the
+falsy/truthy checks threaded through the model code keep working: the
+resolved value is `False` or a non-empty policy name) and the three scan
+bodies (causal blocks, seq2seq blocks, pipeline stage ticks) wrap
+themselves with `wrap_remat`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+RematArg = Union[bool, str]
+
+_POLICY_NAMES = (
+    "none",
+    "full",
+    "save_nothing",
+    "dots_saveable",
+    "dots_with_no_batch_dims",
+    "offload",
+)
+
+
+def resolve_remat(policy: RematArg) -> RematArg:
+    """Validate a config `remat_policy` and normalize it for threading:
+    returns False for "none" (so `if remat:` checks stay correct) and
+    the policy name otherwise. Bools pass through (legacy call sites)."""
+    if isinstance(policy, bool):
+        return policy
+    if policy not in _POLICY_NAMES:
+        raise ValueError(
+            f"remat_policy={policy!r} not in {_POLICY_NAMES}"
+        )
+    return False if policy == "none" else policy
+
+
+def checkpoint_policy(remat: RematArg) -> Optional[Callable]:
+    """The jax.checkpoint `policy` for a resolved remat arg (None means
+    the default nothing-saveable, i.e. full recompute)."""
+    p = jax.checkpoint_policies
+    if isinstance(remat, bool) or remat in ("full", "save_nothing"):
+        return None
+    return {
+        "dots_saveable": p.dots_saveable,
+        "dots_with_no_batch_dims": p.dots_with_no_batch_dims_saveable,
+        "offload": p.offload_dot_with_no_batch_dims("device", "pinned_host"),
+    }[remat]
+
+
+def wrap_remat(fn: Callable, remat: RematArg) -> Callable:
+    """Apply jax.checkpoint with the resolved policy ("none"/False: fn
+    unchanged). prevent_cse=False is safe under scan/while (the layer
+    bodies are always inside one) and lets XLA fuse freely."""
+    if not remat or remat == "none":
+        return fn
+    return jax.checkpoint(fn, prevent_cse=False, policy=checkpoint_policy(remat))

@@ -171,8 +171,9 @@ def pipelined_layers(
             )
             return (h, caps), None
 
-        if remat:
-            body = jax.checkpoint(body, prevent_cse=False)
+        from trlx_tpu.ops.remat import wrap_remat
+
+        body = wrap_remat(body, remat)
         caps0 = jnp.zeros((n_pts,) + h.shape, io_dtype)
         (h, caps), _ = jax.lax.scan(body, (h.astype(compute_dtype), caps0), xs_local)
         return h.astype(io_dtype), caps

@@ -116,6 +116,48 @@ def shard_params(mesh: Mesh, params: Dict) -> Dict:
     )
 
 
+def unshard_axis(params: Dict, mesh: Mesh, axis: str = "pp") -> Dict:
+    """Re-lay out a param tree with `axis` dropped from every spec
+    (all-gathering each leaf's shards over that mesh axis).
+
+    Decode under pipeline parallelism is the use case: the sequential
+    KV-cache scan reads every layer's weights each step, and with the
+    stacked layer axis sharded over `pp` each step would gather the
+    remote stages' slices — across DCN on a dcn_pp2-style mesh. Calling
+    this once on the decode param copy (inside the sampler jit, before
+    the while_loop) turns per-step cross-stage traffic into ONE gather
+    per generate call; the loop then reads stage-local weights. Costs
+    pp× block-param memory per device for the duration of the call —
+    the decode copy is already materialized by `cast_params_for_decode`,
+    so this re-shards that copy rather than duplicating params again.
+
+    Works under jit (sharding constraint) and outside (device_put).
+    """
+
+    def strip(spec_axis):
+        if isinstance(spec_axis, tuple):
+            rest = tuple(a for a in spec_axis if a != axis)
+            return rest if len(rest) > 1 else (rest[0] if rest else None)
+        return None if spec_axis == axis else spec_axis
+
+    def constrain(path, x):
+        spec = _fit_spec(spec_for_path(_path_str(path)), np.shape(x), mesh)
+        stripped = P(*[strip(a) for a in spec])
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, stripped))
+
+    return jax.tree_util.tree_map_with_path(constrain, params)
+
+
+def unshard_for_decode(params: Dict, mesh: Optional[Mesh], axis: str = "pp") -> Dict:
+    """The sampler-side gate for `unshard_axis`: no-op unless the mesh
+    carries a real pp axis. Both samplers (models/generation.py and
+    models/seq2seq.py:generate_seq2seq) share this so the decode-unshard
+    condition can't drift between them."""
+    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+        return params
+    return unshard_axis(params, mesh, axis)
+
+
 def init_sharded_opt_state(mesh: Mesh, tx, params: Dict):
     """Initialize optimizer state with mu/nu sharded like their params.
 

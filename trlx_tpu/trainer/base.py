@@ -922,6 +922,16 @@ class TPUBaseTrainer(BaseRLTrainer):
 
     def learn(self):
         """The training loop (parity: reference learn() :518-651)."""
+        try:
+            return self._learn()
+        finally:
+            # rollout phases defer their stats behind an async device->host
+            # copy; flush even when learn() exits straight after a rollout
+            # (total_steps hit before the next train step, or an exception)
+            # so the final chunk's stats always reach the tracker
+            self._finish_rollout_stats()
+
+    def _learn(self):
         logger.info("Starting training")
         self.prepare_learning()
         self.iter_count = 0

@@ -23,6 +23,7 @@ from trlx_tpu.pipeline.offline_pipeline import ILQLRolloutStorage, tokenize_dial
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
 from trlx_tpu.utils import logging
+from trlx_tpu.ops.remat import resolve_remat
 
 logger = logging.get_logger(__name__)
 
@@ -182,7 +183,7 @@ class TPUILQLTrainer(TPUBaseTrainer):
         return mask
 
     def loss(self, params, batch):
-        remat = self.config.train.remat_policy != "none"
+        remat = resolve_remat(self.config.train.remat_policy)
         if self.seq2seq:
             logits, qvs = self.model.forward(
                 params, batch.input_ids, batch.attention_mask,

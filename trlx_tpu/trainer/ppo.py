@@ -44,6 +44,7 @@ from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
 from trlx_tpu.utils import Clock, infinite_loader, logging
+from trlx_tpu.ops.remat import resolve_remat
 
 logger = logging.get_logger(__name__)
 
@@ -184,7 +185,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             batch.values, batch.rewards, gamma=method.gamma, lam=method.lam
         )
         pad = self.generate_settings.pad_token_id
-        remat = self.config.train.remat_policy != "none"
+        remat = resolve_remat(self.config.train.remat_policy)
         if self.seq2seq:
             # query = encoder prompt; response = decoder ids (start token
             # + sampled tokens), parity: reference loss :146-173

@@ -26,6 +26,7 @@ from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
 from trlx_tpu.trainer.sft import sft_loss
 from trlx_tpu.utils import logging
+from trlx_tpu.ops.remat import resolve_remat
 
 logger = logging.get_logger(__name__)
 
@@ -78,7 +79,7 @@ class TPURFTTrainer(TPUBaseTrainer):
 
         out = self.model.forward(
             params, batch.input_ids, batch.attention_mask,
-            remat=self.config.train.remat_policy != "none",
+            remat=resolve_remat(self.config.train.remat_policy),
         )
         labels = jnp.where(batch.attention_mask > 0, batch.input_ids, -100)
         return sft_loss(out["logits"], labels)

@@ -20,6 +20,7 @@ from trlx_tpu.pipeline.offline_pipeline import DialogStore, tokenize_dialogue
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
 from trlx_tpu.utils import logging
+from trlx_tpu.ops.remat import resolve_remat
 
 logger = logging.get_logger(__name__)
 
@@ -59,7 +60,7 @@ class TPUSFTTrainer(TPUBaseTrainer):
     def loss(self, params, batch: SFTBatch):
         out = self.model.forward(
             params, batch.input_ids, batch.attention_mask,
-            remat=self.config.train.remat_policy != "none",
+            remat=resolve_remat(self.config.train.remat_policy),
         )
         return sft_loss(out["logits"], batch.labels)
 
