@@ -103,3 +103,128 @@ def test_kernel_cross_attention_shapes():
     out = flash_attention(q, k, v, mask, causal=False)
     ref = _attention_reference(q, k, v, mask, False, D**-0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("n_kv_head", [1, 2, 4])
+def test_kernel_gqa_matches_reference(n_kv_head):
+    """GQA: kv heads passed UNREPEATED ([B, Hkv, S, D]) match the
+    repeat-then-attend XLA reference, forward and backward, across
+    group sizes (Hkv=H is the MHA degenerate case)."""
+    B, H, T, D = 2, 4, 32, 16
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, n_kv_head, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, n_kv_head, T, D)), jnp.float32)
+    m = np.ones((B, T), np.int32)
+    m[0, :7] = 0
+    mask = jnp.asarray(m)
+
+    def loss_flash(q_, k_, v_):
+        return (flash_attention(q_, k_, v_, mask) * jnp.arange(D)).sum()
+
+    def loss_ref(q_, k_, v_):
+        return (
+            _attention_reference(q_, k_, v_, mask, True, D**-0.5) * jnp.arange(D)
+        ).sum()
+
+    out = flash_attention(q, k, v, mask)
+    ref = _attention_reference(q, k, v, mask, True, D**-0.5)
+    real = np.asarray(mask, bool)
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(out)[b, :, real[b]], np.asarray(ref)[b, :, real[b]],
+            atol=2e-5, rtol=2e-4,
+        )
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        assert a.shape == b.shape  # dk/dv stay at Hkv heads
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+
+def test_model_gqa_pallas_vs_xla():
+    """A GQA model config routes teacher-forced forwards through the
+    pallas kernel with unrepeated kv and matches the XLA path."""
+    kw = dict(vocab_size=64, hidden_size=32, n_layer=2, n_head=4,
+              n_kv_head=2, n_positions=64, pos_embed="rotary",
+              use_attn_bias=False, dtype=jnp.float32)
+    lm_x = TransformerLM(TransformerConfig(**kw))
+    lm_p = TransformerLM(TransformerConfig(attention_impl="pallas", **kw))
+    params = lm_x.init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    mask = jnp.ones((2, 16), jnp.int32).at[0, :4].set(0)
+    out_x = lm_x(params, ids, mask)["logits"]
+    out_p = lm_p(params, ids, mask)["logits"]
+    real = np.asarray(mask, bool)
+    np.testing.assert_allclose(
+        np.asarray(out_p)[real], np.asarray(out_x)[real], atol=2e-4, rtol=2e-3
+    )
+
+
+def test_generation_prefill_pallas_vs_xla():
+    """Rollout generation with attention_impl='pallas' routes the PREFILL
+    through the kernel (static cache offset 0) and greedy-decodes the
+    same tokens as the XLA path — the long-context rollout gap: an 8k
+    prompt prefill is a full-length attention pass."""
+    from trlx_tpu.models.generation import SamplerSettings, make_generate_fn
+
+    kw = dict(vocab_size=64, hidden_size=32, n_layer=2, n_head=4,
+              n_kv_head=2, n_positions=128, pos_embed="rotary",
+              use_attn_bias=False, dtype=jnp.float32)
+    lm_x = TransformerLM(TransformerConfig(**kw))
+    lm_p = TransformerLM(TransformerConfig(attention_impl="pallas", **kw))
+    params = lm_x.init(jax.random.PRNGKey(0))
+    settings = SamplerSettings(max_new_tokens=8, do_sample=False,
+                               eos_token_id=-1, pad_token_id=0)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    mask = jnp.ones((2, 16), jnp.int32).at[0, :5].set(0)  # left padding
+    rng = jax.random.PRNGKey(2)
+    out_x = make_generate_fn(lm_x, settings)(params, ids, mask, rng)
+    out_p = make_generate_fn(lm_p, settings)(params, ids, mask, rng)
+    np.testing.assert_array_equal(
+        np.asarray(out_x["sequences"]), np.asarray(out_p["sequences"])
+    )
+
+
+def test_generation_prefill_pallas_nonzero_offset():
+    """Adapter generation (kv-prefix / soft-prompt warm segments) prefills
+    at a NONZERO static cache offset — the only path where the kernels'
+    q_offset differs from both 0 and S-T, pinning their causal coordinate
+    arithmetic against the XLA path."""
+    from trlx_tpu.models.generation import SamplerSettings, generate
+
+    kw = dict(vocab_size=64, hidden_size=32, n_layer=2, n_head=4,
+              n_kv_head=2, n_positions=128, pos_embed="rotary",
+              use_attn_bias=False, dtype=jnp.float32)
+    lm_x = TransformerLM(TransformerConfig(**kw))
+    lm_p = TransformerLM(TransformerConfig(attention_impl="pallas", **kw))
+    params = lm_x.init(jax.random.PRNGKey(0))
+    settings = SamplerSettings(max_new_tokens=8, do_sample=False,
+                               eos_token_id=-1, pad_token_id=0)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    mask = jnp.ones((2, 16), jnp.int32).at[0, :5].set(0)
+    rng = jax.random.PRNGKey(2)
+    cfgp = lm_p.cfg
+    prefix = {
+        "k": jnp.asarray(
+            np.random.default_rng(5).normal(
+                size=(kw["n_layer"], 8, cfgp.n_kv_head, cfgp.head_dim)),
+            jnp.float32),
+        "v": jnp.asarray(
+            np.random.default_rng(6).normal(
+                size=(kw["n_layer"], 8, cfgp.n_kv_head, cfgp.head_dim)),
+            jnp.float32),
+    }
+    soft = jnp.asarray(
+        np.random.default_rng(7).normal(size=(8, kw["hidden_size"])), jnp.float32
+    )
+    for adapter in [dict(kv_prefix=prefix), dict(soft_prompt=soft)]:
+        out_x = jax.jit(
+            lambda p, i, m, r: generate(lm_x, p, i, m, r, settings, **adapter)
+        )(params, ids, mask, rng)
+        out_p = jax.jit(
+            lambda p, i, m, r: generate(lm_p, p, i, m, r, settings, **adapter)
+        )(params, ids, mask, rng)
+        np.testing.assert_array_equal(
+            np.asarray(out_x["sequences"]), np.asarray(out_p["sequences"])
+        )

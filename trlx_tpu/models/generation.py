@@ -181,7 +181,16 @@ def generate(
         n_virt = soft_prompt.shape[0]
     elif kv_prefix is not None:
         n_virt = kv_prefix["k"].shape[1]
+    # pallas only: round the cache up to 128 slots — Mosaic needs a
+    # 128-aligned cache length to lower the prefill's chunked loads (the
+    # pad slots stay masked below and decode never reaches them). The
+    # XLA path skips the pad: it would just inflate cache memory and
+    # every decode step's masked score width for nothing.
     total = n_virt + P + N
+    pad_slots = (
+        (-total) % 128 if model.cfg.attention_impl == "pallas" else 0
+    )
+    total += pad_slots
 
     # response slots count as attendable keys once written
     key_mask = jnp.concatenate(
@@ -189,6 +198,7 @@ def generate(
             jnp.ones((B, n_virt), jnp.int32),
             attention_mask.astype(jnp.int32),
             jnp.ones((B, N), jnp.int32),
+            jnp.zeros((B, pad_slots), jnp.int32),
         ],
         axis=1,
     )
@@ -210,6 +220,7 @@ def generate(
                 cache["v"], tiled(kv_prefix["v"]), 0, axis=2
             ),
             index=jnp.int32(n_virt),
+            static_index=n_virt,
         )
     elif soft_prompt is not None:
         warm = model(
@@ -218,7 +229,9 @@ def generate(
             cache=cache,
             prefix_embeds=soft_prompt,
         )
-        cache = warm["cache"]
+        # forwards drop the static index from the cache they return;
+        # re-attach it so the main prefill keeps the pallas path
+        cache = dict(warm["cache"], static_index=n_virt)
 
     # real positions (rope/wpe) run over non-pad tokens only, offset past
     # any virtual prefix (HF past-length semantics)

@@ -238,11 +238,6 @@ class Attention(nn.Module):
             new_kv = {"k": k_all, "v": v_all}
             k, v = k_all.astype(cfg.dtype), v_all.astype(cfg.dtype)
 
-        if Hkv != H:  # grouped-query: repeat kv heads
-            rep = H // Hkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-
         # the pallas kernel bakes in 1/sqrt(D) scaling and a plain
         # causal+padding mask; architectures with nonstandard scaling or
         # extra additive biases (alibi, local windows) take the XLA path
@@ -251,6 +246,41 @@ class Attention(nn.Module):
             and cfg.pos_embed != "alibi"
             and cfg.local_window is None
         )
+        # prefill (cache present, T>1) can use the pallas kernel when the
+        # cache carries a STATIC write index (a Python int placed by
+        # init_cache/generate; a cache crossing a jit boundary turns it
+        # into a tracer and this cleanly falls back to XLA): queries sit
+        # at slots [static_index, static_index+T) against the full cache
+        # length. Decode steps (T=1) stay XLA — they're memory-bound.
+        # Mosaic lowers the kernels' dynamic chunk loads only at aligned
+        # offsets: cache length (lane dim of the mask load, chunked at
+        # >=128 when 128 | S) and query length (sublane q blocks, 8-row
+        # granularity). generate() rounds its cache to 128 slots so real
+        # rollouts always qualify; unaligned callers fall back to XLA.
+        prefill_offset = None
+        if (
+            cache is not None
+            and T > 1
+            and isinstance(cache.get("static_index"), int)
+            and cache["k"].shape[1] % 128 == 0
+            and T % 8 == 0
+        ):
+            prefill_offset = cache["static_index"]
+        use_pallas = (
+            cfg.attention_impl == "pallas"
+            and ring_mesh is None
+            and key_mask is not None
+            and plain_bias
+            and (cache is None or prefill_offset is not None)
+        )
+        if Hkv != H and not use_pallas:
+            # grouped-query on the XLA/ring paths: repeat kv heads (the
+            # pallas kernel handles GQA natively and must NOT see
+            # repeated kv — that would forfeit its grouped HBM reads)
+            rep = H // Hkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
         if ring_mesh is not None:
             # sequence-parallel path: K/V rotate around the `sp` ring via
             # ppermute while each shard accumulates its queries' attention
@@ -261,12 +291,7 @@ class Attention(nn.Module):
             out = ring_attention_sharded(
                 q, k, v, ring_mesh, segment_mask=key_mask, causal=True
             )
-        elif (
-            cfg.attention_impl == "pallas"
-            and cache is None
-            and key_mask is not None
-            and plain_bias
-        ):
+        elif use_pallas:
             from trlx_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(
@@ -274,6 +299,7 @@ class Attention(nn.Module):
                 k.transpose(0, 2, 1, 3),
                 v.transpose(0, 2, 1, 3),
                 key_mask,
+                q_offset=prefill_offset,
             ).transpose(0, 2, 1, 3)
         else:
             scale = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / math.sqrt(D)
@@ -657,9 +683,11 @@ class TransformerLM:
             bias = attn_bias
             if flags is not None:
                 bias = bias + layer["flag"] * local_bias
-            layer_cache = (
-                dict(layer["kv"], index=cache["index"]) if cache is not None else None
-            )
+            layer_cache = None
+            if cache is not None:
+                layer_cache = dict(layer["kv"], index=cache["index"])
+                if "static_index" in cache:  # pallas prefill offset
+                    layer_cache["static_index"] = cache["static_index"]
             out, new_kv = self.block.apply(
                 {"params": lp}, hidden, bias, positions, layer_cache, key_mask,
                 ring_mesh,
@@ -747,6 +775,7 @@ class TransformerLM:
                     jnp.zeros(shape, self.cfg.dtype), tiled(kv_prefix["v"]), 0, axis=2
                 ),
                 "index": jnp.int32(n),
+                "static_index": n,
                 "key_mask": jnp.concatenate(
                     [jnp.ones((B, n), jnp.int32), attention_mask], axis=1
                 ),
@@ -798,7 +827,7 @@ class TransformerLM:
         else:
             h, new_cache = self._scan_blocks(
                 params["blocks"], h, bias, positions, layer_cache, remat=remat,
-                key_mask=None if cache is not None else attention_mask,
+                key_mask=key_mask if cache is not None else attention_mask,
                 local_bias=local_bias,
                 ring_mesh=None if cache is not None else ring,
             )
@@ -983,13 +1012,21 @@ class TransformerLM:
     # -- cache -----------------------------------------------------------
 
     def init_cache(self, batch: int, max_len: int, key_mask: Optional[Array] = None) -> Dict:
-        """Preallocate a static-shape KV cache [L, B, S, Hkv, D]."""
+        """Preallocate a static-shape KV cache [L, B, S, Hkv, D].
+
+        `static_index` mirrors `index` as a PYTHON int while the cache
+        stays inside one trace: it lets the first forward (prefill, T>1)
+        take the pallas kernel at a static slot offset. Forwards drop it
+        from the cache they return (decode loops carry arrays only), and
+        a cache that crosses a jit boundary loses its int-ness — both
+        cases just fall back to the XLA path."""
         cfg = self.cfg
         shape = (cfg.n_layer, batch, max_len, cfg.n_kv_head, cfg.head_dim)
         return {
             "k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),
             "index": jnp.int32(0),
+            "static_index": 0,
             "key_mask": key_mask if key_mask is not None
             else jnp.ones((batch, max_len), jnp.int32),
         }

@@ -52,7 +52,13 @@ def _interpret() -> bool:
 
 
 def _attention_reference(q, k, v, key_mask, causal: bool, sm_scale: float):
-    """Plain XLA attention (numerics oracle for tests)."""
+    """Plain XLA attention (numerics oracle for tests). Accepts GQA
+    shapes (k/v with fewer heads) by repeating kv heads — the same thing
+    transformer.py's XLA path does."""
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * sm_scale
     T, S = s.shape[-2], s.shape[-1]
@@ -120,9 +126,26 @@ def _flash_kernel(
     l_ref[0] = l
 
 
-def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, with_stats=False):
+def _kv_head_index(H: int, Hkv: int):
+    """Grid-id -> kv row map for [B*Hkv, S, D] k/v arrays when the grid
+    runs over B*H query heads: query head h reads kv head h // (H//Hkv)
+    (grouped-query attention; identity when Hkv == H)."""
+    rep = H // Hkv
+
+    def ix(bh, qi):
+        return ((bh // H) * Hkv + (bh % H) // rep, 0, 0)
+
+    return ix
+
+
+def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q,
+                   with_stats=False, q_offset=None):
     B, H, T, D = q.shape
-    S = k.shape[2]
+    Hkv, S = k.shape[1], k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"n_head={H} not a multiple of n_kv_head={Hkv}")
+    if q_offset is None:
+        q_offset = S - T  # right-aligned queries (teacher-forced default)
     if key_mask is None:
         key_mask = jnp.ones((B, S), jnp.int32)
     bq = _pick_block(T, block_q)
@@ -130,11 +153,16 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, with_stats=Fals
     grid = (B * H, T // bq)
 
     qr = q.reshape(B * H, T, D)
-    kr = k.reshape(B * H, S, D)
-    vr = v.reshape(B * H, S, D)
+    # GQA: k/v stay at Hkv heads — never materialized repeated; the
+    # BlockSpec index map routes each q head's grid cells to its group's
+    # kv rows, so HBM reads per kv head happen once per GROUP, which is
+    # the bandwidth saving GQA exists for
+    kr = k.reshape(B * Hkv, S, D)
+    vr = v.reshape(B * Hkv, S, D)
+    kv_ix = _kv_head_index(H, Hkv)
 
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, causal=causal, q_offset=S - T,
+        _flash_kernel, sm_scale=sm_scale, causal=causal, q_offset=q_offset,
         n_chunks=S // ck, ck=ck,
     )
     out, m, l = pl.pallas_call(
@@ -142,8 +170,8 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, with_stats=Fals
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), kv_ix),
+            pl.BlockSpec((1, S, D), kv_ix),
             # [B, 1, S] so the block's trailing two dims (1, S) equal the
             # array dims — Mosaic requires trailing block dims divisible
             # by (8, 128) OR equal to the array's (a bare (1, S) block
@@ -209,12 +237,21 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, mask_ref, do_ref, m_ref, l_ref, delta_ref, dk_ref, dv_ref,
-    *, sm_scale, causal, q_offset, n_chunks, cq,
+    *, sm_scale, causal, q_offset, n_chunks, cq, q_chunks_per_head,
 ):
     """dk/dv for one key block. Works in TRANSPOSED orientation
     ([Bk, cq] score tiles) so the per-row stats stream in lane-major
     [1, T] layout — a [T, 1] operand would be lane-padded to [T, 128]
-    in VMEM (4 MB per stat at 8k tokens), which blows the budget."""
+    in VMEM (4 MB per stat at 8k tokens), which blows the budget.
+
+    GQA: the grid runs over B*Hkv and the q/do/stat refs carry the whole
+    GROUP's rows ([rep*T] where rep = n_head // n_kv_head, heads
+    contiguous), so each group member's contribution accumulates into
+    the same (dk, dv) — the sum-over-group that jnp.repeat's transpose
+    would otherwise do as a separate XLA pass. The chunk loop walks all
+    rep*T rows; a row's causal position is its index within its own
+    head, recovered per chunk as (j % q_chunks_per_head) * cq since cq
+    divides T (chunks never straddle heads)."""
     bk = k_ref.shape[1]
     D = k_ref.shape[2]
     k = k_ref[0].astype(jnp.float32)  # [Bk, D]
@@ -233,7 +270,8 @@ def _dkv_kernel(
             k, q_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [Bk, cq]
         rows = col0 + jax.lax.broadcasted_iota(jnp.int32, (bk, cq), 0)  # key idx
-        cols = j * cq + q_offset + jax.lax.broadcasted_iota(jnp.int32, (bk, cq), 1)
+        pos0 = (j % q_chunks_per_head) * cq  # q position within its head
+        cols = pos0 + q_offset + jax.lax.broadcasted_iota(jnp.int32, (bk, cq), 1)
         valid = (cols >= rows) if causal else jnp.ones((bk, cq), jnp.bool_)
         valid = valid & (mk[:, None] > 0)
         s_t = jnp.where(valid, s_t, NEG_INF)
@@ -256,16 +294,21 @@ def _dkv_kernel(
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, key_mask, o, m, l, g, causal, sm_scale, block_q):
+def _flash_backward(q, k, v, key_mask, o, m, l, g, causal, sm_scale, block_q,
+                    q_offset=None):
     B, H, T, D = q.shape
-    S = k.shape[2]
+    Hkv, S = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    if q_offset is None:
+        q_offset = S - T
     if key_mask is None:
         key_mask = jnp.ones((B, S), jnp.int32)
     mask3 = key_mask.astype(jnp.int32)[:, None, :]
 
     qr = q.reshape(B * H, T, D)
-    kr = k.reshape(B * H, S, D)
-    vr = v.reshape(B * H, S, D)
+    kr = k.reshape(B * Hkv, S, D)
+    vr = v.reshape(B * Hkv, S, D)
+    kv_ix = _kv_head_index(H, Hkv)
     dor = g.reshape(B * H, T, D)
     # delta_i = rowsum(dO_i * O_i): tiny elementwise pass, fine in XLA
     delta = jnp.sum(
@@ -277,14 +320,14 @@ def _flash_backward(q, k, v, key_mask, o, m, l, g, causal, sm_scale, block_q):
     ck = _pick_block(S, CHUNK)
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, sm_scale=sm_scale, causal=causal, q_offset=S - T,
+            _dq_kernel, sm_scale=sm_scale, causal=causal, q_offset=q_offset,
             n_chunks=S // ck, ck=ck,
         ),
         grid=(B * H, T // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), kv_ix),
+            pl.BlockSpec((1, S, D), kv_ix),
             pl.BlockSpec((1, 1, S), lambda bh, qi: (bh // H, 0, 0)),
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
@@ -298,71 +341,88 @@ def _flash_backward(q, k, v, key_mask, o, m, l, g, causal, sm_scale, block_q):
 
     bk = _pick_block(S, block_q)
     cq = _pick_block(T, CHUNK)
+    # GQA: one dkv grid row per KV head; the group's q/do/stat rows are
+    # flattened head-major ([B, Hkv, rep, T, ...] -> [B*Hkv, rep*T, ...])
+    # so the kernel's chunk loop accumulates the whole group into its kv
+    # head's (dk, dv) — no repeated kv materialization, no XLA reduce
+    qg = q.reshape(B * Hkv, rep * T, D)
+    dog = g.reshape(B * Hkv, rep * T, D)
     # lane-major stat views for the dkv kernel (see its docstring)
-    m_t = m.reshape(B * H, 1, T)
-    l_t = l.reshape(B * H, 1, T)
-    delta_t = delta.reshape(B * H, 1, T)
+    m_t = m.reshape(B * Hkv, 1, rep * T)
+    l_t = l.reshape(B * Hkv, 1, rep * T)
+    delta_t = delta.reshape(B * Hkv, 1, rep * T)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, sm_scale=sm_scale, causal=causal, q_offset=S - T,
-            n_chunks=T // cq, cq=cq,
+            _dkv_kernel, sm_scale=sm_scale, causal=causal, q_offset=q_offset,
+            n_chunks=rep * T // cq, cq=cq, q_chunks_per_head=T // cq,
         ),
-        grid=(B * H, S // bk),
+        grid=(B * Hkv, S // bk),
         in_specs=[
-            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, rep * T, D), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, ki: (bh // H, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki: (bh // Hkv, 0, 0)),
+            pl.BlockSpec((1, rep * T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, rep * T), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, rep * T), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, rep * T), lambda bh, ki: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, bk, D), lambda bh, ki: (bh, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, S, D), v.dtype),
         ],
         interpret=_interpret(),
-    )(qr, kr, vr, mask3, dor, m_t, l_t, delta_t)
+    )(qg, kr, vr, mask3, dog, m_t, l_t, delta_t)
 
     return (
         dq.reshape(B, H, T, D),
-        dk.reshape(B, H, S, D),
-        dv.reshape(B, H, S, D),
+        dk.reshape(B, Hkv, S, D),
+        dv.reshape(B, Hkv, S, D),
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def flash_attention(q, k, v, key_mask, causal=True, sm_scale=None, block_q=256):
-    """Fused attention. q/k/v: [B, H, T|S, D]; key_mask: [B, S] (1=real).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention(q, k, v, key_mask, causal=True, sm_scale=None, block_q=256,
+                    q_offset=None):
+    """Fused attention. q: [B, H, T, D]; k/v: [B, Hkv, S, D] with
+    Hkv | H (grouped-query attention — pass kv heads UNREPEATED, the
+    kernels route each q head to its group's kv rows and accumulate the
+    group's dk/dv natively); key_mask: [B, S] (1=real).
 
-    Causality compares PHYSICAL slots with queries right-aligned against
-    keys (q_offset = S - T), matching the transformer's slot semantics.
+    Causality compares PHYSICAL slots. `q_offset` (STATIC int) is the
+    slot of query row 0; the default None means right-aligned queries
+    (q_offset = S - T, the teacher-forced / hydra-branch layout). A
+    KV-cache PREFILL passes its static write index instead: queries
+    occupy slots [q_offset, q_offset + T) against the full cache length
+    S, with unwritten future slots excluded via key_mask.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q)
+    return _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q,
+                          q_offset=q_offset)
 
 
-def _fwd(q, k, v, key_mask, causal, sm_scale, block_q):
+def _fwd(q, k, v, key_mask, causal, sm_scale, block_q, q_offset):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     out, m, l = _flash_forward(
-        q, k, v, key_mask, causal, sm_scale, block_q, with_stats=True
+        q, k, v, key_mask, causal, sm_scale, block_q, with_stats=True,
+        q_offset=q_offset,
     )
     return out, (q, k, v, key_mask, out, m, l)
 
 
-def _bwd(causal, sm_scale, block_q, res, g):
+def _bwd(causal, sm_scale, block_q, q_offset, res, g):
     q, k, v, key_mask, o, m, l = res
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     dq, dk, dv = _flash_backward(
-        q, k, v, key_mask, o, m, l, g, causal, sm_scale, block_q
+        q, k, v, key_mask, o, m, l, g, causal, sm_scale, block_q,
+        q_offset=q_offset,
     )
     return dq, dk, dv, None
 
