@@ -1,0 +1,97 @@
+"""ILQL on flan-T5 over TL;DR comparison pairs (parity:
+/root/reference/examples/summarize_rlhf/ilql_summarize_t5.py).
+
+Offline RL on the human preference data directly: each comparison
+contributes its chosen summary with reward +1 and its rejected summary
+with reward -1 (the reference's `preprocess`), so no reward model is in
+the training loop — the trained stage-2 RM only scores eval samples
+through `metric_fn`, matching the reference's use of `rw_model` there.
+"""
+
+import os
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_ilql_config
+
+default_config = default_ilql_config().evolve(
+    train=dict(
+        seq_length=550,
+        batch_size=8,
+        epochs=100,
+        total_steps=5000,
+        checkpoint_interval=10000,
+        eval_interval=1000,
+        checkpoint_dir="ckpts/ilql_summarize_t5",
+        mesh={"dp": -1, "fsdp": 8, "tp": 1, "sp": 1},
+        compute_dtype="bfloat16",
+    ),
+    model=dict(
+        model_path="pvduy/flant5-xl_openai_tldr_sft",
+        num_layers_unfrozen=-1,
+        model_arch_type="seq2seq",
+    ),
+    tokenizer=dict(
+        tokenizer_path="pvduy/flant5-xl_openai_tldr_sft", truncation_side="left"
+    ),
+    optimizer=dict(
+        name="adamw",
+        kwargs=dict(lr=1e-6, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6),
+    ),
+    scheduler=dict(name="cosine_annealing", kwargs=dict(T_max=5000, eta_min=1e-6)),
+    method=dict(
+        tau=0.6,
+        gamma=0.99,
+        cql_scale=0.1,
+        awac_scale=1,
+        alpha=0.0001,
+        beta=0,
+        steps_for_target_q_sync=1,
+        two_qs=True,
+        gen_kwargs=dict(max_new_tokens=50, top_k=50, beta=1, temperature=1.0),
+    ),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+
+    from datasets import load_dataset
+
+    from examples.summarize_rlhf.ppo_summarize import make_rm_reward_fn
+
+    rm_score = make_rm_reward_fn(
+        os.environ.get("RM_DIR", "ckpts/reward_model"),
+        max_length=config.train.seq_length,
+    )
+
+    def metric_fn(samples, **kwargs):
+        return {"rewards": rm_score(samples).tolist()}
+
+    # chosen summaries carry reward +1, rejected -1 — offline preference
+    # data IS the dataset (ref ilql_summarize_t5.py preprocess)
+    dataset = load_dataset("CarperAI/openai_summarize_comparisons")
+    samples, rewards = [], []
+    for x in dataset["train"]:
+        prompt = x["prompt"] + " TL;DR:"
+        samples.append([prompt, x["chosen"][7:]])
+        rewards.append(1.0)
+        samples.append([prompt, x["rejected"][7:]])
+        rewards.append(-1.0)
+
+    val = load_dataset("CarperAI/openai_summarize_tldr", split="valid")
+    eval_prompts = list(val["prompt"])[:1000]
+
+    return trlx_tpu.train(
+        samples=samples,
+        rewards=rewards,
+        eval_prompts=eval_prompts,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    main({} if len(sys.argv) == 1 else json.loads(sys.argv[1]))

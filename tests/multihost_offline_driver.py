@@ -49,3 +49,40 @@ t2 = trlx_tpu.train(
     config=config2,
 )
 print(f"ILQL_MH_OK pid={pid} iter={t2.iter_count}", flush=True)
+
+# RFT: each process generates its strided prompt slice, the scored pool
+# is all-gathered before percentile selection (the analog of reference
+# accelerate_rft_trainer.py:127-144 all_gather_object), and threshold
+# math runs identically everywhere
+from trlx_tpu.data.default_configs import default_rft_config
+
+config3 = default_rft_config().evolve(
+    train=dict(batch_size=8, total_steps=2, tracker=None, seq_length=24,
+               checkpoint_interval=100, eval_interval=100, epochs=2,
+               checkpoint_dir=os.path.join(workdir, "rft_ckpts"), mesh={"dp": -1}),
+    model=dict(model_path="random",
+               model_extra_configs={"transformer": dict(hidden_size=16, n_layer=2, n_head=2, n_positions=64)}),
+    tokenizer=dict(tokenizer_path="byte"),
+    method=dict(n_generations_per_prompt=4, n_improve_steps=2,
+                start_percentile=0.5, end_percentile=0.9,
+                gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0,
+                                do_sample=True)),
+)
+prompts = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot",
+           "golf", "hotel"]
+
+
+def rft_reward_fn(samples, prompts, outputs, **kw):
+    return [float(len(o)) for o in outputs]
+
+
+t3 = trlx_tpu.train(reward_fn=rft_reward_fn, prompts=prompts, config=config3)
+# the pooled selection must have seen EVERY process's prompt slice: with
+# 8 prompts striped over 2 processes, a process that only pooled its own
+# generations would hold 4 prompts here, not 8
+n_pool = len(t3.generations_per_prompt)
+assert n_pool == len(prompts), (n_pool, sorted(t3.generations_per_prompt))
+leaf = jax.tree_util.tree_leaves(t3.params)[0]
+val = float(np.sum(np.abs(np.asarray(mh.allgather(leaf)))))
+print(f"RFT_MH_OK pid={pid} iter={t3.iter_count} pool={n_pool} paramsum={val:.6f}",
+      flush=True)

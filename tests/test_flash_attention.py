@@ -49,6 +49,7 @@ def test_kernel_gradients_flow():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_model_forward_parity_pallas_vs_xla():
     kw = dict(vocab_size=64, hidden_size=16, n_layer=2, n_head=2,
               n_positions=64, dtype=jnp.float32)
@@ -142,6 +143,7 @@ def test_kernel_gqa_matches_reference(n_kv_head):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
 
 
+@pytest.mark.slow
 def test_model_gqa_pallas_vs_xla():
     """A GQA model config routes teacher-forced forwards through the
     pallas kernel with unrepeated kv and matches the XLA path."""
@@ -186,6 +188,7 @@ def test_generation_prefill_pallas_vs_xla():
     )
 
 
+@pytest.mark.slow
 def test_generation_prefill_pallas_nonzero_offset():
     """Adapter generation (kv-prefix / soft-prompt warm segments) prefills
     at a NONZERO static cache offset — the only path where the kernels'

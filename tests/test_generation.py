@@ -27,6 +27,7 @@ def tiny_lm():
     return lm, params
 
 
+@pytest.mark.slow
 def test_greedy_matches_teacher_forced(tiny_lm):
     lm, params = tiny_lm
     B, P, N = 2, 6, 5

@@ -19,6 +19,7 @@ def tiny_cfg():
     )
 
 
+@pytest.mark.slow
 def test_multi_capture_matches_plain_forward(tiny_cfg):
     lm = TransformerLM(tiny_cfg)
     params = lm.init(jax.random.PRNGKey(0))
@@ -31,6 +32,7 @@ def test_multi_capture_matches_plain_forward(tiny_cfg):
     assert len(multi["captures"]) == 2
 
 
+@pytest.mark.slow
 def test_value_branch_forward_and_gradient(tiny_cfg):
     model = CausalLMWithValueHead(tiny_cfg, branch_at=2, value_branch_at=1)
     params = model.init_params(jax.random.PRNGKey(0))

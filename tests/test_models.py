@@ -84,6 +84,7 @@ ALL_ARCHS = [
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("model_type", ALL_ARCHS)
 def test_logit_parity_with_hf(model_type):
     hf, model, params = convert(model_type)
@@ -128,6 +129,7 @@ def test_hf_export_round_trip(model_type):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("model_type", ["gpt2", "llama", "bloom", "gpt_neo"])
 def test_kv_cache_matches_full_forward(model_type):
     _, model, params = convert(model_type)

@@ -88,6 +88,14 @@ def test_sft_ilql_two_processes(tmp_path):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
         assert f"SFT_MH_OK pid={pid}" in out
         assert f"ILQL_MH_OK pid={pid}" in out
+        # RFT: generation pooling gathered every process's slice (the
+        # driver asserts pool size) and selection/threshold math agreed
+        assert f"RFT_MH_OK pid={pid}" in out
+    rft_lines = sorted(
+        line for out in outs for line in out.splitlines() if "RFT_MH_OK" in line
+    )
+    sums = {line.split("paramsum=")[1] for line in rft_lines}
+    assert len(sums) == 1, rft_lines
 
 
 @pytest.mark.slow

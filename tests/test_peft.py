@@ -201,6 +201,7 @@ def test_prefix_tuning_matches_cached_continuation(base_params):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("peft_type", ["PROMPT_TUNING", "PREFIX_TUNING"])
 def test_virtual_token_generation_consistency(base_params, peft_type):
     # greedy generation with an adapter must equal greedy teacher-forcing

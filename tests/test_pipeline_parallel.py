@@ -42,6 +42,7 @@ def padded_batch(B=8, T=16, vocab=97, pad=3):
     return ids, mask
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("axes", [{"pp": 2, "dp": 2, "tp": 2}, {"pp": 4, "dp": 2}])
 def test_pp_forward_matches_sequential(axes):
     cfg = tiny_cfg()
@@ -108,6 +109,7 @@ def test_pp_multi_capture_parity():
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("remat", [False, True])
 def test_pp_grad_parity(remat):
     cfg = tiny_cfg()
@@ -399,6 +401,7 @@ def test_data_group_info(monkeypatch):
         mh.data_group_info(m)
 
 
+@pytest.mark.slow
 def test_pp_t5_forward_parity():
     """Encoder and decoder stacks of the seq2seq (T5) family pipeline
     over pp with identical teacher-forced outputs, including the hydra
@@ -450,6 +453,7 @@ def test_pp_t5_forward_parity():
     )
 
 
+@pytest.mark.slow
 def test_pp_t5_bf16_grad_compiles():
     """bf16 ctx leaves (T5 encoder_hidden) cross the shard_map boundary:
     their cotangent psum must not hit the XLA CPU bf16 AllReducePromotion

@@ -49,6 +49,7 @@ def test_resolve_remat():
         resolve_remat("selective")  # NeMo's name, not ours — must be loud
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("policy", POLICIES)
 def test_causal_grad_parity_across_policies(policy):
     lm, params, ids, mask = _tiny_lm()
@@ -75,6 +76,7 @@ def test_wrap_remat_none_is_identity():
     assert wrap_remat(fn, "none") is fn
 
 
+@pytest.mark.slow
 def test_seq2seq_grad_parity_across_policies():
     from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM
 

@@ -123,6 +123,7 @@ def test_model_forward_ring_matches_xla():
     assert float(jnp.where(mask > 0, diff, 0.0).max()) < 1e-4
 
 
+@pytest.mark.slow
 def test_model_grads_ring_match_xla():
     cfg = TransformerConfig(**TINY)
     lm = TransformerLM(cfg)

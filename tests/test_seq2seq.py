@@ -52,6 +52,7 @@ def test_t5_logit_parity(ff, tie):
     np.testing.assert_allclose(np.asarray(out["logits"]), ref, atol=2e-3, rtol=2e-2)
 
 
+@pytest.mark.slow
 def test_t5_greedy_decode_matches_teacher_forced():
     hf_model = tiny_t5().eval()
     cfg = seq2seq_config_from_hf(hf_model.config, dtype=jnp.float32)
@@ -112,6 +113,7 @@ def test_t5_hf_export_roundtrip(ff, tie, tmp_path):
     np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_t5_lora_targets_and_merge():
     # seq2seq LoRA: overlays land on self/cross attention kernels of both
     # stacks and change the forward once B != 0

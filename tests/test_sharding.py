@@ -52,6 +52,7 @@ def test_param_pspec_rules():
     assert specs["ln_f"]["scale"] == P()
 
 
+@pytest.mark.slow
 def test_shard_params_places_and_computes():
     cfg = TransformerConfig(
         vocab_size=64, hidden_size=32, n_layer=2, n_head=2, n_positions=32,
@@ -227,6 +228,7 @@ def test_unshard_for_decode_greedy_parity():
     )
 
 
+@pytest.mark.slow
 def test_seq2seq_unshard_for_decode_greedy_parity():
     """Seq2seq decode on a pp mesh unshards ONLY the decoder subtree
     (the encoder stays pp-sharded for the pipelined encode) and still

@@ -32,6 +32,25 @@ class Q8:
     shape: tuple = flax.struct.field(pytree_node=False)  # original (static)
 
 
+def _quant_blocks(blocks: jnp.ndarray):
+    """[n, BLOCK] fp32 -> (int8 payload, fp32 per-block absmax)."""
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    norm = jnp.abs(blocks) / jnp.maximum(scale, 1e-30)
+    q = jnp.round(jnp.sign(blocks) * jnp.sqrt(norm) * 127.0)
+    return q.astype(jnp.int8), scale[:, 0]
+
+
+def _deq_blocks(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    u = q.astype(jnp.float32) / 127.0
+    return jnp.sign(u) * u * u * scale[:, None]
+
+
+def _to_blocks(x: jnp.ndarray) -> jnp.ndarray:
+    flat = x.reshape(-1)
+    pad = (-flat.size) % BLOCK
+    return jnp.pad(flat, (0, pad)).reshape(-1, BLOCK)
+
+
 def _quantize(x: jnp.ndarray) -> Q8:
     """Blockwise companded int8: q = sign * 127 * sqrt(|x| / absmax).
 
@@ -40,21 +59,12 @@ def _quantize(x: jnp.ndarray) -> Q8:
     block, and a LINEAR absmax code wipes out the small entries, which
     visibly corrupts the update direction (sqrt(vhat) sits in the
     denominator)."""
-    shape = x.shape
-    flat = x.astype(jnp.float32).reshape(-1)
-    pad = (-flat.size) % BLOCK
-    flat = jnp.pad(flat, (0, pad))
-    blocks = flat.reshape(-1, BLOCK)
-    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
-    norm = jnp.abs(blocks) / jnp.maximum(scale, 1e-30)
-    q = jnp.round(jnp.sign(blocks) * jnp.sqrt(norm) * 127.0)
-    return Q8(q.astype(jnp.int8), scale[:, 0], shape)
+    q, scale = _quant_blocks(_to_blocks(x.astype(jnp.float32)))
+    return Q8(q, scale, x.shape)
 
 
 def _dequantize(s: Q8) -> jnp.ndarray:
-    u = s.q.astype(jnp.float32) / 127.0
-    blocks = jnp.sign(u) * u * u * s.scale[:, None]
-    flat = blocks.reshape(-1)
+    flat = _deq_blocks(s.q, s.scale).reshape(-1)
     n = 1
     for d in s.shape:
         n *= d
@@ -67,26 +77,42 @@ class Adam8bitState(NamedTuple):
     v: optax.Params  # tree of Q8
 
 
+def _init_adam8bit_state(params) -> Adam8bitState:
+    # m and v must be INDEPENDENT buffers: sharing one quantized-zeros
+    # tree between them makes a donated state donate each buffer twice
+    # (Execute() rejects `f(donate(a), donate(a))`)
+    def zeros(p):
+        return _quantize(jnp.zeros(p.shape, jnp.float32))
+
+    return Adam8bitState(
+        count=jnp.zeros([], jnp.int32),
+        m=jax.tree_util.tree_map(zeros, params),
+        v=jax.tree_util.tree_map(zeros, params),
+    )
+
+
 def scale_by_adam_8bit(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
     """optax transformation holding both Adam moments in blockwise int8."""
 
-    def init(params):
-        zeros = jax.tree_util.tree_map(
-            lambda p: _quantize(jnp.zeros(p.shape, jnp.float32)), params
-        )
-        return Adam8bitState(count=jnp.zeros([], jnp.int32), m=zeros, v=zeros)
+    init = _init_adam8bit_state
 
     def update(updates, state, params=None):
         count = state.count + 1
 
         def one(g, mq, vq):
+            out_dtype = g.dtype
             g = g.astype(jnp.float32)
             m = b1 * _dequantize(mq) + (1 - b1) * g
             v = b2 * _dequantize(vq) + (1 - b2) * g * g
             mhat = m / (1 - b1 ** count.astype(jnp.float32))
             vhat = v / (1 - b2 ** count.astype(jnp.float32))
             step = mhat / (jnp.sqrt(vhat) + eps)
-            return step, _quantize(m), _quantize(v)
+            # emit the step in the grad's dtype: moment math stays fp32,
+            # but a bf16-grad caller (memory-tight large models) gets a
+            # bf16 updates tree — the step is O(1)-scaled, so bf16's
+            # ~0.4% relative error is noise next to int8 moment states,
+            # and optax.apply_updates promotes back to fp32 params
+            return step.astype(out_dtype), _quantize(m), _quantize(v)
 
         flat_u, tdef = jax.tree_util.tree_flatten(updates)
         flat_m = tdef.flatten_up_to(state.m)
@@ -110,3 +136,152 @@ def adamw_8bit(
         chain.append(optax.add_decayed_weights(weight_decay))
     chain.append(optax.scale_by_learning_rate(learning_rate))
     return optax.chain(*chain)
+
+
+# elements of fp32 temporaries the fused apply allows live per leaf:
+# 2^22 * 4 B = 16 MB per array, a handful of arrays in flight
+_FUSED_CHUNK_ELEMS = 1 << 22
+
+
+def fused_adamw_8bit_update(
+    params,
+    grads,
+    state: Adam8bitState,
+    learning_rate: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+):
+    """One fused AdamW step over int8 moments: returns (new_params,
+    new_state) directly, never materializing an fp32 moment OR updates
+    tree — the dequantize -> moment update -> requantize -> parameter
+    apply chain streams through a `lax.scan` over block chunks per leaf.
+
+    This is what bitsandbytes' fused CUDA kernel does (the reference's
+    `adamw_8bit_bnb` row, ref trlx/utils/__init__.py:104-123): the
+    optax-style `scale_by_adam_8bit` keeps the standard updates-tree
+    contract, but at billion-parameter scale the fp32 temporaries of
+    that contract (moments + updates, ~3 full fp32 copies in flight)
+    are exactly what doesn't fit next to fp32 master params on a 16 GB
+    chip. Donate params+state into the jit that calls this and the whole
+    optimizer phase runs in O(chunk) extra memory.
+
+    `grads` may be lower precision (bf16): moment math runs fp32 per
+    chunk regardless, and the apply writes fp32 master params.
+    """
+    count = state.count + 1
+    c = count.astype(jnp.float32)
+    bc1 = 1 - b1 ** c
+    bc2 = 1 - b2 ** c
+    lr = jnp.asarray(learning_rate, jnp.float32)
+
+    def one(p, g, mq, vq):
+        shape, size, dtype = p.shape, p.size, p.dtype
+        pb = _to_blocks(p)
+        gb = _to_blocks(g)
+        nb = pb.shape[0]
+        # pad the block count up to a whole number of target-size chunks
+        # (an exact-divisor search can collapse to huge chunks — e.g. a
+        # prime block count would force ONE full-leaf fp32 chunk, which
+        # defeats the O(chunk) memory bound this function exists for);
+        # the pad rows quantize zeros and are sliced off below
+        cb = max(1, _FUSED_CHUNK_ELEMS // BLOCK)
+        n_chunks = -(-nb // cb)
+        pad_rows = n_chunks * cb - nb
+
+        def padb(x):
+            if not pad_rows:
+                return x
+            widths = ((0, pad_rows),) + ((0, 0),) * (x.ndim - 1)
+            return jnp.pad(x, widths)
+
+        pb, gb = padb(pb), padb(gb)
+        mq_q, mq_s = padb(mq.q), padb(mq.scale)
+        vq_q, vq_s = padb(vq.q), padb(vq.scale)
+
+        def body(_, xs):
+            p_c, g_c, mq_c, ms_c, vq_c, vs_c = xs
+            g32 = g_c.astype(jnp.float32)
+            m = b1 * _deq_blocks(mq_c, ms_c) + (1 - b1) * g32
+            v = b2 * _deq_blocks(vq_c, vs_c) + (1 - b2) * g32 * g32
+            step = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            p32 = p_c.astype(jnp.float32)
+            if weight_decay:
+                step = step + weight_decay * p32
+            new_p = (p32 - lr * step).astype(dtype)
+            nmq, nms = _quant_blocks(m)
+            nvq, nvs = _quant_blocks(v)
+            return None, (new_p, nmq, nms, nvq, nvs)
+
+        chunk = lambda x: x.reshape((n_chunks, cb) + x.shape[1:])
+        _, (new_p, nmq, nms, nvq, nvs) = jax.lax.scan(
+            body,
+            None,
+            (
+                chunk(pb), chunk(gb), chunk(mq_q), chunk(mq_s),
+                chunk(vq_q), chunk(vq_s),
+            ),
+        )
+        new_p = new_p.reshape(-1)[:size].reshape(shape)
+        # strip the chunk-pad rows so state shapes match init's exactly
+        return (
+            new_p,
+            Q8(nmq.reshape(-1, BLOCK)[:nb], nms.reshape(-1)[:nb], shape),
+            Q8(nvq.reshape(-1, BLOCK)[:nb], nvs.reshape(-1)[:nb], shape),
+        )
+
+    flat_p, tdef = jax.tree_util.tree_flatten(params)
+    flat_g = tdef.flatten_up_to(grads)
+    flat_m = tdef.flatten_up_to(state.m)
+    flat_v = tdef.flatten_up_to(state.v)
+    out = [one(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    return (
+        tdef.unflatten([o[0] for o in out]),
+        Adam8bitState(
+            count=count,
+            m=tdef.unflatten([o[1] for o in out]),
+            v=tdef.unflatten([o[2] for o in out]),
+        ),
+    )
+
+
+class FusedAdamW8bit:
+    """Registry-wirable fused variant: holds the AdamW hyperparameters
+    and exposes `init` (optax-shaped, so `init_sharded_opt_state` and
+    state checkpointing work unchanged) plus `fused_apply(params, grads,
+    state) -> (new_params, new_state)`, which the trainers' step uses
+    instead of the update/apply_updates pair whenever present.
+
+    Select with `optimizer.name: adamw_8bit_fused` in a TRLConfig — the
+    memory-tight large-model recipe (docs/benchmarks.md) reachable from
+    config, not just hand-rolled steps. `learning_rate` may be an optax
+    schedule; it is evaluated at the pre-increment step count, matching
+    `optax.scale_by_learning_rate`'s cadence.
+    """
+
+    def __init__(self, learning_rate, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8, weight_decay: float = 0.0):
+        self.learning_rate = learning_rate
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self.weight_decay = weight_decay
+
+    def init(self, params) -> Adam8bitState:
+        return _init_adam8bit_state(params)
+
+    def update(self, grads, state, params=None):
+        raise NotImplementedError(
+            "FusedAdamW8bit writes params directly; call fused_apply "
+            "(the trainers do this automatically)"
+        )
+
+    def fused_apply(self, params, grads, state: Adam8bitState):
+        lr = (
+            self.learning_rate(state.count)
+            if callable(self.learning_rate)
+            else self.learning_rate
+        )
+        return fused_adamw_8bit_update(
+            params, grads, state, lr, b1=self.b1, b2=self.b2, eps=self.eps,
+            weight_decay=self.weight_decay,
+        )

@@ -103,9 +103,13 @@ class TPUBaseTrainer(BaseRLTrainer):
             self._lm().mesh = self.mesh
 
         tx, self.schedule = build_optimizer(config.optimizer, config.scheduler)
-        mask = self.trainable_mask()
-        if mask is not None:
-            tx = optax.chain(tx, _mask_updates(mask))
+        self._update_mask = self.trainable_mask()
+        if hasattr(tx, "fused_apply"):
+            # fused optimizers write params directly (no updates tree to
+            # chain a mask into); _step_update blends frozen leaves back
+            pass
+        elif self._update_mask is not None:
+            tx = optax.chain(tx, _mask_updates(self._update_mask))
         self.tx = tx
         with self.mesh:
             self.opt_state = init_sharded_opt_state(self.mesh, self.tx, self.params)
@@ -718,8 +722,18 @@ class TPUBaseTrainer(BaseRLTrainer):
             loss = l_sum / num_mb
             stats = jax.tree_util.tree_map(lambda x: x / num_mb, s_sum)
 
-        updates, new_opt_state = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        if hasattr(tx, "fused_apply"):
+            new_params, new_opt_state = tx.fused_apply(params, grads, opt_state)
+            if self._update_mask is not None:
+                # freeze = keep the old value on masked-out leaves (the
+                # updates-tree path chains _mask_updates instead)
+                new_params = jax.tree_util.tree_map(
+                    lambda p, np_, m: p + m * (np_ - p),
+                    params, new_params, self._update_mask,
+                )
+        else:
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
         return new_params, new_opt_state, loss, stats
 
     def _pinned_state_shardings(self):

@@ -80,6 +80,10 @@ class OptimizerName(str, Enum):
     ADAM = "adam"
     ADAMW = "adamw"
     ADAMW_8BIT_BNB = "adamw_8bit_bnb"  # first-party int8-state adamw (ops/adam8bit.py)
+    # fused apply variant: dequantize->update->requantize->param write
+    # streamed per block chunk, no fp32 moment/updates tree — the
+    # memory-tight large-model recipe (docs/benchmarks.md)
+    ADAMW_8BIT_FUSED = "adamw_8bit_fused"
     SGD = "sgd"
     LION = "lion"
 
@@ -115,6 +119,10 @@ def get_optimizer_class(name: str):
         from trlx_tpu.ops.adam8bit import adamw_8bit
 
         return _adamish(adamw_8bit)
+    if name == OptimizerName.ADAMW_8BIT_FUSED:
+        from trlx_tpu.ops.adam8bit import FusedAdamW8bit
+
+        return _adamish(FusedAdamW8bit)
     if name == OptimizerName.LION:
         def make_lion(lr, betas=(0.9, 0.99), weight_decay=0.0, **kw):
             return optax.lion(lr, b1=betas[0], b2=betas[1], weight_decay=weight_decay, **kw)
