@@ -508,3 +508,114 @@ def test_pp_prompt_tuning_parity():
             shard_params(mesh, params)
         )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule (parallel/pipeline.py:_run_1f1b)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_pp_1f1b_grad_parity_with_captures():
+    """pp_schedule='1f1b' (custom-VJP backward: recompute + cotangent
+    pipelines interleaved, O(pp) boundary liveness) produces the same
+    loss and grads as the sequential scan — including capture-point
+    cotangents (the hydra/value-branch fork inputs)."""
+    kw = dict(vocab_size=64, hidden_size=32, n_layer=4, n_head=2,
+              n_positions=32, dtype=jnp.float32, pp_microbatches=4)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 12), 0, 64)
+    mask = jnp.ones_like(ids)
+    lm_seq = TransformerLM(TransformerConfig(**kw))
+    params = lm_seq.init(jax.random.PRNGKey(0))
+
+    def loss_of(lm):
+        def loss(p):
+            out = lm.forward_with_multi_capture(p, ids, mask, points=(2,))
+            return jnp.mean(out["logits"] ** 2) + jnp.mean(out["captures"][0] ** 2)
+        return loss
+
+    l0, g0 = jax.value_and_grad(loss_of(lm_seq))(params)
+    mesh = make_mesh({"pp": 2, "dp": 2, "fsdp": 2})
+    lm = TransformerLM(TransformerConfig(pp_schedule="1f1b", **kw))
+    lm.mesh = mesh
+    with mesh:
+        l1, g1 = jax.jit(jax.value_and_grad(loss_of(lm)))(shard_params(mesh, params))
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        ),
+        g1, g0,
+    )
+
+
+@pytest.mark.slow
+def test_pp_1f1b_t5_grad_parity():
+    """Seq2seq under 1f1b: the encoder_hidden ctx cotangent (accumulated
+    per microbatch across stages, then psum-merged) matches sequential."""
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM
+
+    kw = dict(vocab_size=64, d_model=32, d_ff=64, n_layer=2,
+              n_decoder_layer=4, n_head=2, relative_attention_num_buckets=8,
+              dtype=jnp.float32, pp_microbatches=4)
+    enc = jax.random.randint(jax.random.PRNGKey(1), (8, 10), 0, 64)
+    dec = jax.random.randint(jax.random.PRNGKey(2), (8, 6), 0, 64)
+    m = jnp.ones_like(enc)
+    lm0 = T5LM(Seq2SeqConfig(**kw))
+    params = lm0.init(jax.random.PRNGKey(0))
+
+    def loss_of(lm):
+        return lambda p: jnp.mean(lm(p, enc, m, dec)["logits"] ** 2)
+
+    l0, g0 = jax.value_and_grad(loss_of(lm0))(params)
+    mesh = make_mesh({"pp": 2, "dp": 2, "fsdp": 2})
+    lm = T5LM(Seq2SeqConfig(pp_schedule="1f1b", **kw))
+    lm.mesh = mesh
+    with mesh:
+        l1, g1 = jax.jit(jax.value_and_grad(loss_of(lm)))(shard_params(mesh, params))
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=2e-6
+        ),
+        g1, g0,
+    )
+
+
+@pytest.mark.slow
+def test_pp_1f1b_memory_bound():
+    """The point of 1f1b: backward temp memory is bounded by O(pp)
+    rolling buffers, not O(M) stored tick boundaries. At M=16
+    microbatches the compiled temp footprint must be a small fraction of
+    no-remat GPipe's (measured ~12x on this geometry)."""
+    kw = dict(vocab_size=64, hidden_size=128, n_layer=4, n_head=4,
+              n_positions=128, dtype=jnp.float32, pp_microbatches=16)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (32, 128), 0, 64)
+    mask = jnp.ones_like(ids)
+    mesh = make_mesh({"pp": 2, "dp": 2, "fsdp": 2})
+    params = TransformerLM(TransformerConfig(**kw)).init(jax.random.PRNGKey(0))
+    temps = {}
+    for sched in ["gpipe", "1f1b"]:
+        lm = TransformerLM(TransformerConfig(pp_schedule=sched, **kw))
+        lm.mesh = mesh
+
+        def loss(p, lm=lm):
+            return jnp.mean(lm(p, ids, mask)["logits"] ** 2)
+
+        with mesh:
+            comp = jax.jit(jax.value_and_grad(loss)).lower(
+                shard_params(mesh, params)
+            ).compile()
+        temps[sched] = comp.memory_analysis().temp_size_in_bytes
+    assert temps["1f1b"] < 0.25 * temps["gpipe"], temps
+
+
+def test_pp_bad_schedule_is_loud():
+    from trlx_tpu.parallel.pipeline import pipelined_layers
+
+    mesh = make_mesh({"pp": 2})
+    with pytest.raises(ValueError, match="pp_schedule"):
+        pipelined_layers(
+            mesh, lambda l, h, c: h, {"w": jnp.zeros((2, 3))},
+            jnp.zeros((4, 8)), (), n_microbatch=2, schedule="interleaved",
+        )

@@ -51,6 +51,7 @@ class Seq2SeqConfig:
     # mesh has a pp axis > 1 (0 = one per stage); raise to shrink the
     # (pp-1)/(M+pp-1) bubble — mirrors TransformerConfig.pp_microbatches
     pp_microbatches: int = 0
+    pp_schedule: str = "gpipe"  # mirrors TransformerConfig.pp_schedule
 
     def __post_init__(self):
         if self.n_decoder_layer is None:
@@ -338,6 +339,7 @@ class T5LM:
             n_microbatch=n_microbatch,
             capture_points=capture_points,
             remat=remat,
+            schedule=self.cfg.pp_schedule,
         )
 
     def _logits(self, params: Dict, hidden: Array) -> Array:

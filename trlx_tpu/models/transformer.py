@@ -95,6 +95,11 @@ class TransformerConfig:
     # mesh has a pp axis > 1 (0 = one microbatch per pipeline stage).
     # The bubble fraction is (pp-1)/(M+pp-1); raise M to amortize it.
     pp_microbatches: int = 0
+    # "gpipe" (differentiate the forward scan; stores M+pp-1 boundary
+    # activations) | "1f1b" (custom-VJP backward interleaving recompute
+    # with the cotangent pipeline; O(pp) boundary liveness per stage,
+    # one extra forward — parallel/pipeline.py:_run_1f1b)
+    pp_schedule: str = "gpipe"
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -585,6 +590,7 @@ class TransformerLM:
             n_microbatch=n_microbatch,
             capture_points=capture_points,
             remat=remat,
+            schedule=cfg.pp_schedule,
         )
 
     # -- bias / embedding helpers ---------------------------------------

@@ -102,6 +102,22 @@ def _index_microbatch(tree, flags, m: Array):
     )
 
 
+def _partition_diff(tree):
+    """Split a pytree into (diff_leaves, aux_leaves, rebuild): inexact
+    leaves can carry gradients; integer leaves (layer indices, positions,
+    key masks) ride along as non-differentiable aux."""
+    leaves, tdef = jax.tree_util.tree_flatten(tree)
+    is_diff = [jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact) for l in leaves]
+    diff = [l for l, d in zip(leaves, is_diff) if d]
+    aux = [l for l, d in zip(leaves, is_diff) if not d]
+
+    def rebuild(diff_leaves, aux_leaves):
+        di, ai = iter(diff_leaves), iter(aux_leaves)
+        return tdef.unflatten([next(di) if d else next(ai) for d in is_diff])
+
+    return diff, aux, rebuild
+
+
 def pipelined_layers(
     mesh: Mesh,
     layer_apply: Callable[[Dict, Array, Any], Array],
@@ -112,6 +128,7 @@ def pipelined_layers(
     n_microbatch: int,
     capture_points: Sequence[int] = (),
     remat: bool = False,
+    schedule: str = "gpipe",
 ) -> Tuple[Array, Tuple[Array, ...]]:
     """Run L stacked layers over the mesh's `pp` axis, pipelined.
 
@@ -126,9 +143,27 @@ def pipelined_layers(
         other leaves are passed whole to every layer call.
       capture_points: global layer indices g; returns the hidden state
         ENTERING layer g for each (the hydra/value-branch fork inputs).
+      schedule: "gpipe" differentiates through the forward scan — simple,
+        but the scan transpose stores one boundary activation per TICK
+        (M + pp - 1 of them). "1f1b" runs the same forward under a
+        custom VJP whose backward interleaves a recompute pipeline with
+        the cotangent pipeline (the 1F1B idea: a microbatch's backward
+        starts as soon as its forward reaches the last stage), holding a
+        rolling buffer of at most 2*pp - 1 boundary activations per
+        stage and accumulating weight grads stage-locally across
+        microbatches. Cost: the backward re-runs each stage forward
+        TWICE (once in the recompute wavefront to regenerate boundary
+        inputs, once as the VJP primal) — one forward more than
+        gpipe+remat — in exchange for O(pp) instead of O(M) boundary
+        memory. Pick it when microbatch count, not FLOPs, is the
+        binding constraint (deep DCN meshes with many microbatches).
+        Parity: NeMo/Apex interleaved schedules, ref
+        modeling_nemo_ppo.py:573-585,713-731.
 
     Returns (h_out [B, ...], captures tuple aligned with capture_points).
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"pp_schedule={schedule!r} not in ('gpipe', '1f1b')")
     n_stages = mesh.shape["pp"]
     leaves = jax.tree_util.tree_leaves(xs)
     n_layer = leaves[0].shape[0]
@@ -245,7 +280,13 @@ def pipelined_layers(
         axis_names={"pp"},
         check_vma=False,
     )
-    outs, caps_store = f(xs, h_mb, ctx_mb)
+    if schedule == "1f1b":
+        outs, caps_store = _run_1f1b(
+            mesh, f, stage, xs, h_mb, ctx_mb, ctx_flags, ctx_dtypes,
+            M=M, n_stages=n_stages,
+        )
+    else:
+        outs, caps_store = f(xs, h_mb, ctx_mb)
     h_out = outs.reshape((B,) + h.shape[1:]).astype(compute_dtype)
     # caps_store: [M, n_pts, B/M, ...] -> per point [B, ...]
     captures = tuple(
@@ -255,3 +296,174 @@ def pipelined_layers(
         for i in range(n_pts)
     )
     return h_out, captures
+
+
+def _run_1f1b(mesh, fwd, stage, xs, h_mb, ctx_mb, ctx_flags, ctx_dtypes,
+              *, M: int, n_stages: int):
+    """The 1F1B memory-bounded differentiation of the pipelined region.
+
+    Forward: the ordinary GPipe shard_map (`fwd`), under a custom VJP
+    that saves ONLY the region inputs. Backward: one shard_map scan
+    interleaving two wavefronts per tick —
+
+      recompute   mb r = t - s flows stage 0 -> pp-1 (the forward
+                  schedule re-run), each stage pushing the activation
+                  that ENTERED it into a rolling ring of 2*pp-1 slots;
+      cotangent   mb b = t - 2(pp-1) + s flows stage pp-1 -> 0; the
+                  stage pops h_in(b) from its ring (pushed exactly
+                  2(pp-1-s) ticks earlier — the 1F1B property: a
+                  microbatch's backward launches the moment its forward
+                  reaches the last stage, so per-stage liveness is
+                  O(pp), not O(M)), runs its local VJP, accumulates its
+                  layer-slice weight grads in place, and ppermutes the
+                  input cotangent to the previous stage.
+
+    Capture cotangents inject automatically: the stage VJP is taken on
+    (h_out, caps) jointly, and caps depends on h only at the owning
+    stage. Integer leaves (layer indices, positions, key masks) ride as
+    non-differentiable aux and get float0 cotangents at the boundary.
+
+    FLOPs: the backward runs each stage forward twice per microbatch
+    (recompute wavefront + VJP primal; the two operate on DIFFERENT
+    microbatches at any tick, so they cannot be shared) — one extra
+    forward versus gpipe+remat. Storing VJP residuals in the ring
+    instead would erase the extra forward at O(pp)×stage-activation
+    memory (torch 1F1B's layout), but residual closures cannot ride a
+    lax.scan carry; boundary-only storage is the compiler-friendly
+    trade.
+    """
+    last = n_stages - 1
+    ring_slots = 2 * last + 1
+    n_ticks = M + 2 * last
+
+    @jax.custom_vjp
+    def run(xs_, h_mb_, ctx_mb_):
+        return fwd(xs_, h_mb_, ctx_mb_)
+
+    def run_fwd(xs_, h_mb_, ctx_mb_):
+        return fwd(xs_, h_mb_, ctx_mb_), (xs_, h_mb_, ctx_mb_)
+
+    def run_bwd(res, cts):
+        xs_, h_mb_, ctx_mb_ = res
+        d_outs, d_caps = cts
+
+        # diff/aux layout is identical globally and per-shard (sharding
+        # never changes tree structure), so these also describe xs_local
+        _, xs_aux_g, rebuild_xs_g = _partition_diff(xs_)
+        ctx_leaves_g, ctx_tdef = jax.tree_util.tree_flatten(ctx_mb_)
+        ctx_is_diff = [
+            jnp.issubdtype(l.dtype, jnp.inexact) for l in ctx_leaves_g
+        ]
+        flag_leaves = jax.tree_util.tree_leaves(ctx_flags)
+        dctx_split = [f for f, d in zip(flag_leaves, ctx_is_diff) if d]
+        dtype_leaves = jax.tree_util.tree_leaves(ctx_dtypes)
+
+        def bwd_shard(xs_local, h_loc, ctx_loc, douts, dcaps):
+            s = jax.lax.axis_index("pp")
+            xs_diff, xs_aux, rebuild_xs = _partition_diff(xs_local)
+            ctx_leaves = jax.tree_util.tree_leaves(ctx_loc)
+
+            def cast_ctx(ct):
+                leaves, tdef = jax.tree_util.tree_flatten(ct)
+                return tdef.unflatten([
+                    x.astype(d) if x.dtype != d else x
+                    for x, d in zip(leaves, dtype_leaves)
+                ])
+
+            def ctx_at(m):
+                return _index_microbatch(ctx_loc, ctx_flags, m)
+
+            perm_up = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            perm_dn = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+            mb_shape = h_loc.shape[1:]
+
+            def tick(carry, t):
+                ring, rec_buf, cot_buf, gxs, dh_store, dctx = carry
+                # recompute wavefront (forward schedule re-run)
+                r = t - s
+                ctx_r = cast_ctx(ctx_at(jnp.clip(r, 0, M - 1)))
+                h_in_rec = jnp.where(
+                    s == 0, h_loc[jnp.clip(t, 0, M - 1)], rec_buf
+                )
+                y, _ = stage(xs_local, h_in_rec, ctx_r)
+                ring = jax.lax.dynamic_update_index_in_dim(
+                    ring, h_in_rec, jnp.mod(t, ring_slots), 0
+                )
+                rec_next = jax.lax.ppermute(y, "pp", perm_up)
+
+                # cotangent wavefront
+                b = t - 2 * last + s
+                b_c = jnp.clip(b, 0, M - 1)
+                h_in_b = jax.lax.dynamic_index_in_dim(
+                    ring, jnp.mod(b_c + s, ring_slots), 0, keepdims=False
+                )
+                ctx_b = ctx_at(b_c)
+                cb_diff, cb_aux, rebuild_cb = _partition_diff(ctx_b)
+
+                def f(xd, h_, cd):
+                    return stage(
+                        rebuild_xs(xd, xs_aux), h_,
+                        cast_ctx(rebuild_cb(cd, cb_aux)),
+                    )
+
+                _, vjp_fn = jax.vjp(f, xs_diff, h_in_b, cb_diff)
+                g_h = jnp.where(s == last, douts[b_c], cot_buf)
+                d_xs, d_h, d_ctx = vjp_fn((g_h, dcaps[b_c]))
+                valid = (b >= 0) & (b < M)
+                vsel = lambda d: jnp.where(valid, d, jnp.zeros_like(d))
+                gxs = [a + vsel(d) for a, d in zip(gxs, d_xs)]
+                dh_store = dh_store.at[b_c].add(
+                    jnp.where(valid & (s == 0), d_h, jnp.zeros_like(d_h))
+                )
+                dctx = [
+                    a.at[b_c].add(vsel(d)) if split else a + vsel(d)
+                    for a, d, split in zip(dctx, d_ctx, dctx_split)
+                ]
+                cot_next = jax.lax.ppermute(d_h, "pp", perm_dn)
+                return (ring, rec_next, cot_next, gxs, dh_store, dctx), None
+
+            carry0 = (
+                jnp.zeros((ring_slots,) + mb_shape, h_loc.dtype),
+                jnp.zeros(mb_shape, h_loc.dtype),
+                jnp.zeros(mb_shape, h_loc.dtype),
+                [jnp.zeros_like(l) for l in xs_diff],
+                jnp.zeros_like(h_loc),
+                [
+                    jnp.zeros_like(l)
+                    for l, d in zip(ctx_leaves, ctx_is_diff) if d
+                ],
+            )
+            (_, _, _, gxs, dh_store, dctx), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(n_ticks)
+            )
+            # weight grads are stage-local (their slice of the stacked
+            # axis); boundary/ctx cotangents merge across stages
+            dh_store = jax.lax.psum(dh_store, "pp")
+            dctx = [jax.lax.psum(a, "pp") for a in dctx]
+            return gxs, dh_store, dctx
+
+        n_xd = len(_partition_diff(xs_)[0])
+        n_cd = sum(ctx_is_diff)
+        g = jax.shard_map(
+            bwd_shard,
+            mesh=mesh,
+            in_specs=(P("pp"), P(), P(), P(), P()),
+            out_specs=([P("pp")] * n_xd, P(), [P()] * n_cd),
+            axis_names={"pp"},
+            check_vma=False,
+        )
+        import numpy as np
+
+        gxs, dh_mb, dctx = g(xs_, h_mb_, ctx_mb_, d_outs, d_caps)
+        dxs = rebuild_xs_g(
+            gxs, [np.zeros(jnp.shape(a), jax.dtypes.float0) for a in xs_aux_g]
+        )
+        it = iter(dctx)
+        dctx_full = ctx_tdef.unflatten([
+            next(it) if d else np.zeros(l.shape, jax.dtypes.float0)
+            for l, d in zip(ctx_leaves_g, ctx_is_diff)
+        ])
+        return dxs, dh_mb, dctx_full
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(xs, h_mb, ctx_mb)
