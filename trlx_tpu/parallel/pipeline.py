@@ -25,10 +25,14 @@ Mechanics (microbatch pipelining, the classic GPipe schedule):
   and merge in the same masked-psum step.
 
 The bubble fraction is (pp-1)/(M+pp-1): raise `pp_microbatches` to
-amortize. Backward works through the `lax.scan`-of-`ppermute` transpose
-(reverse-direction permutes), which is exactly the 1F1B-ish reversed
-schedule; `remat=True` checkpoints each layer body so only per-tick
-stage inputs are stored, as in the sequential path.
+amortize. Two backward schedules (`pp_schedule`): "gpipe" (default)
+differentiates the forward scan — the transpose runs reverse-direction
+permutes but stores one boundary activation per TICK, O(M+pp) of them;
+"1f1b" (`_run_1f1b`) is a custom VJP whose backward interleaves a
+recompute pipeline with the cotangent pipeline so per-stage boundary
+liveness is O(pp), at the price of one extra forward. `remat` composes
+with either: it checkpoints each layer body so per-layer activations
+inside a stage are recomputed rather than stored.
 """
 
 from __future__ import annotations
@@ -226,12 +230,9 @@ def pipelined_layers(
             m = t - s
             m_c = jnp.clip(m, 0, M - 1)
             valid = (m >= 0) & (m < M)
-            ctx_t = _index_microbatch(ctx_mb, ctx_flags, m_c)
             # restore boundary-promoted ctx leaves to their compute dtype
             # (bf16<->f32 round-trips are bit-exact)
-            ctx_t = jax.tree_util.tree_map(
-                lambda x, d: x.astype(d) if x.dtype != d else x, ctx_t, ctx_dtypes
-            )
+            ctx_t = restore_ctx(_index_microbatch(ctx_mb, ctx_flags, m_c))
             h_in = jnp.where(s == 0, h_mb[jnp.clip(t, 0, M - 1)], buf)
             y, caps = stage(xs_local, h_in, ctx_t)
             if n_pts:
@@ -241,9 +242,7 @@ def pipelined_layers(
             outs = outs.at[m_c].add(
                 jnp.where(valid & (s == last), y, jnp.zeros_like(y))
             )
-            buf = jax.lax.ppermute(
-                y, "pp", [(i, (i + 1) % n_stages) for i in range(n_stages)]
-            )
+            buf = jax.lax.ppermute(y, "pp", perm_up)
             return (buf, outs, caps_store), None
 
         (buf, outs, caps_store), _ = jax.lax.scan(
@@ -272,6 +271,16 @@ def pipelined_layers(
         )
     ctx_mb = _split_microbatches(ctx, ctx_flags, M)
 
+    # one definition each for the fwd schedule AND the 1f1b backward, so
+    # the dtype-restore and neighbor-hop wiring can't drift between them
+    def restore_ctx(ct):
+        return jax.tree_util.tree_map(
+            lambda x, d: x.astype(d) if x.dtype != d else x, ct, ctx_dtypes
+        )
+
+    perm_up = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm_dn = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
     f = jax.shard_map(
         pipelined,
         mesh=mesh,
@@ -282,8 +291,8 @@ def pipelined_layers(
     )
     if schedule == "1f1b":
         outs, caps_store = _run_1f1b(
-            mesh, f, stage, xs, h_mb, ctx_mb, ctx_flags, ctx_dtypes,
-            M=M, n_stages=n_stages,
+            mesh, f, stage, xs, h_mb, ctx_mb, ctx_flags, restore_ctx,
+            M=M, n_stages=n_stages, perm_up=perm_up, perm_dn=perm_dn,
         )
     else:
         outs, caps_store = f(xs, h_mb, ctx_mb)
@@ -298,8 +307,8 @@ def pipelined_layers(
     return h_out, captures
 
 
-def _run_1f1b(mesh, fwd, stage, xs, h_mb, ctx_mb, ctx_flags, ctx_dtypes,
-              *, M: int, n_stages: int):
+def _run_1f1b(mesh, fwd, stage, xs, h_mb, ctx_mb, ctx_flags, restore_ctx,
+              *, M: int, n_stages: int, perm_up, perm_dn):
     """The 1F1B memory-bounded differentiation of the pipelined region.
 
     Forward: the ordinary GPipe shard_map (`fwd`), under a custom VJP
@@ -356,32 +365,22 @@ def _run_1f1b(mesh, fwd, stage, xs, h_mb, ctx_mb, ctx_flags, ctx_dtypes,
         ]
         flag_leaves = jax.tree_util.tree_leaves(ctx_flags)
         dctx_split = [f for f, d in zip(flag_leaves, ctx_is_diff) if d]
-        dtype_leaves = jax.tree_util.tree_leaves(ctx_dtypes)
 
         def bwd_shard(xs_local, h_loc, ctx_loc, douts, dcaps):
             s = jax.lax.axis_index("pp")
             xs_diff, xs_aux, rebuild_xs = _partition_diff(xs_local)
             ctx_leaves = jax.tree_util.tree_leaves(ctx_loc)
 
-            def cast_ctx(ct):
-                leaves, tdef = jax.tree_util.tree_flatten(ct)
-                return tdef.unflatten([
-                    x.astype(d) if x.dtype != d else x
-                    for x, d in zip(leaves, dtype_leaves)
-                ])
-
             def ctx_at(m):
                 return _index_microbatch(ctx_loc, ctx_flags, m)
 
-            perm_up = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-            perm_dn = [(i, (i - 1) % n_stages) for i in range(n_stages)]
             mb_shape = h_loc.shape[1:]
 
             def tick(carry, t):
                 ring, rec_buf, cot_buf, gxs, dh_store, dctx = carry
                 # recompute wavefront (forward schedule re-run)
                 r = t - s
-                ctx_r = cast_ctx(ctx_at(jnp.clip(r, 0, M - 1)))
+                ctx_r = restore_ctx(ctx_at(jnp.clip(r, 0, M - 1)))
                 h_in_rec = jnp.where(
                     s == 0, h_loc[jnp.clip(t, 0, M - 1)], rec_buf
                 )
@@ -403,7 +402,7 @@ def _run_1f1b(mesh, fwd, stage, xs, h_mb, ctx_mb, ctx_flags, ctx_dtypes,
                 def f(xd, h_, cd):
                     return stage(
                         rebuild_xs(xd, xs_aux), h_,
-                        cast_ctx(rebuild_cb(cd, cb_aux)),
+                        restore_ctx(rebuild_cb(cd, cb_aux)),
                     )
 
                 _, vjp_fn = jax.vjp(f, xs_diff, h_in_b, cb_diff)

@@ -154,6 +154,7 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         self._train_step = None  # built lazily (jitted)
         self._fused_train_step = None  # built lazily (jitted inner loop)
+        self._warned_fused_cadence = False
         self._measured_forward_times = {}  # timing_split probes by batch shape
         self._seen_step_shapes = set()  # batch shapes whose step has compiled
         self._generate_fns: Dict[Tuple, Callable] = {}
@@ -814,6 +815,25 @@ class TPUBaseTrainer(BaseRLTrainer):
             perm_rows.extend(order.reshape(n_batches, bs))
         perms = np.asarray(perm_rows[:steps_left], np.int32)
         n_steps = len(perms)
+        # quantization is silent degradation whenever the requested eval
+        # cadence doesn't land on fused-block boundaries (finer than one
+        # block, or any non-multiple — evals then fire late/irregularly):
+        # say so ONCE, or the tracker's eval curve is sparser than the
+        # reference's for no visible reason
+        if (
+            not self._warned_fused_cadence
+            and n_steps > 1
+            and self.config.train.eval_interval % n_steps != 0
+        ):
+            logger.warning(
+                "fused_inner_loop runs %d optimizer steps per device call "
+                "and eval_interval=%d is not a multiple: evals quantize to "
+                "block boundaries. Lower ppo_epochs or raise batch_size "
+                "(fewer steps per block), or disable train.fused_inner_loop "
+                "for exact cadence.",
+                n_steps, self.config.train.eval_interval,
+            )
+            self._warned_fused_cadence = True
 
         if self._fused_train_step is None:
             self._fused_train_step = self.make_fused_train_steps()
