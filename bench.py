@@ -127,7 +127,7 @@ PROMPTS = [
 ] * 16
 
 
-def bench_tpu() -> float:
+def bench_tpu() -> tuple:
     import jax
 
     import trlx_tpu
@@ -173,8 +173,13 @@ def bench_tpu() -> float:
     rng = np.random.default_rng(0)
 
     def cycle():
+        """One steady-state PPO cycle; returns the rollout/train phase
+        boundary timestamp (everything after make_experience — epoch
+        batch assembly, device placement, the fused train dispatch — is
+        booked under "train")."""
         trainer.store.clear_history()
         trainer.make_experience(NUM_ROLLOUTS)
+        mark = time.time()
         # all PPO_EPOCHS x minibatches in ONE dispatch (fused scan) —
         # the same path train.fused_inner_loop drives inside learn()
         full, n = trainer._fused_epoch_batch()
@@ -189,13 +194,22 @@ def bench_tpu() -> float:
                 trainer.params, trainer.opt_state, device_full, jnp.asarray(perms)
             )
         float(loss)  # sync
+        return mark
 
     cycle()  # warmup: compiles sampler, experience fn, train step
     # best-of-5: the remote-tunneled chip adds latency jitter worth
     # +-40% per cycle (occasionally far worse), so take the least
-    # contended measurement
-    dt = min(_timed(cycle) for _ in range(5))
-    return NUM_ROLLOUTS / dt
+    # contended measurement; each cycle records its phase split
+    # (rollout vs batch-assembly+train) so regressions are attributable
+    best, split = None, {}
+    for _ in range(5):
+        t0 = time.time()
+        marks = cycle()
+        dt = time.time() - t0
+        if best is None or dt < best:
+            best = dt
+            split = {"rollout": marks - t0, "train": t0 + dt - marks}
+    return NUM_ROLLOUTS / best, split
 
 
 def _timed(fn) -> float:
@@ -510,12 +524,14 @@ def main():
         with open(BASELINE_CACHE, "w") as f:
             json.dump({"samples_per_sec": baseline, "measured_at": time.time()}, f)
 
-    value = bench_tpu()
+    value, split = bench_tpu()
     dt_cycle = NUM_ROLLOUTS / value
     tokens_per_sec = cycle_tokens() / dt_cycle
     mfu = cycle_flops() / dt_cycle / (chip_peak_tflops() * 1e12)
 
-    extras = {}
+    extras = {
+        f"{k}_s": round(v, 3) for k, v in split.items()
+    }
     # reference-scale evidence first (the round-3 headline extra): 1.3B
     # train-step MFU on the real chip
     if os.environ.get("BENCH_LARGE", "1") != "0":

@@ -39,3 +39,14 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# the repo root on sys.path regardless of invocation style: bare
+# `pytest tests/` (the CI workflow) doesn't put the cwd there, and the
+# example-surface tests import `examples.*` (a plain directory, not an
+# installed package)
+import sys  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)

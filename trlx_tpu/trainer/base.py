@@ -819,11 +819,13 @@ class TPUBaseTrainer(BaseRLTrainer):
         # cadence doesn't land on fused-block boundaries (finer than one
         # block, or any non-multiple — evals then fire late/irregularly):
         # say so ONCE, or the tracker's eval curve is sparser than the
-        # reference's for no visible reason
+        # reference's for no visible reason. Judge the NOMINAL block size
+        # (a final total_steps-truncated block is not a cadence problem).
+        nominal_block = self.n_inner_epochs * n_batches
         if (
             not self._warned_fused_cadence
-            and n_steps > 1
-            and self.config.train.eval_interval % n_steps != 0
+            and nominal_block > 1
+            and self.config.train.eval_interval % nominal_block != 0
         ):
             logger.warning(
                 "fused_inner_loop runs %d optimizer steps per device call "
@@ -831,7 +833,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                 "block boundaries. Lower ppo_epochs or raise batch_size "
                 "(fewer steps per block), or disable train.fused_inner_loop "
                 "for exact cadence.",
-                n_steps, self.config.train.eval_interval,
+                nominal_block, self.config.train.eval_interval,
             )
             self._warned_fused_cadence = True
 
