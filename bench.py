@@ -212,12 +212,6 @@ def bench_tpu() -> tuple:
     return NUM_ROLLOUTS / best, split
 
 
-def _timed(fn) -> float:
-    t0 = time.time()
-    fn()
-    return time.time() - t0
-
-
 def bench_large() -> dict:
     """Train-step throughput at reference scale: a 1.32B-parameter
     GPT-NeoX-class geometry (24 layers x 2048 hidden, vocab 50257 — the

@@ -619,3 +619,33 @@ def test_pp_bad_schedule_is_loud():
             mesh, lambda l, h, c: h, {"w": jnp.zeros((2, 3))},
             jnp.zeros((4, 8)), (), n_microbatch=2, schedule="interleaved",
         )
+
+
+@pytest.mark.slow
+def test_pp4_1f1b_grad_parity():
+    """pp=4 single-layer stages: the deepest mesh the 8-device CI box
+    allows — exercises the 2*pp-1=7 slot ring with wraparound and the
+    multi-hop cotangent ppermute chain."""
+    kw = dict(vocab_size=64, hidden_size=32, n_layer=4, n_head=2,
+              n_positions=32, dtype=jnp.float32, pp_microbatches=8)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 12), 0, 64)
+    mask = jnp.ones_like(ids)
+    lm_seq = TransformerLM(TransformerConfig(**kw))
+    params = lm_seq.init(jax.random.PRNGKey(0))
+
+    def loss_of(lm):
+        return lambda p: jnp.mean(lm(p, ids, mask)["logits"] ** 2)
+
+    l0, g0 = jax.value_and_grad(loss_of(lm_seq))(params)
+    mesh = make_mesh({"pp": 4, "dp": 2})
+    lm = TransformerLM(TransformerConfig(pp_schedule="1f1b", **kw))
+    lm.mesh = mesh
+    with mesh:
+        l1, g1 = jax.jit(jax.value_and_grad(loss_of(lm)))(shard_params(mesh, params))
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        ),
+        g1, g0,
+    )
