@@ -21,10 +21,10 @@ from trlx_tpu.ops.remat import checkpoint_policy, resolve_remat, wrap_remat
 POLICIES = ["full", "save_nothing", "dots_saveable", "dots_with_no_batch_dims"]
 
 
-def _tiny_lm():
+def _tiny_lm(attention_impl="xla"):
     cfg = TransformerConfig(
         vocab_size=61, hidden_size=32, n_layer=3, n_head=2, n_positions=32,
-        dtype=jnp.float32,
+        dtype=jnp.float32, attention_impl=attention_impl,
     )
     lm = TransformerLM(cfg)
     params = lm.init(jax.random.PRNGKey(0))
@@ -102,5 +102,21 @@ def test_seq2seq_grad_parity_across_policies():
         # body), so grads match to reassociation noise, not bit-exactly
         jax.tree_util.tree_map(
             lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
+            grad, base_grad,
+        )
+
+
+@pytest.mark.slow
+def test_save_attn_policy_grad_parity():
+    """"save_attn" (keep the pallas kernel's named residuals, recompute
+    everything else) matches no-remat grads on a pallas-attention model,
+    and degrades to plain "full" behavior on the XLA path (no names)."""
+    for impl in ["pallas", "xla"]:
+        lm, params, ids, mask = _tiny_lm(attention_impl=impl)
+        base_loss, base_grad = _loss_and_grad(lm, params, ids, mask, False)
+        loss, grad = _loss_and_grad(lm, params, ids, mask, "save_attn")
+        np.testing.assert_allclose(loss, base_loss, rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6),
             grad, base_grad,
         )

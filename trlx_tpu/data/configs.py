@@ -161,7 +161,9 @@ class TrainConfig(_Section):
     # "dots_saveable" (keep matmul outputs, recompute elementwise —
     # NeMo "selective") | "dots_with_no_batch_dims" (keep weight-
     # stationary matmul results only) | "offload" (same, saved to
-    # pinned host memory). See trlx_tpu/ops/remat.py.
+    # pinned host memory) | "save_attn" (full recompute except the
+    # pallas attention kernel's named residuals — the long-context
+    # winner, docs/benchmarks.md). See trlx_tpu/ops/remat.py.
     remat_policy: str = "none"
     # When set, a jax.profiler trace of train steps [profile_start,
     # profile_stop) is written here (the reference exposes Nsight knobs in

@@ -37,6 +37,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 import numpy as np
 from jax.experimental import pallas as pl
 
@@ -413,6 +414,15 @@ def _fwd(q, k, v, key_mask, causal, sm_scale, block_q, q_offset):
         q, k, v, key_mask, causal, sm_scale, block_q, with_stats=True,
         q_offset=q_offset,
     )
+    # named so a remat policy can pin the kernel's residuals: under
+    # jax.checkpoint the custom-VJP primal re-executes to rebuild
+    # residuals — i.e. the forward KERNEL runs again in the backward
+    # pass. `save_attn` (ops/remat.py) saves exactly (out, m, l); q/k/v
+    # rematerialize from their projection matmuls, which is cheap next
+    # to a full online-softmax sweep.
+    out = checkpoint_name(out, "flash_out")
+    m = checkpoint_name(m, "flash_m")
+    l = checkpoint_name(l, "flash_l")
     return out, (q, k, v, key_mask, out, m, l)
 
 

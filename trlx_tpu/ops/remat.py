@@ -47,6 +47,7 @@ _POLICY_NAMES = (
     "dots_saveable",
     "dots_with_no_batch_dims",
     "offload",
+    "save_attn",
 )
 
 
@@ -73,6 +74,15 @@ def checkpoint_policy(remat: RematArg) -> Optional[Callable]:
         "dots_saveable": p.dots_saveable,
         "dots_with_no_batch_dims": p.dots_with_no_batch_dims_saveable,
         "offload": p.offload_dot_with_no_batch_dims("device", "pinned_host"),
+        # full recompute EXCEPT the pallas attention kernel's residuals
+        # (ops/flash_attention.py names its out + softmax stats): the
+        # backward's remat re-runs projections and elementwise chains but
+        # never the online-softmax sweep itself. ~1 extra [B,T,E]-sized
+        # save per layer vs "full"; no effect on the XLA attention path
+        # (nothing is named there).
+        "save_attn": p.save_only_these_names(
+            "flash_out", "flash_m", "flash_l"
+        ),
     }[remat]
 
 
