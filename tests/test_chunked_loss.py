@@ -1,0 +1,310 @@
+"""Chunked-from-hidden logprob/CE path (train.logit_chunks) and the
+bf16-gradient view (train.grads_dtype): numerical parity against the
+full-logits losses, plus an end-to-end PPO run on the at-scale recipe
+knobs. This is the machinery that makes the 1.3B training recipe
+reachable through trlx_tpu.train() instead of a hand-rolled bench step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    logit_projection,
+)
+from trlx_tpu.ops.common import chunked_logprobs, logprobs_of_labels
+
+B, T, E, V = 2, 11, 16, 37  # T deliberately not divisible by n_chunks
+
+
+def _hidden_labels(seed=0, t=T):
+    rng = np.random.default_rng(seed)
+    hidden = jnp.asarray(rng.normal(size=(B, t, E)), jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, V, size=(B, t)), jnp.int32)
+    return hidden, labels
+
+
+@pytest.mark.parametrize("n_chunks", [1, 3, 4])
+def test_chunked_logprobs_matches_full_tied(n_chunks):
+    cfg = TransformerConfig(vocab_size=V, hidden_size=E, n_layer=1, n_head=2)
+    params = TransformerLM(cfg).init(jax.random.PRNGKey(0))
+    hidden, labels = _hidden_labels()
+    proj = logit_projection(params)
+    full = logprobs_of_labels(proj(hidden), labels)
+    chunked = chunked_logprobs(proj, hidden, labels, n_chunks)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(chunked), atol=1e-5)
+
+
+def test_chunked_logprobs_matches_full_untied():
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=E, n_layer=1, n_head=2,
+        tie_word_embeddings=False,
+    )
+    params = TransformerLM(cfg).init(jax.random.PRNGKey(0))
+    assert "lm_head" in params
+    hidden, labels = _hidden_labels(1)
+    proj = logit_projection(params)
+    full = logprobs_of_labels(proj(hidden), labels)
+    chunked = chunked_logprobs(proj, hidden, labels, 4)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(chunked), atol=1e-5)
+
+
+def test_chunked_logprobs_grad_matches():
+    """The jax.checkpoint chunk scan must backprop identically to the
+    full projection (the whole point: same grads, no [B,T,V] residual)."""
+    cfg = TransformerConfig(vocab_size=V, hidden_size=E, n_layer=1, n_head=2)
+    params = TransformerLM(cfg).init(jax.random.PRNGKey(0))
+    hidden, labels = _hidden_labels(2)
+    hidden = hidden.astype(jnp.float32)
+
+    def loss_full(h, wte):
+        p = logit_projection({"embed": {"wte": wte}})
+        return logprobs_of_labels(p(h), labels).mean()
+
+    def loss_chunked(h, wte):
+        p = logit_projection({"embed": {"wte": wte}})
+        return chunked_logprobs(p, h, labels, 3).mean()
+
+    wte = params["embed"]["wte"]
+    gf_h, gf_w = jax.grad(loss_full, argnums=(0, 1))(hidden, wte)
+    gc_h, gc_w = jax.grad(loss_chunked, argnums=(0, 1))(hidden, wte)
+    np.testing.assert_allclose(np.asarray(gf_h), np.asarray(gc_h), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gf_w), np.asarray(gc_w), atol=1e-5)
+
+
+def test_t5_projection_matches_model_logits():
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM, t5_logit_projection
+
+    for tie in (True, False):
+        cfg = Seq2SeqConfig(
+            vocab_size=V, d_model=E, n_layer=1, n_decoder_layer=1, n_head=2,
+            d_ff=32, tie_word_embeddings=tie,
+        )
+        lm = T5LM(cfg)
+        params = lm.init(jax.random.PRNGKey(0))
+        hidden = _hidden_labels(3)[0]
+        full = lm._logits(params, hidden)
+        via_proj = t5_logit_projection(params, cfg)(hidden)
+        np.testing.assert_allclose(
+            np.asarray(full), np.asarray(via_proj), atol=1e-6
+        )
+
+
+def test_sft_loss_from_hidden_matches():
+    from trlx_tpu.trainer.sft import sft_loss, sft_loss_from_hidden
+
+    cfg = TransformerConfig(vocab_size=V, hidden_size=E, n_layer=2, n_head=2)
+    lm = TransformerLM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(4)
+    ids = jnp.asarray(rng.integers(0, V, size=(B, T)), jnp.int32)
+    labels = jnp.asarray(
+        np.where(rng.random((B, T)) < 0.3, -100, np.asarray(ids)), jnp.int32
+    )
+
+    def full(p):
+        out = lm(p, ids)
+        return sft_loss(out["logits"], labels)[0]
+
+    def chunked(p):
+        out = lm(p, ids, compute_logits=False)
+        assert out["logits"] is None
+        return sft_loss_from_hidden(
+            out["hidden_states"], logit_projection(p), labels, 3
+        )[0]
+
+    lf, gf = jax.value_and_grad(full)(params)
+    lc, gc = jax.value_and_grad(chunked)(params)
+    np.testing.assert_allclose(float(lf), float(lc), atol=1e-5)
+    # bf16 forward + differing fp32 reduction orders (log_softmax gather
+    # vs picked-minus-logsumexp): grads agree to bf16-noise level
+    for a, b in zip(jax.tree_util.tree_leaves(gf), jax.tree_util.tree_leaves(gc)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-3
+        )
+
+
+def _make_ppo_trainer(num_layers_unfrozen=-1, **train_kw):
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=10, seq_length=12,
+            checkpoint_interval=10, epochs=1, tracker=None, **train_kw,
+        ),
+        model=dict(
+            model_path="random",
+            num_layers_unfrozen=num_layers_unfrozen,
+            model_extra_configs={
+                "transformer": dict(
+                    hidden_size=16, n_layer=2, n_head=2, n_positions=64
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    trainer_cls = get_trainer(config.train.trainer)
+    return trainer_cls(
+        config=config,
+        reward_fn=lambda samples, prompts, outputs, **kw: [1.0] * len(outputs),
+    )
+
+
+def _fake_rollout_batch(trainer, P=4, N=4):
+    from trlx_tpu.data import PPORolloutBatch
+
+    rng = np.random.default_rng(7)
+    vocab = trainer.model.cfg.vocab_size
+    B_ = 8
+    return PPORolloutBatch(
+        query_tensors=jnp.asarray(rng.integers(1, vocab, (B_, P)), jnp.int32),
+        response_tensors=jnp.asarray(rng.integers(1, vocab, (B_, N)), jnp.int32),
+        logprobs=jnp.asarray(rng.normal(size=(B_, N)), jnp.float32),
+        values=jnp.asarray(rng.normal(size=(B_, N)), jnp.float32),
+        rewards=jnp.asarray(rng.normal(size=(B_, N)), jnp.float32),
+        response_mask=jnp.ones((B_, N), jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("num_layers_unfrozen", [-1, 1])
+def test_ppo_loss_chunked_matches_full(num_layers_unfrozen):
+    """trainer.loss with logit_chunks>0 == the full-logits loss (value
+    AND gradients), in both hydra and full-reference modes."""
+    trainer = _make_ppo_trainer(num_layers_unfrozen)
+    batch = _fake_rollout_batch(trainer)
+
+    trainer.config.train.logit_chunks = 0
+    (lf, _), gf = jax.value_and_grad(trainer.loss, has_aux=True)(
+        trainer.params, batch
+    )
+    trainer.config.train.logit_chunks = 3
+    (lc, _), gc = jax.value_and_grad(trainer.loss, has_aux=True)(
+        trainer.params, batch
+    )
+    np.testing.assert_allclose(float(lf), float(lc), rtol=2e-4, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(gf), jax.tree_util.tree_leaves(gc)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=3e-4
+        )
+
+
+def test_ppo_experience_fwd_chunked_matches_full():
+    """The rollout experience forward (policy+ref logprobs, KL penalty)
+    under logit_chunks == the full-logits one."""
+    trainer = _make_ppo_trainer(1)
+    rng = np.random.default_rng(9)
+    vocab = trainer.model.cfg.vocab_size
+    P = N = 4
+    tokens = jnp.asarray(rng.integers(1, vocab, (8, P + N)), jnp.int32)
+    mask = jnp.ones_like(tokens)
+    rmask = jnp.ones((8, N), jnp.int32)
+
+    outs = {}
+    for chunks in (0, 3):
+        trainer.config.train.logit_chunks = chunks
+        trainer._experience_fns.clear()  # cache key doesn't carry chunks
+        fn = trainer._get_experience_fwd_fn(P, N)
+        batch, kl = fn(
+            trainer.params, trainer.ref_params, tokens, mask, rmask,
+            jnp.float32(0.1), jnp.float32(8.0),
+        )
+        outs[chunks] = (batch, kl)
+    b0, kl0 = outs[0]
+    b1, kl1 = outs[3]
+    np.testing.assert_allclose(
+        np.asarray(b0.logprobs), np.asarray(b1.logprobs), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(b0.rewards), np.asarray(b1.rewards), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(kl0["mean_kl"]), float(kl1["mean_kl"]), rtol=1e-4, atol=1e-6
+    )
+
+
+@pytest.mark.slow
+def test_ppo_learn_at_scale_recipe_knobs(tmp_path):
+    """End-to-end trlx_tpu.train() with the full at-scale recipe config:
+    logit_chunks + grads_dtype=bfloat16 + fused int8 AdamW + save_attn
+    remat — the exact knob set the 1.3B bench drives (here on a tiny
+    model so CI covers the wiring)."""
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=2, checkpoint_interval=2,
+            seq_length=12, epochs=2, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            logit_chunks=2, grads_dtype="bfloat16", remat_policy="full",
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    hidden_size=16, n_layer=2, n_head=2, n_positions=64
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        optimizer=dict(name="adamw_8bit_fused", kwargs=dict(lr=1e-4)),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+    prompts = ["hello world", "the cat", "a b", "xyz", "what is", "I am", "go", "ok"]
+    trainer = trlx_tpu.train(
+        reward_fn=lambda samples, prompts, outputs, **kw: [
+            float(len(o)) for o in outputs
+        ],
+        prompts=prompts,
+        config=config,
+    )
+    assert trainer.iter_count == 2
+    assert all(
+        np.isfinite(np.asarray(x, np.float32)).all()
+        for x in jax.tree_util.tree_leaves(trainer.params)
+    )
+
+
+@pytest.mark.slow
+def test_sft_grads_dtype_bf16_with_accumulation(tmp_path):
+    """grads_dtype with minibatch accumulation: per-microbatch grads ride
+    bf16 but the running sum stays fp32 (base._step_update)."""
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_sft_config
+
+    config = default_sft_config().evolve(
+        train=dict(
+            batch_size=8, minibatch_size=4, total_steps=2, eval_interval=4,
+            checkpoint_interval=4, seq_length=16, epochs=2, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            logit_chunks=2, grads_dtype="bfloat16",
+            mesh=dict(dp=2, fsdp=2, tp=2, sp=1),
+        ),
+        model=dict(
+            model_path="random",
+            model_extra_configs={
+                "transformer": dict(
+                    hidden_size=16, n_layer=2, n_head=2, n_positions=64
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(gen_kwargs=dict(max_new_tokens=4)),
+    )
+    samples = ["hello world"] * 8 + ["the quick brown fox"] * 8
+    trainer = trlx_tpu.train(samples=samples, config=config)
+    assert trainer.iter_count == 2
+    assert all(
+        np.isfinite(np.asarray(x, np.float32)).all()
+        for x in jax.tree_util.tree_leaves(trainer.params)
+    )

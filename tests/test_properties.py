@@ -300,8 +300,9 @@ def test_fused_adamw_8bit_matches_optax_path():
 def test_fused_adam8bit_registry_and_trainer(tmp_path):
     """`optimizer.name: adamw_8bit_fused` reaches the fused apply from a
     TRLConfig: the trainer's step takes the fused_apply branch (params
-    written directly, no updates tree) including the freeze-mask blend
-    (num_layers_unfrozen=1 freezes the bottom layer + embeddings)."""
+    written directly, no updates tree) including the freeze mask streamed
+    through the apply (num_layers_unfrozen=1 freezes the bottom layer +
+    embeddings)."""
     import trlx_tpu
     from trlx_tpu.data.default_configs import default_sft_config
     from trlx_tpu.utils import get_optimizer_class
@@ -309,8 +310,18 @@ def test_fused_adam8bit_registry_and_trainer(tmp_path):
     make = get_optimizer_class("adamw_8bit_fused")
     tx = make(1e-4, betas=(0.9, 0.99), weight_decay=0.01)
     assert hasattr(tx, "fused_apply")
-    with pytest.raises(NotImplementedError):
+    # optax-contract fallback: params=None fails fast (AdamW needs the
+    # params); with params it returns the delta matching fused_apply
+    with pytest.raises(ValueError):
         tx.update({}, tx.init({"w": jnp.zeros((8,))}))
+    p0 = {"w": jnp.ones((8,), jnp.float32)}
+    g0 = {"w": jnp.full((8,), 0.1, jnp.float32)}
+    s0 = tx.init(p0)
+    upd, _ = tx.update(g0, s0, p0)
+    fp, _ = tx.fused_apply(p0, g0, s0)
+    np.testing.assert_allclose(
+        np.asarray(p0["w"] + upd["w"]), np.asarray(fp["w"]), atol=1e-6
+    )
 
     config = default_sft_config().evolve(
         train=dict(
@@ -348,3 +359,21 @@ def test_fused_adam8bit_registry_and_trainer(tmp_path):
     s0 = txf.init(p0)
     p1, s1 = txf.fused_apply(p0, {"w": jnp.zeros((4,))}, s0)
     np.testing.assert_allclose(np.asarray(p1["w"]), np.ones(4), atol=1e-6)
+
+
+def test_scale_by_adam_8bit_step_dtype_pin():
+    """step_dtype=None follows the grad dtype (bf16 in, bf16 step out);
+    an explicit jnp.float32 pins fp32 steps regardless of grad precision
+    (the option gating the bf16-step behavior change for bnb-row users)."""
+    from trlx_tpu.ops.adam8bit import scale_by_adam_8bit
+
+    p = {"w": jnp.ones((8,), jnp.float32)}
+    g = {"w": jnp.full((8,), 0.1, jnp.bfloat16)}
+
+    tx = scale_by_adam_8bit()
+    upd, _ = tx.update(g, tx.init(p))
+    assert upd["w"].dtype == jnp.bfloat16
+
+    tx32 = scale_by_adam_8bit(step_dtype=jnp.float32)
+    upd32, _ = tx32.update(g, tx32.init(p))
+    assert upd32["w"].dtype == jnp.float32

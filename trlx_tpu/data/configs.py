@@ -165,6 +165,21 @@ class TrainConfig(_Section):
     # pallas attention kernel's named residuals — the long-context
     # winner, docs/benchmarks.md). See trlx_tpu/ops/remat.py.
     remat_policy: str = "none"
+    # When > 0, trainer losses compute per-token logprobs / cross-entropy
+    # from hidden states in this many sequence chunks under
+    # jax.checkpoint (ops.common.chunked_logprobs) instead of
+    # materializing the full [batch, seq, vocab] fp32 logits — at
+    # b8/seq2048/vocab50257 that single tensor is 3.3 GB per
+    # materialization, the difference between billion-parameter training
+    # fitting one 16 GB chip or not. 0 = off. The at-scale recipe
+    # (docs/benchmarks.md) uses 8.
+    logit_chunks: int = 0
+    # When set (e.g. "bfloat16"), losses are differentiated through a
+    # grads_dtype view of the params, so the gradient tree rides in that
+    # dtype (half the HBM of fp32 grads at 1.3B: 2.6 GB vs 5.3 GB).
+    # Params and optimizer masters stay `param_dtype`; with
+    # minibatch accumulation the running sum stays fp32.
+    grads_dtype: Optional[str] = None
     # When set, a jax.profiler trace of train steps [profile_start,
     # profile_stop) is written here (the reference exposes Nsight knobs in
     # its NeMo configs — megatron_20b.yaml:126-131; this is the XLA

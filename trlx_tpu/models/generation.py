@@ -128,15 +128,24 @@ def cast_params_for_decode(params: Dict, compute_dtype) -> Dict:
     # [L, E] under blocks), biases and rel_bias tables keep fp32
     matmul_keys = ("kernel", "wte", "wpe")
 
-    def cast(path, x):
-        if not jnp.issubdtype(x.dtype, jnp.floating):
-            return x
+    def needs_cast(path, x):
+        if not jnp.issubdtype(x.dtype, jnp.floating) or x.dtype == compute_dtype:
+            return False
         last = getattr(path[-1], "key", None) if path else None
-        if last not in matmul_keys:
-            return x
-        return x.astype(compute_dtype)
+        return last in matmul_keys
 
-    return jax.tree_util.tree_map_with_path(cast, params)
+    # already-compute-dtype params (bf16 deployment checkpoints, or a
+    # caller that pre-cast): return the SAME tree — at 1.3B the cast
+    # copy is +2.6 GB of HBM that would sit next to the KV cache for
+    # the whole rollout, for zero bandwidth benefit
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    if not any(needs_cast(path, x) for path, x in flat):
+        return params
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: x.astype(compute_dtype) if needs_cast(path, x) else x,
+        params,
+    )
 
 
 def generate(
@@ -183,12 +192,17 @@ def generate(
         n_virt = kv_prefix["k"].shape[1]
     # pallas only: round the cache up to 128 slots — Mosaic needs a
     # 128-aligned cache length to lower the prefill's chunked loads (the
-    # pad slots stay masked below and decode never reaches them). The
-    # XLA path skips the pad: it would just inflate cache memory and
-    # every decode step's masked score width for nothing.
+    # pad slots stay masked below and decode never reaches them). Gated
+    # on the prefill actually qualifying for the kernel (Attention also
+    # needs 8-row-aligned queries, P % 8 == 0): when the prefill will
+    # fall back to XLA anyway, the pad would just inflate cache memory
+    # and every decode step's masked score width for nothing — same
+    # reason the plain XLA path skips it.
     total = n_virt + P + N
     pad_slots = (
-        (-total) % 128 if model.cfg.attention_impl == "pallas" else 0
+        (-total) % 128
+        if model.cfg.attention_impl == "pallas" and P % 8 == 0
+        else 0
     )
     total += pad_slots
 

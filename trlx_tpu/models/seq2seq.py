@@ -386,6 +386,7 @@ class T5LM:
         decoder_attention_mask: Optional[Array] = None,
         encoder_hidden: Optional[Array] = None,
         remat: bool = False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         """Teacher-forced forward. `encoder_hidden` may be reused across
         calls (e.g. computed once during rollout generation)."""
@@ -421,7 +422,7 @@ class T5LM:
             )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h)
         return {
-            "logits": self._logits(params, hidden),
+            "logits": self._logits(params, hidden) if compute_logits else None,
             "hidden_states": hidden,
             "encoder_hidden": encoder_hidden,
         }
@@ -437,6 +438,7 @@ class T5LM:
         decoder_attention_mask: Optional[Array],
         branch_at: int,
         remat=False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         """Teacher-forced forward that also returns the decoder hidden
         state entering layer `branch_at` plus the biases needed to re-run
@@ -484,7 +486,7 @@ class T5LM:
             )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h_top)
         return {
-            "logits": self._logits(params, hidden),
+            "logits": self._logits(params, hidden) if compute_logits else None,
             "hidden_states": hidden,
             "branch_hidden": h_branch,
             "self_bias": self_bias,
@@ -500,6 +502,7 @@ class T5LM:
         encoder_hidden: Array,
         cross_bias: Array,
         remat=False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         """Run a frozen top-k decoder branch from a captured hidden state."""
         h, _ = self._scan(
@@ -507,7 +510,10 @@ class T5LM:
             encoder_hidden, cross_bias, remat=remat,
         )
         hidden = self.norm.apply({"params": branch_params["ln_f"]}, h)
-        return {"logits": self._logits(branch_params, hidden)}
+        return {
+            "logits": self._logits(branch_params, hidden) if compute_logits else None,
+            "hidden_states": hidden,
+        }
 
 
     # -- decoding --------------------------------------------------------
@@ -549,6 +555,34 @@ class T5LM:
         )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h)
         return {"logits": self._logits(params, hidden), "hidden_states": hidden}, new_cache
+
+
+def t5_logit_projection(params: Dict, cfg):
+    """hidden -> fp32 logits closure over a T5LM param tree, matching
+    `T5LM._logits` numerics exactly (tied-embedding d_model^-0.5 scale,
+    compute-dtype matmul, fp32 accumulation). Feeds
+    `ops.common.chunked_logprobs` so losses can avoid materializing
+    full [B, T, V] logits."""
+    if "lm_head" in params:
+        kernel = params["lm_head"]["kernel"]
+
+        def proj(h: Array) -> Array:
+            return jnp.einsum(
+                "...d,dv->...v", h, kernel.astype(h.dtype),
+                preferred_element_type=jnp.float32,
+            )
+
+        return proj
+    wte = params["shared"]["wte"]
+    scale = cfg.d_model ** -0.5
+
+    def proj(h: Array) -> Array:
+        return jnp.einsum(
+            "...d,vd->...v", h * scale, wte.astype(h.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    return proj
 
 
 def extract_t5_branch_params(params: Dict, branch_at: int) -> Dict:

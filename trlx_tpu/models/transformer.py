@@ -445,6 +445,33 @@ class LMHead(nn.Module):
 # ---------------------------------------------------------------------------
 
 
+def logit_projection(params: Dict):
+    """hidden -> fp32 logits closure over a TransformerLM param tree
+    (tied wte or untied lm_head), matching `TransformerLM._logits`
+    numerics exactly (compute-dtype matmul, fp32 accumulation). Feeds
+    `ops.common.chunked_logprobs` so losses can avoid materializing
+    full [B, T, V] logits."""
+    if "lm_head" in params:
+        kernel = params["lm_head"]["kernel"]
+
+        def proj(h: Array) -> Array:
+            return jnp.einsum(
+                "...e,ev->...v", h, kernel.astype(h.dtype),
+                preferred_element_type=jnp.float32,
+            )
+
+        return proj
+    wte = params["embed"]["wte"]
+
+    def proj(h: Array) -> Array:
+        return jnp.einsum(
+            "...e,ve->...v", h, wte.astype(h.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    return proj
+
+
 def make_attention_bias(
     key_mask: Array,  # [B, S] 1 = attendable key slot
     q_slots: Array,  # [T] or [B, T] slot index of each query token
@@ -728,6 +755,7 @@ class TransformerLM:
         remat: bool = False,
         prefix_embeds: Optional[Array] = None,  # [n, E] prompt tuning
         kv_prefix: Optional[Dict[str, Array]] = None,  # {k,v}: [L, n, Hkv, D]
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         """Full forward. Without `cache`: plain teacher-forced pass over a
         (possibly left-padded) sequence. With `cache`: the input occupies
@@ -838,10 +866,12 @@ class TransformerLM:
                 ring_mesh=None if cache is not None else ring,
             )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
-        logits = self._logits(params, hidden)
+        # compute_logits=False: callers using chunked-from-hidden losses
+        # (train.logit_chunks) skip the full [B, T, V] projection here
+        logits = self._logits(params, hidden) if compute_logits else None
         if n_virtual:
             hidden = hidden[:, n_virtual:]
-            logits = logits[:, n_virtual:]
+            logits = logits[:, n_virtual:] if logits is not None else None
             positions = positions[:, n_virtual:]
         return {
             "logits": logits,
@@ -866,6 +896,7 @@ class TransformerLM:
         attention_mask: Optional[Array],
         branch_at: int,
         remat: bool = False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         """Forward that also returns the hidden state entering layer
         `branch_at`: the scan is split into [0, branch_at) + [branch_at,
@@ -905,7 +936,7 @@ class TransformerLM:
                 local_bias=local_bias, layer_offset=branch_at, ring_mesh=ring,
             )
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h_top)
-        logits = self._logits(params, hidden)
+        logits = self._logits(params, hidden) if compute_logits else None
         return {
             "logits": logits,
             "hidden_states": hidden,
@@ -923,6 +954,7 @@ class TransformerLM:
         attention_mask: Optional[Array],
         points: Tuple[int, ...],
         remat: bool = False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         """Forward capturing the hidden state entering each layer index in
         `points` (sorted ascending). Generalizes branch capture so the
@@ -970,7 +1002,7 @@ class TransformerLM:
                     captures.append(h)
                 prev = point
         hidden = self.ln_f.apply({"params": params["ln_f"]}, h)
-        logits = self._logits(params, hidden)
+        logits = self._logits(params, hidden) if compute_logits else None
         return {
             "logits": logits,
             "hidden_states": hidden,
@@ -990,6 +1022,7 @@ class TransformerLM:
         remat: bool = False,
         local_bias: Optional[Array] = None,
         key_mask: Optional[Array] = None,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         """Run only a top-k branch from a captured hidden state.
 
@@ -1012,7 +1045,7 @@ class TransformerLM:
             key_mask=key_mask, ring_mesh=ring,
         )
         hidden = self.ln_f.apply({"params": branch_params["ln_f"]}, h)
-        logits = self._logits(branch_params, hidden)
+        logits = self._logits(branch_params, hidden) if compute_logits else None
         return {"logits": logits, "hidden_states": hidden}
 
     # -- cache -----------------------------------------------------------

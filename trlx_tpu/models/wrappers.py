@@ -37,6 +37,7 @@ from trlx_tpu.models.transformer import (
     TransformerConfig,
     TransformerLM,
     extract_branch_params,
+    logit_projection,
 )
 
 Array = jnp.ndarray
@@ -83,11 +84,19 @@ class CausalLM:
         input_ids: Array,
         attention_mask: Optional[Array] = None,
         remat: bool = False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         return self.lm(
             _effective_base(self, params), input_ids, attention_mask,
-            remat=remat, **_adapter_kwargs(params),
+            remat=remat, compute_logits=compute_logits,
+            **_adapter_kwargs(params),
         )
+
+    def logit_project_fn(self, params: Dict):
+        """hidden -> logits closure for chunked-from-hidden losses
+        (`ops.common.chunked_logprobs`); resolves any LoRA overlay so the
+        projection matches the forward's effective weights."""
+        return logit_projection(_effective_base(self, params))
 
 
 class CausalLMWithValueHead:
@@ -174,12 +183,14 @@ class CausalLMWithValueHead:
             points.add(self.value_branch_at)
         return tuple(sorted(points))
 
-    def _multi_forward(self, params, input_ids, attention_mask, remat):
+    def _multi_forward(self, params, input_ids, attention_mask, remat,
+                       compute_logits=True):
         """Trunk pass capturing hydra and/or value-branch fork hiddens."""
         base = _effective_base(self, params)
         points = self._capture_points()
         out = self.lm.forward_with_multi_capture(
-            base, input_ids, attention_mask, points, remat=remat
+            base, input_ids, attention_mask, points, remat=remat,
+            compute_logits=compute_logits,
         )
         named = dict(zip(points, out["captures"]))
         if self.branch_at is not None:
@@ -194,15 +205,25 @@ class CausalLMWithValueHead:
         input_ids: Array,
         attention_mask: Optional[Array] = None,
         remat: bool = False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         if self.value_branch_at is None:
             out = self.lm(
                 _effective_base(self, params), input_ids, attention_mask,
-                remat=remat, **_adapter_kwargs(params),
+                remat=remat, compute_logits=compute_logits,
+                **_adapter_kwargs(params),
             )
         else:
-            out = self._multi_forward(params, input_ids, attention_mask, remat)
+            out = self._multi_forward(
+                params, input_ids, attention_mask, remat, compute_logits
+            )
         return dict(out, values=self._values(params, out))
+
+    def logit_project_fn(self, params: Dict):
+        """hidden -> logits closure for chunked-from-hidden losses
+        (`ops.common.chunked_logprobs`); resolves any LoRA overlay so the
+        projection matches the forward's effective weights."""
+        return logit_projection(_effective_base(self, params))
 
     def forward_train(
         self,
@@ -211,6 +232,7 @@ class CausalLMWithValueHead:
         input_ids: Array,
         attention_mask: Optional[Array] = None,
         remat: bool = False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         """One pass producing policy logits, values AND reference logits.
 
@@ -218,26 +240,42 @@ class CausalLMWithValueHead:
         reference (the whole point of the reference's hydra heads —
         modeling_ppo.py:410-453 — done here with an array slice instead of
         six per-arch branch classes).
+
+        `compute_logits=False` (train.logit_chunks) skips BOTH full-vocab
+        projections; `ref_hidden` is always returned so chunked losses can
+        project the reference's logprobs themselves.
         """
         if self.branch_at is None:
-            out = self.forward(params, input_ids, attention_mask, remat=remat)
-            ref_out = self.lm(ref_params, input_ids, attention_mask, remat=remat)
-            return dict(out, ref_logits=jax.lax.stop_gradient(ref_out["logits"]))
-
-        out = self._multi_forward(params, input_ids, attention_mask, remat)
-        ref_out = self.lm.forward_from_layer(
-            ref_params,
-            jax.lax.stop_gradient(out["branch_hidden"]),
-            out["attn_bias"],
-            out["positions"],
-            remat=remat,
-            local_bias=out.get("local_bias"),
-            key_mask=out.get("key_mask"),
-        )
+            out = self.forward(
+                params, input_ids, attention_mask, remat=remat,
+                compute_logits=compute_logits,
+            )
+            ref_out = self.lm(
+                ref_params, input_ids, attention_mask, remat=remat,
+                compute_logits=compute_logits,
+            )
+        else:
+            out = self._multi_forward(
+                params, input_ids, attention_mask, remat, compute_logits
+            )
+            out["values"] = self._values(params, out)
+            ref_out = self.lm.forward_from_layer(
+                ref_params,
+                jax.lax.stop_gradient(out["branch_hidden"]),
+                out["attn_bias"],
+                out["positions"],
+                remat=remat,
+                local_bias=out.get("local_bias"),
+                key_mask=out.get("key_mask"),
+                compute_logits=compute_logits,
+            )
         return dict(
             out,
-            values=self._values(params, out),
-            ref_logits=jax.lax.stop_gradient(ref_out["logits"]),
+            ref_logits=(
+                jax.lax.stop_gradient(ref_out["logits"])
+                if compute_logits else None
+            ),
+            ref_hidden=jax.lax.stop_gradient(ref_out["hidden_states"]),
         )
 
 
@@ -282,13 +320,21 @@ class Seq2SeqLMWithValueHead:
         decoder_input_ids: Array,
         decoder_attention_mask: Optional[Array] = None,
         remat: bool = False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         out = self.lm(
             _effective_base(self, params), input_ids, attention_mask,
             decoder_input_ids, decoder_attention_mask, remat=remat,
+            compute_logits=compute_logits,
         )
         values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
         return dict(out, values=values)
+
+    def logit_project_fn(self, params: Dict):
+        """hidden -> logits closure for chunked-from-hidden losses."""
+        from trlx_tpu.models.seq2seq import t5_logit_projection
+
+        return t5_logit_projection(_effective_base(self, params), self.cfg)
 
     def forward_train(
         self,
@@ -299,32 +345,42 @@ class Seq2SeqLMWithValueHead:
         decoder_input_ids: Array,
         decoder_attention_mask: Optional[Array] = None,
         remat: bool = False,
+        compute_logits: bool = True,
     ) -> Dict[str, Array]:
         if self.branch_at is None:
             out = self.forward(
                 params, input_ids, attention_mask, decoder_input_ids,
                 decoder_attention_mask, remat=remat,
+                compute_logits=compute_logits,
             )
             ref_out = self.lm(
                 ref_params, input_ids, attention_mask, decoder_input_ids,
                 decoder_attention_mask, remat=remat,
+                compute_logits=compute_logits,
             )
-            return dict(out, ref_logits=jax.lax.stop_gradient(ref_out["logits"]))
-        out = self.lm.forward_with_branch_capture(
-            params["base"], input_ids, attention_mask, decoder_input_ids,
-            decoder_attention_mask, self.branch_at, remat=remat,
-        )
-        values = apply_head(params["v_head"], out["hidden_states"])[..., 0]
-        ref_out = self.lm.forward_from_layer(
-            ref_params,
-            jax.lax.stop_gradient(out["branch_hidden"]),
-            out["self_bias"],
-            jax.lax.stop_gradient(out["encoder_hidden"]),
-            out["cross_bias"],
-            remat=remat,
-        )
+        else:
+            out = self.lm.forward_with_branch_capture(
+                params["base"], input_ids, attention_mask, decoder_input_ids,
+                decoder_attention_mask, self.branch_at, remat=remat,
+                compute_logits=compute_logits,
+            )
+            out["values"] = apply_head(params["v_head"], out["hidden_states"])[..., 0]
+            ref_out = self.lm.forward_from_layer(
+                ref_params,
+                jax.lax.stop_gradient(out["branch_hidden"]),
+                out["self_bias"],
+                jax.lax.stop_gradient(out["encoder_hidden"]),
+                out["cross_bias"],
+                remat=remat,
+                compute_logits=compute_logits,
+            )
         return dict(
-            out, values=values, ref_logits=jax.lax.stop_gradient(ref_out["logits"])
+            out,
+            ref_logits=(
+                jax.lax.stop_gradient(ref_out["logits"])
+                if compute_logits else None
+            ),
+            ref_hidden=jax.lax.stop_gradient(ref_out["hidden_states"]),
         )
 
 
@@ -362,16 +418,22 @@ class Seq2SeqLMWithILQLHeads:
         actions_ixs: Array,
         remat: bool = False,
     ) -> Tuple[Array, Tuple]:
+        from trlx_tpu.models.seq2seq import t5_logit_projection
         from trlx_tpu.ops.common import batched_index_select
 
+        base = _effective_base(self, params)
+        # the loss only needs logits AT the action positions: gather the
+        # hidden rows first, then project — [B, A, V] instead of [B, T, V]
+        # (identical math; the vocab matmul runs on A rows, not T)
         out = self.lm(
-            _effective_base(self, params), input_ids, attention_mask,
-            decoder_input_ids, remat=remat,
+            base, input_ids, attention_mask,
+            decoder_input_ids, remat=remat, compute_logits=False,
         )
         qs, target_qs, vs = apply_ilql_heads(
             params["heads"], out["hidden_states"], states_ixs, actions_ixs
         )
-        logits_at_actions = batched_index_select(out["logits"], actions_ixs, dim=1)
+        h_at = batched_index_select(out["hidden_states"], actions_ixs, dim=1)
+        logits_at_actions = t5_logit_projection(base, self.cfg)(h_at)
         return logits_at_actions, (qs, target_qs, vs)
 
     def sync_target(self, params: Dict, alpha: Optional[float] = None) -> Dict:
@@ -431,14 +493,19 @@ class CausalLMWithILQLHeads:
         ILQL loss consumes (trlx_tpu.ops.ilql.ilql_loss)."""
         from trlx_tpu.ops.common import batched_index_select
 
+        base = _effective_base(self, params)
+        # the loss only needs logits AT the action positions: gather the
+        # hidden rows first, then project — [B, A, V] instead of [B, T, V]
+        # (identical math; the vocab matmul runs on A rows, not T)
         out = self.lm(
-            _effective_base(self, params), input_ids, attention_mask,
-            remat=remat, **_adapter_kwargs(params),
+            base, input_ids, attention_mask,
+            remat=remat, compute_logits=False, **_adapter_kwargs(params),
         )
         qs, target_qs, vs = apply_ilql_heads(
             params["heads"], out["hidden_states"], states_ixs, actions_ixs
         )
-        logits_at_actions = batched_index_select(out["logits"], actions_ixs, dim=1)
+        h_at = batched_index_select(out["hidden_states"], actions_ixs, dim=1)
+        logits_at_actions = logit_projection(base)(h_at)
         return logits_at_actions, (qs, target_qs, vs)
 
     def sync_target(self, params: Dict, alpha: Optional[float] = None) -> Dict:

@@ -80,6 +80,47 @@ def logprobs_of_labels(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def chunked_logprobs(
+    project_fn,
+    hidden: jnp.ndarray,
+    labels: jnp.ndarray,
+    n_chunks: int,
+) -> jnp.ndarray:
+    """Per-token log p(label) from hidden states, never materializing the
+    full [batch, seq, vocab] logits.
+
+    `project_fn(hidden_chunk) -> logits_chunk` is the model's hidden->
+    logits projection (models.transformer.logit_projection /
+    models.seq2seq.t5_logit_projection — same einsum/dtype contract as
+    the in-model `_logits`, so this path is numerically the full-logits
+    path up to reduction order). The sequence axis is split into
+    `n_chunks` pieces and scanned with `jax.checkpoint`: the backward
+    recomputes each chunk's logits, so peak logit residency is
+    [batch, ceil(seq/n_chunks), vocab] instead of [batch, seq, vocab] —
+    at b8/seq2048/vocab50257 fp32 that's 0.4 GB instead of 3.3 GB, the
+    difference between the 1.3B recipe fitting one 16 GB chip or not.
+
+    Returns fp32 logprobs with the shape of `labels`.
+    """
+    B, T = labels.shape
+    ck = -(-T // n_chunks)
+    pad = n_chunks * ck - T
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+    hs = hidden.reshape(B, n_chunks, ck, hidden.shape[-1]).transpose(1, 0, 2, 3)
+    ls = labels.reshape(B, n_chunks, ck).transpose(1, 0, 2)
+
+    def body(carry, xt):
+        h, lab = xt
+        return carry, logprobs_of_labels(project_fn(h), lab)
+
+    body = jax.checkpoint(body, prevent_cse=False)
+    _, lp = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ls))
+    lp = lp.transpose(1, 0, 2).reshape(B, n_chunks * ck)
+    return lp[:, :T]
+
+
 def topk_mask(xs: jnp.ndarray, k: int) -> jnp.ndarray:
     """Mask all but the top-k logits to -inf (k >= vocab is a no-op)."""
     if k <= 0 or k >= xs.shape[-1]:

@@ -131,7 +131,10 @@ def unshard_axis(params: Dict, mesh: Mesh, axis: str = "pp") -> Dict:
     the decode copy is already materialized by `cast_params_for_decode`,
     so this re-shards that copy rather than duplicating params again.
 
-    Works under jit (sharding constraint) and outside (device_put).
+    Implemented as `jax.lax.with_sharding_constraint` on every leaf:
+    under jit this is a layout constraint the partitioner satisfies with
+    an all-gather; called eagerly it relies on
+    with_sharding_constraint's eager semantics (an immediate reshard).
     """
 
     def strip(spec_axis):

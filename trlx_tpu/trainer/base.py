@@ -106,7 +106,8 @@ class TPUBaseTrainer(BaseRLTrainer):
         self._update_mask = self.trainable_mask()
         if hasattr(tx, "fused_apply"):
             # fused optimizers write params directly (no updates tree to
-            # chain a mask into); _step_update blends frozen leaves back
+            # chain a mask into); _step_update streams the mask through
+            # fused_apply instead
             pass
         elif self._update_mask is not None:
             tx = optax.chain(tx, _mask_updates(self._update_mask))
@@ -698,8 +699,20 @@ class TPUBaseTrainer(BaseRLTrainer):
         loss_fn = self.loss
         num_mb, mb_size = self.num_mb, self.mb_size
         tx = self.tx
+        gd = self.config.train.grads_dtype
+        grads_dtype = _DTYPES[gd] if gd else None
 
         def compute(p, b):
+            if grads_dtype is not None:
+                # differentiate through a grads_dtype view: gradients come
+                # out in that dtype (e.g. bf16 = half the HBM of fp32
+                # grads); `params` stays the fp32 master the optimizer
+                # updates (the bench-proven 1.3B recipe, docs/benchmarks.md)
+                p = jax.tree_util.tree_map(
+                    lambda x: x.astype(grads_dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    p,
+                )
             return jax.value_and_grad(loss_fn, has_aux=True)(p, b)
 
         if num_mb == 1:
@@ -710,28 +723,40 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
             first = jax.tree_util.tree_map(lambda x: x[0], mbs)
             (l_shape, s_shape), g_shape = jax.eval_shape(compute, params, first)
+            # low-precision per-microbatch grads still ACCUMULATE in fp32
+            # (bf16 running sums lose mantissa against a growing total)
             zeros = jax.tree_util.tree_map(
-                lambda s: jnp.zeros(s.shape, s.dtype), (g_shape, l_shape, s_shape)
+                lambda s: jnp.zeros(
+                    s.shape,
+                    jnp.float32
+                    if jnp.issubdtype(s.dtype, jnp.floating) else s.dtype,
+                ),
+                (g_shape, l_shape, s_shape),
             )
 
             def body(acc, mb):
                 (l, s), g = compute(params, mb)
-                return jax.tree_util.tree_map(jnp.add, acc, (g, l, s)), None
+                return jax.tree_util.tree_map(
+                    lambda a, x: a + x.astype(a.dtype), acc, (g, l, s)
+                ), None
 
             (g_sum, l_sum, s_sum), _ = jax.lax.scan(body, zeros, mbs)
             grads = jax.tree_util.tree_map(lambda x: x / num_mb, g_sum)
+            if grads_dtype is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda x: x.astype(grads_dtype), grads
+                )
             loss = l_sum / num_mb
             stats = jax.tree_util.tree_map(lambda x: x / num_mb, s_sum)
 
         if hasattr(tx, "fused_apply"):
-            new_params, new_opt_state = tx.fused_apply(params, grads, opt_state)
-            if self._update_mask is not None:
-                # freeze = keep the old value on masked-out leaves (the
-                # updates-tree path chains _mask_updates instead)
-                new_params = jax.tree_util.tree_map(
-                    lambda p, np_, m: p + m * (np_ - p),
-                    params, new_params, self._update_mask,
-                )
+            # the freeze mask streams through the fused apply itself
+            # (O(chunk) extra memory); blending frozen values back after
+            # the apply would hold THREE fp32 param trees at peak —
+            # measured as the 0.5 GB that OOMed the 1.3B recipe
+            new_params, new_opt_state = tx.fused_apply(
+                params, grads, opt_state, mask=self._update_mask
+            )
         else:
             updates, new_opt_state = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)

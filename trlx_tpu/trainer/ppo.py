@@ -32,6 +32,7 @@ from trlx_tpu.data import PPORolloutBatch, PromptBatch
 from trlx_tpu.data.method_configs import PPOConfig
 from trlx_tpu.models.wrappers import CausalLMWithValueHead, Seq2SeqLMWithValueHead
 from trlx_tpu.ops.common import (
+    chunked_logprobs,
     logprobs_of_labels,
     running_moments_init,
     running_moments_update,
@@ -186,6 +187,9 @@ class TPUPPOTrainer(TPUBaseTrainer):
         )
         pad = self.generate_settings.pad_token_id
         remat = resolve_remat(self.config.train.remat_policy)
+        # chunked-from-hidden logprobs (train.logit_chunks): the full
+        # [B, T, V] fp32 logits never materialize — the at-scale recipe
+        chunks = self.config.train.logit_chunks
         if self.seq2seq:
             # query = encoder prompt; response = decoder ids (start token
             # + sampled tokens), parity: reference loss :146-173
@@ -197,9 +201,15 @@ class TPUPPOTrainer(TPUBaseTrainer):
             )
             out = self.model.forward_train(
                 params, self.ref_params, batch.query_tensors, enc_mask, dec,
-                dec_mask, remat=remat,
+                dec_mask, remat=remat, compute_logits=chunks == 0,
             )
-            logprobs = logprobs_of_labels(out["logits"][:, :-1], dec[:, 1:])
+            if chunks:
+                logprobs = chunked_logprobs(
+                    self.model.logit_project_fn(params),
+                    out["hidden_states"][:, :-1], dec[:, 1:], chunks,
+                )
+            else:
+                logprobs = logprobs_of_labels(out["logits"][:, :-1], dec[:, 1:])
             values_pred = out["values"][:, :-1]
             return ppo_loss(
                 logprobs=logprobs,
@@ -223,8 +233,19 @@ class TPUPPOTrainer(TPUBaseTrainer):
         )
         out = self.model.forward_train(
             params, self.ref_params, tokens, attention_mask, remat=remat,
+            compute_logits=chunks == 0,
         )
-        logprobs = logprobs_of_labels(out["logits"][:, P - 1 : P + N - 1], tokens[:, P : P + N])
+        if chunks:
+            # only response positions need logprobs: slice hidden BEFORE
+            # projecting, so even the chunked vocab matmul runs over N
+            # rows, not P+N
+            logprobs = chunked_logprobs(
+                self.model.logit_project_fn(params),
+                out["hidden_states"][:, P - 1 : P + N - 1],
+                tokens[:, P : P + N], chunks,
+            )
+        else:
+            logprobs = logprobs_of_labels(out["logits"][:, P - 1 : P + N - 1], tokens[:, P : P + N])
         values_pred = out["values"][:, P - 1 : P + N - 1]
         return ppo_loss(
             logprobs=logprobs,
@@ -249,15 +270,32 @@ class TPUPPOTrainer(TPUBaseTrainer):
             return self._experience_fns[key]
         model = self.model
 
+        chunks = self.config.train.logit_chunks
+
         def seq2seq_fn(params, ref_params, enc_ids, enc_mask, dec_ids, response_mask, scores, scores_mask, kl_coef, n_valid, scale_div):
             scores = scores / jnp.maximum(scale_div, 1e-8)
             mask = response_mask.astype(jnp.float32)
             dec_mask = jnp.concatenate(
                 [jnp.ones_like(dec_ids[:, :1]), response_mask.astype(jnp.int32)], axis=1
             )
-            out = model.forward_train(params, ref_params, enc_ids, enc_mask, dec_ids, dec_mask)
-            logprobs = logprobs_of_labels(out["logits"][:, :-1], dec_ids[:, 1:]) * mask
-            ref_logprobs = logprobs_of_labels(out["ref_logits"][:, :-1], dec_ids[:, 1:]) * mask
+            out = model.forward_train(
+                params, ref_params, enc_ids, enc_mask, dec_ids, dec_mask,
+                compute_logits=chunks == 0,
+            )
+            if chunks:
+                from trlx_tpu.models.seq2seq import t5_logit_projection
+
+                logprobs = chunked_logprobs(
+                    model.logit_project_fn(params),
+                    out["hidden_states"][:, :-1], dec_ids[:, 1:], chunks,
+                ) * mask
+                ref_logprobs = chunked_logprobs(
+                    t5_logit_projection(ref_params, model.cfg),
+                    out["ref_hidden"][:, :-1], dec_ids[:, 1:], chunks,
+                ) * mask
+            else:
+                logprobs = logprobs_of_labels(out["logits"][:, :-1], dec_ids[:, 1:]) * mask
+                ref_logprobs = logprobs_of_labels(out["ref_logits"][:, :-1], dec_ids[:, 1:]) * mask
             log_ratio = logprobs - ref_logprobs
             kl = jnp.exp(log_ratio) - 1 - log_ratio
             mean_kl, mean_kl_per_token = _masked_kl_stats(kl, n_valid)
@@ -315,10 +353,27 @@ class TPUPPOTrainer(TPUBaseTrainer):
             return self._experience_fns[key]
         model = self.model
 
+        chunks = self.config.train.logit_chunks
+
         def fn(params, ref_params, tokens, attention_mask, response_mask, kl_coef, n_valid):
-            out = model.forward_train(params, ref_params, tokens, attention_mask)
-            logprobs_full = logprobs_of_labels(out["logits"][:, :-1], tokens[:, 1:])
-            ref_logprobs_full = logprobs_of_labels(out["ref_logits"][:, :-1], tokens[:, 1:])
+            out = model.forward_train(
+                params, ref_params, tokens, attention_mask,
+                compute_logits=chunks == 0,
+            )
+            if chunks:
+                from trlx_tpu.models.transformer import logit_projection
+
+                logprobs_full = chunked_logprobs(
+                    model.logit_project_fn(params),
+                    out["hidden_states"][:, :-1], tokens[:, 1:], chunks,
+                )
+                ref_logprobs_full = chunked_logprobs(
+                    logit_projection(ref_params),
+                    out["ref_hidden"][:, :-1], tokens[:, 1:], chunks,
+                )
+            else:
+                logprobs_full = logprobs_of_labels(out["logits"][:, :-1], tokens[:, 1:])
+                ref_logprobs_full = logprobs_of_labels(out["ref_logits"][:, :-1], tokens[:, 1:])
 
             full_mask = attention_mask[:, 1:].astype(jnp.float32)
             log_ratio_full = (logprobs_full - ref_logprobs_full) * full_mask

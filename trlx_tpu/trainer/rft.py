@@ -77,11 +77,20 @@ class TPURFTTrainer(TPUBaseTrainer):
         # reference loss :82-87 labels=input_ids)
         import jax.numpy as jnp
 
+        chunks = self.config.train.logit_chunks
         out = self.model.forward(
             params, batch.input_ids, batch.attention_mask,
             remat=resolve_remat(self.config.train.remat_policy),
+            compute_logits=chunks == 0,
         )
         labels = jnp.where(batch.attention_mask > 0, batch.input_ids, -100)
+        if chunks:
+            from trlx_tpu.trainer.sft import sft_loss_from_hidden
+
+            return sft_loss_from_hidden(
+                out["hidden_states"], self.model.logit_project_fn(params),
+                labels, chunks,
+            )
         return sft_loss(out["logits"], labels)
 
     def add_prompt_pipeline(self, pipeline) -> None:

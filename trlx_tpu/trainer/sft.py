@@ -38,6 +38,21 @@ def sft_loss(logits: jnp.ndarray, labels: jnp.ndarray):
     return loss, {"losses/loss": loss, "perplexity": jnp.exp(loss)}
 
 
+def sft_loss_from_hidden(hidden, project_fn, labels, n_chunks: int):
+    """`sft_loss` without materializing [B, T, V] logits: per-token
+    logprobs come from a checkpointed chunk scan over the sequence
+    (ops.common.chunked_logprobs) — the train.logit_chunks path."""
+    from trlx_tpu.ops.common import chunked_logprobs
+
+    labels = labels[:, 1:]
+    mask = (labels != -100).astype(jnp.float32)
+    safe_labels = jnp.where(labels == -100, 0, labels)
+    lp = chunked_logprobs(project_fn, hidden[:, :-1], safe_labels, n_chunks)
+    n = jnp.maximum(mask.sum(), 1.0)
+    loss = -(lp * mask).sum() / n
+    return loss, {"losses/loss": loss, "perplexity": jnp.exp(loss)}
+
+
 @register_trainer("TPUSFTTrainer")
 class TPUSFTTrainer(TPUBaseTrainer):
     def __init__(self, config, **kwargs):
@@ -58,10 +73,17 @@ class TPUSFTTrainer(TPUBaseTrainer):
         return self.lora_freeze_mask(self.params) or self.make_freeze_mask(self.params)
 
     def loss(self, params, batch: SFTBatch):
+        chunks = self.config.train.logit_chunks
         out = self.model.forward(
             params, batch.input_ids, batch.attention_mask,
             remat=resolve_remat(self.config.train.remat_policy),
+            compute_logits=chunks == 0,
         )
+        if chunks:
+            return sft_loss_from_hidden(
+                out["hidden_states"], self.model.logit_project_fn(params),
+                batch.labels, chunks,
+            )
         return sft_loss(out["logits"], batch.labels)
 
     def make_experience(
