@@ -367,7 +367,13 @@ def bench_large_ppo() -> dict:
     exp = LB * SEQ_L * (
         _large_fwd_flops_per_token(SEQ_L) + _large_ref_flops_per_token(SEQ_L)
     )
-    train = 3 * L_PPO_EPOCHS * LB * SEQ_L * _large_fwd_flops_per_token(SEQ_L)
+    # the chunked train loss projects logits ONLY for the LN response
+    # positions (hidden sliced before the vocab matmul) — don't credit
+    # the (SEQ_L - LN) projections that never execute
+    train = 3 * L_PPO_EPOCHS * LB * (
+        SEQ_L * _large_fwd_flops_per_token(SEQ_L)
+        - (SEQ_L - LN) * 2.0 * VOCAB * LH
+    )
     peak = chip_peak_tflops() * 1e12
     train_s = max(split.get("train", 0.0), 1e-9)
     return {
@@ -537,6 +543,38 @@ def bench_longctx() -> dict:
     sync(lv, g)
     dt = (time.time() - t0) / 3
     out["longctx_train_tokens_per_sec"] = round(T / dt, 1)
+
+    # T5 long-document summarization shape (the TL;DR acceptance config's
+    # family): 8k-token encoder + 512-token decoder through the fused
+    # seq2seq attention path (rel-bias pallas self-attention + padding
+    # -mask cross-attention kernels), one full train step
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM
+
+    scfg = Seq2SeqConfig(
+        vocab_size=VOCAB, d_model=512, n_layer=6, n_head=8, d_kv=64,
+        d_ff=2048, attention_impl="pallas", dtype=jnp.bfloat16,
+    )
+    t5 = T5LM(scfg)
+    tparams = t5.init(jax.random.PRNGKey(2))
+    Td = 512
+    enc_ids = jax.random.randint(jax.random.PRNGKey(3), (1, T), 0, VOCAB)
+    emask = jnp.ones((1, T), jnp.int32)
+    dec_ids = jax.random.randint(jax.random.PRNGKey(4), (1, Td), 0, VOCAB)
+
+    def t5_loss(p):
+        o = t5(p, enc_ids, emask, dec_ids, remat="full")
+        lp = jax.nn.log_softmax(o["logits"].astype(jnp.float32), -1)
+        tg = jnp.concatenate([dec_ids[:, 1:], dec_ids[:, :1]], 1)
+        return -jnp.take_along_axis(lp, tg[..., None], -1).mean()
+
+    t5_step = jax.jit(jax.value_and_grad(t5_loss))
+    lv, g = t5_step(tparams)
+    sync(lv, g)
+    t0 = time.time()
+    for _ in range(3):
+        lv, g = t5_step(tparams)
+    sync(lv, g)
+    out["longctx_t5_tokens_per_sec"] = round((T + Td) / ((time.time() - t0) / 3), 1)
 
     # attention op: pallas vs XLA
     B, NH, D = 1, HEADS, H // HEADS

@@ -1,21 +1,70 @@
 """PPO GPT2 on IMDB sentiment continuation (parity:
 /root/reference/examples/ppo_sentiments.py — the benchmark workhorse).
-Requires HF hub access (gpt2-imdb weights + a sentiment classifier); for
-an air-gapped smoke test of the same loop use
-examples/randomwalks/ppo_randomwalks.py.
+Requires HF hub access (gpt2-imdb weights + a sentiment classifier).
+
+SMOKE=1 runs the SAME wiring air-gapped: a tiny random-init transformer
+via model_extra_configs, the byte tokenizer, fixed prompts, and a
+synthetic lexical-positivity reward standing in for the classifier —
+so CI executes this example's full train loop end to end (the reward
+model/dataset are the only network-bound pieces). For a REAL air-gapped
+learning check use examples/randomwalks/ppo_randomwalks.py.
 """
 
+import os
 from typing import Dict, List
 
 import trlx_tpu
 from trlx_tpu.data.default_configs import TRLConfig, default_ppo_config
+
+SMOKE = os.environ.get("SMOKE", "0") == "1"
 
 
 def get_positive_score(scores: List[Dict[str, float]]) -> float:
     return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
 
 
+def smoke_config() -> TRLConfig:
+    """CI-sized smoke configuration: tiny random model, byte tokenizer,
+    2 steps — everything else identical to the real run's wiring."""
+    return default_ppo_config().evolve(
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    hidden_size=16, n_layer=2, n_head=2, n_positions=64
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(
+            batch_size=8, total_steps=2, seq_length=16, eval_interval=2,
+            checkpoint_interval=2, tracker=None,
+        ),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+
+
 def main(hparams={}):
+    if SMOKE:
+        config = TRLConfig.update(smoke_config().to_dict(), hparams)
+
+        def reward_fn(samples: List[str], **kwargs) -> List[float]:
+            # lexical positivity stand-in for the sentiment classifier
+            return [float(s.count("a")) - 0.05 * len(s) for s in samples]
+
+        prompts = ["the movie was", "I watched this and", "a review:",
+                   "honestly the plot", "the acting", "what a film,",
+                   "two hours of", "the director"] * 2
+        return trlx_tpu.train(
+            reward_fn=reward_fn,
+            prompts=prompts,
+            eval_prompts=prompts[:8],
+            config=config,
+        )
+
     config = TRLConfig.update(default_ppo_config().to_dict(), hparams)
 
     from datasets import load_dataset

@@ -209,7 +209,6 @@ def test_ppo_experience_fwd_chunked_matches_full():
     outs = {}
     for chunks in (0, 3):
         trainer.config.train.logit_chunks = chunks
-        trainer._experience_fns.clear()  # cache key doesn't carry chunks
         fn = trainer._get_experience_fwd_fn(P, N)
         batch, kl = fn(
             trainer.params, trainer.ref_params, tokens, mask, rmask,

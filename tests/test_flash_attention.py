@@ -231,3 +231,57 @@ def test_generation_prefill_pallas_nonzero_offset():
         np.testing.assert_array_equal(
             np.asarray(out_x["sequences"]), np.asarray(out_p["sequences"])
         )
+
+
+def _bias_reference(q, k, v, key_mask, bias, causal):
+    """XLA oracle for the bias-carrying kernel (T5 semantics: additive
+    learned bias, no 1/sqrt(d) scale)."""
+    from trlx_tpu.ops.flash_attention import NEG_INF
+
+    T, S = q.shape[2], k.shape[2]
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) + bias[None]
+    if causal:
+        s = jnp.where(
+            jnp.arange(T)[:, None] >= jnp.arange(S)[None, :], s, NEG_INF
+        )
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :] > 0, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bias_kernel_matches_reference(causal):
+    """flash_attention_bias (T5 rel-bias variant): values AND all four
+    gradients — q, k, v and the batch-summed dbias that trains the
+    rel_bias table — against the XLA oracle, with padding masks."""
+    from trlx_tpu.ops.flash_attention import flash_attention_bias
+
+    B, H, T, D = 2, 3, 128, 16
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(H, T, T)), jnp.float32)
+    mask = jnp.asarray(rng.random((B, T)) > 0.2, jnp.int32).at[:, :4].set(1)
+    ct = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+
+    out = flash_attention_bias(q, k, v, mask, bias, causal=causal)
+    ref = _bias_reference(q, k, v, mask, bias, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    gk = jax.grad(
+        lambda a: (
+            flash_attention_bias(a[0], a[1], a[2], mask, a[3], causal=causal)
+            * ct
+        ).sum()
+    )((q, k, v, bias))
+    gr = jax.grad(
+        lambda a: (_bias_reference(a[0], a[1], a[2], mask, a[3], causal) * ct).sum()
+    )((q, k, v, bias))
+    for a, b, name in zip(gk, gr, ("dq", "dk", "dv", "dbias")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, err_msg=name
+        )

@@ -47,6 +47,14 @@ class Seq2SeqConfig:
     decoder_start_token_id: int = 0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # "xla" | "pallas": fused flash kernels for teacher-forced encoder
+    # and decoder self-attention (ops/flash_attention.flash_attention_bias
+    # — carries the learned relative position bias with a proper dbias
+    # backward) and for cross-attention (padding-mask-only kernel).
+    # Decode steps (KV cache) and shapes not divisible by 128 fall back
+    # to XLA. The per-layer [B, H, T, S] score tensor never materializes
+    # on this path — long-context summarization training's memory win.
+    attention_impl: str = "xla"
     # pipeline parallelism: microbatches per pipelined stack when the
     # mesh has a pp axis > 1 (0 = one per stage); raise to shrink the
     # (pp-1)/(M+pp-1) bubble — mirrors TransformerConfig.pp_microbatches
@@ -92,11 +100,51 @@ def compute_position_bias(
     num_buckets: int,
     max_distance: int,
 ) -> Array:
-    """[1, n_head, T, S] additive attention bias."""
+    """[1, n_head, T, S] additive attention bias.
+
+    The gather is head-major ([H, T, S] directly, NOT [T, S, H] then
+    transpose): a [T*S, H] intermediate has an H-wide minor dim that the
+    TPU lane layout pads to 128 — 16x inflation, a 34 GB allocation at
+    8k/8-head where the real tensor is 2 GB."""
     rel = k_pos[None, :] - q_pos[:, None]  # [T, S]
     buckets = relative_position_bucket(rel, bidirectional, num_buckets, max_distance)
-    bias = jnp.take(rel_bias_table, buckets, axis=0)  # [T, S, H]
-    return bias.transpose(2, 0, 1)[None].astype(jnp.float32)
+    bias = jnp.take(rel_bias_table.transpose(1, 0), buckets, axis=1)  # [H, T, S]
+    return bias[None].astype(jnp.float32)
+
+
+def compute_position_bias_dense(
+    rel_bias_table: Array,  # [n_buckets, n_head]
+    T: int,
+    S: int,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> Array:
+    """[1, n_head, T, S] bias for CONSECUTIVE positions (arange(T) vs
+    arange(S)) — every teacher-forced stack call.
+
+    Exploits the Toeplitz structure (the bias depends only on s - t):
+    bucket and gather a tiny [H, T+S-1] relative vector, then expand to
+    [H, T, S] with vmapped dynamic slices. A direct [T, S]-indexed
+    gather (and its scatter-add transpose for the trainable table's
+    gradient) lowers to a [T*S, H]-shaped buffer whose 8-wide minor dim
+    the TPU lane layout pads 16x — a 34 GB allocation at 8k tokens
+    (measured); this construction never builds a lane-padded buffer in
+    either direction."""
+    R = T + S - 1
+    rel_vec = jnp.arange(R) - (T - 1)  # s - t for each diagonal
+    buckets = relative_position_bucket(
+        rel_vec, bidirectional, num_buckets, max_distance
+    )
+    bias_rel = jnp.take(
+        rel_bias_table.transpose(1, 0), buckets, axis=1
+    )  # [H, R]
+
+    def row(t):
+        return jax.lax.dynamic_slice_in_dim(bias_rel, (T - 1) - t, S, axis=1)
+
+    bias = jax.vmap(row)(jnp.arange(T)).transpose(1, 0, 2)  # [H, T, S]
+    return bias[None].astype(jnp.float32)
 
 
 class T5Norm(nn.Module):
@@ -122,8 +170,15 @@ class T5Attention(nn.Module):
         self,
         x: Array,  # [B, T, D] queries
         kv: Array,  # [B, S, D] keys/values source
-        bias: Array,  # [B or 1, H, T, S] additive (position bias + masking)
+        bias: Optional[Array],  # [B or 1, H, T, S] additive — None takes
+        # the fused pallas path (the caller gated shapes) with the
+        # structured pieces below instead
         cache: Optional[Dict[str, Array]] = None,
+        pos_bias: Optional[Array] = None,  # [1, H, T, S] learned rel bias
+        # (rank-4 with a leading broadcast dim so pipeline-parallel ctx
+        # splitting never mistakes the head axis for a batch axis)
+        key_mask: Optional[Array] = None,  # [B, S] 1 = attendable
+        causal: bool = False,
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
         cfg = self.cfg
         H, Dk = cfg.n_head, cfg.d_kv
@@ -151,11 +206,35 @@ class T5Attention(nn.Module):
             new_kv = {"k": k_all, "v": v_all}
             k, v = k_all.astype(cfg.dtype), v_all.astype(cfg.dtype)
 
-        # NOTE: no 1/sqrt(d) — T5 folds the scale into initialization
-        scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
-        scores = scores + bias
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        if bias is None:
+            # fused path (NOTE: T5 has no 1/sqrt(d) — sm_scale=1.0):
+            # self-attention carries the learned rel bias through
+            # flash_attention_bias (dbias flows back to the table);
+            # cross-attention has padding masking only, so the plain
+            # kernel serves it
+            from trlx_tpu.ops.flash_attention import (
+                flash_attention,
+                flash_attention_bias,
+            )
+
+            qT = q.transpose(0, 2, 1, 3)
+            kT = k.transpose(0, 2, 1, 3)
+            vT = v.transpose(0, 2, 1, 3)
+            if pos_bias is not None:
+                out = flash_attention_bias(
+                    qT, kT, vT, key_mask, pos_bias[0], causal=causal,
+                    sm_scale=1.0,
+                )
+            else:
+                out = flash_attention(
+                    qT, kT, vT, key_mask, causal=False, sm_scale=1.0
+                )
+            out = out.transpose(0, 2, 1, 3).astype(cfg.dtype)
+        else:
+            scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+            scores = scores + bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhts,bshd->bthd", probs, v)
         proj = nn.DenseGeneral(
             features=cfg.d_model,
             axis=(-2, -1),
@@ -198,18 +277,26 @@ class T5Block(nn.Module):
     def __call__(
         self,
         x: Array,
-        self_bias: Array,
+        self_bias: Optional[Array],
         enc_out: Optional[Array] = None,
         cross_bias: Optional[Array] = None,
+        pos_bias: Optional[Array] = None,  # pallas path (self_bias None)
+        skey_mask: Optional[Array] = None,
+        ckey_mask: Optional[Array] = None,
         cache: Optional[Dict[str, Array]] = None,
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
         cfg = self.cfg
         h = T5Norm(cfg, name="ln_1")(x)
-        attn_out, new_kv = T5Attention(cfg, name="self_attn")(h, h, self_bias, cache)
+        attn_out, new_kv = T5Attention(cfg, name="self_attn")(
+            h, h, self_bias, cache, pos_bias=pos_bias, key_mask=skey_mask,
+            causal=self.is_decoder,
+        )
         x = x + attn_out
         if self.is_decoder and enc_out is not None:
             h = T5Norm(cfg, name="ln_cross")(x)
-            cross_out, _ = T5Attention(cfg, name="cross_attn")(h, enc_out, cross_bias)
+            cross_out, _ = T5Attention(cfg, name="cross_attn")(
+                h, enc_out, cross_bias, key_mask=ckey_mask
+            )
             x = x + cross_out
         x = x + T5MLP(cfg, name="mlp")(T5Norm(cfg, name="ln_2")(x))
         return x, new_kv
@@ -355,26 +442,80 @@ class T5LM:
 
     # -- forward ---------------------------------------------------------
 
+    def _pallas_ok(self, *seq_dims) -> bool:
+        """Static gate for the fused-attention path: teacher-forced
+        shapes with 128-divisible sequence dims (Mosaic lane/DMA
+        alignment); decode steps (cache) never come through here."""
+        return self.cfg.attention_impl == "pallas" and all(
+            d % 128 == 0 for d in seq_dims
+        )
+
+    def _self_attn_args(self, params, stack: str, T: int, key_mask, causal,
+                        use_pallas: bool):
+        """(self_bias, pos_bias, skey_mask) for a self-attention stack:
+        the combined additive [.., T, T] bias on the XLA path, or the
+        structured (learned bias, padding mask) pieces on the pallas one
+        — where the combined tensor is exactly what must NOT be built."""
+        cfg = self.cfg
+        pos = jnp.arange(T)
+        pb = compute_position_bias_dense(
+            params[stack]["rel_bias"], T, T, not causal,
+            cfg.relative_attention_num_buckets,
+            cfg.relative_attention_max_distance,
+        )  # [1, H, T, T]
+        if use_pallas:
+            return None, pb, key_mask
+        bias = pb
+        if causal:
+            causal_ok = pos[:, None] >= pos[None, :]
+            bias = bias + jnp.where(causal_ok[None, None], 0.0, NEG_INF)
+        if key_mask is not None:
+            bias = bias + jnp.where(key_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+        return bias, None, None
+
+    def _decoder_args(self, params, B, T, S_enc, decoder_attention_mask,
+                      attention_mask, encoder_hidden):
+        """The decoder stacks' shared 6-tuple of block args (combined
+        biases on the XLA path; structured pos-bias/key-mask pieces on
+        the pallas one) — one place, so the teacher-forced and
+        hydra-capture paths cannot diverge."""
+        use_pallas = self._pallas_ok(T, S_enc)
+        self_bias, pos_bias, skey_mask = self._self_attn_args(
+            params, "decoder", T, decoder_attention_mask, causal=True,
+            use_pallas=use_pallas,
+        )
+        if use_pallas and skey_mask is None:
+            skey_mask = jnp.ones((B, T), jnp.int32)
+        if use_pallas:
+            cross_bias, ckey_mask = None, attention_mask
+        else:
+            cross_bias = jnp.where(
+                attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
+            )
+            ckey_mask = None
+        return (self_bias, encoder_hidden, cross_bias, pos_bias, skey_mask,
+                ckey_mask)
+
     def encode(self, params: Dict, input_ids: Array, attention_mask: Array,
                remat=False) -> Array:
         cfg = self.cfg
         T = input_ids.shape[1]
-        pos = jnp.arange(T)
-        bias = compute_position_bias(
-            params["encoder"]["rel_bias"], pos, pos, True,
-            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        use_pallas = self._pallas_ok(T)
+        self_bias, pos_bias, skey_mask = self._self_attn_args(
+            params, "encoder", T, attention_mask, causal=False,
+            use_pallas=use_pallas,
         )
-        bias = bias + jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+        args = (self_bias, None, None, pos_bias, skey_mask, None)
         h = self._embed(params, input_ids)
         n_mb = self._pp_microbatches(cfg.n_layer, h.shape[0])
         if n_mb:
             h, _ = self._pp_scan(
-                self.enc_block, params["encoder"]["blocks"], h, (bias,), n_mb,
+                self.enc_block, params["encoder"]["blocks"], h, args, n_mb,
                 remat=remat,
             )
         else:
-            h, _ = self._scan(self.enc_block, params["encoder"]["blocks"], h, bias,
-                              remat=remat)
+            h, _ = self._scan(self.enc_block, params["encoder"]["blocks"], h,
+                              *args, remat=remat)
         return self.norm.apply({"params": params["encoder"]["ln_f"]}, h)
 
     def __call__(
@@ -395,30 +536,22 @@ class T5LM:
             encoder_hidden = self.encode(params, input_ids, attention_mask,
                                          remat=remat)
         B, T = decoder_input_ids.shape
-        pos = jnp.arange(T)
-        self_bias = compute_position_bias(
-            params["decoder"]["rel_bias"], pos, pos, False,
-            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        args = self._decoder_args(
+            params, B, T, encoder_hidden.shape[1], decoder_attention_mask,
+            attention_mask, encoder_hidden,
         )
-        causal = pos[:, None] >= pos[None, :]
-        self_bias = self_bias + jnp.where(causal[None, None], 0.0, NEG_INF)
-        if decoder_attention_mask is not None:
-            self_bias = self_bias + jnp.where(
-                decoder_attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
-            )
-        cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
 
         h = self._embed(params, decoder_input_ids)
         n_mb = self._pp_microbatches(cfg.n_decoder_layer, B)
         if n_mb:
             h, _ = self._pp_scan(
                 self.dec_block, params["decoder"]["blocks"], h,
-                (self_bias, encoder_hidden, cross_bias), n_mb, remat=remat,
+                args, n_mb, remat=remat,
             )
         else:
             h, _ = self._scan(
-                self.dec_block, params["decoder"]["blocks"], h, self_bias,
-                encoder_hidden, cross_bias, remat=remat,
+                self.dec_block, params["decoder"]["blocks"], h, *args,
+                remat=remat,
             )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h)
         return {
@@ -448,26 +581,18 @@ class T5LM:
         encoder_hidden = self.encode(params, input_ids, attention_mask,
                                      remat=remat)
         B, T = decoder_input_ids.shape
-        pos = jnp.arange(T)
-        self_bias = compute_position_bias(
-            params["decoder"]["rel_bias"], pos, pos, False,
-            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        args = self._decoder_args(
+            params, B, T, encoder_hidden.shape[1], decoder_attention_mask,
+            attention_mask, encoder_hidden,
         )
-        causal = pos[:, None] >= pos[None, :]
-        self_bias = self_bias + jnp.where(causal[None, None], 0.0, NEG_INF)
-        if decoder_attention_mask is not None:
-            self_bias = self_bias + jnp.where(
-                decoder_attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
-            )
-        cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+        (self_bias, _, cross_bias, pos_bias, skey_mask, ckey_mask) = args
 
         h = self._embed(params, decoder_input_ids)
         n_mb = self._pp_microbatches(cfg.n_decoder_layer, B)
         if n_mb:
             h_top, (h_branch,) = self._pp_scan(
                 self.dec_block, params["decoder"]["blocks"], h,
-                (self_bias, encoder_hidden, cross_bias), n_mb,
-                capture_points=(branch_at,), remat=remat,
+                args, n_mb, capture_points=(branch_at,), remat=remat,
             )
         else:
             bottom = jax.tree_util.tree_map(
@@ -477,12 +602,10 @@ class T5LM:
                 lambda x: x[branch_at:], params["decoder"]["blocks"]
             )
             h_branch, _ = self._scan(
-                self.dec_block, bottom, h, self_bias, encoder_hidden, cross_bias,
-                remat=remat,
+                self.dec_block, bottom, h, *args, remat=remat,
             )
             h_top, _ = self._scan(
-                self.dec_block, top, h_branch, self_bias, encoder_hidden, cross_bias,
-                remat=remat,
+                self.dec_block, top, h_branch, *args, remat=remat,
             )
         hidden = self.norm.apply({"params": params["decoder"]["ln_f"]}, h_top)
         return {
@@ -491,6 +614,9 @@ class T5LM:
             "branch_hidden": h_branch,
             "self_bias": self_bias,
             "cross_bias": cross_bias,
+            "pos_bias": pos_bias,
+            "skey_mask": skey_mask,
+            "ckey_mask": ckey_mask,
             "encoder_hidden": encoder_hidden,
         }
 
@@ -498,16 +624,22 @@ class T5LM:
         self,
         branch_params: Dict,
         branch_hidden: Array,
-        self_bias: Array,
+        self_bias: Optional[Array],
         encoder_hidden: Array,
-        cross_bias: Array,
+        cross_bias: Optional[Array],
         remat=False,
         compute_logits: bool = True,
+        pos_bias: Optional[Array] = None,
+        skey_mask: Optional[Array] = None,
+        ckey_mask: Optional[Array] = None,
     ) -> Dict[str, Array]:
-        """Run a frozen top-k decoder branch from a captured hidden state."""
+        """Run a frozen top-k decoder branch from a captured hidden state.
+        Under the pallas path the combined biases are None and the
+        structured (pos_bias, key-mask) pieces ride instead."""
         h, _ = self._scan(
             self.dec_block, branch_params["blocks"], branch_hidden, self_bias,
-            encoder_hidden, cross_bias, remat=remat,
+            encoder_hidden, cross_bias, pos_bias, skey_mask, ckey_mask,
+            remat=remat,
         )
         hidden = self.norm.apply({"params": branch_params["ln_f"]}, h)
         return {

@@ -373,6 +373,9 @@ class Seq2SeqLMWithValueHead:
                 out["cross_bias"],
                 remat=remat,
                 compute_logits=compute_logits,
+                pos_bias=out.get("pos_bias"),
+                skey_mask=out.get("skey_mask"),
+                ckey_mask=out.get("ckey_mask"),
             )
         return dict(
             out,

@@ -438,3 +438,342 @@ def _bwd(causal, sm_scale, block_q, q_offset, res, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# Bias-carrying variant (T5 relative position bias).
+#
+# T5 self-attention adds a LEARNED additive bias to the scores (and uses
+# no 1/sqrt(d) scale). The bias is batch-invariant ([H, T, S]) and shared
+# across the layer stack, so it is materialized ONCE per forward while
+# the per-layer [B, H, T, S] score/probability tensors still never
+# exist — the structural memory win stands. The backward returns dbias
+# (= ds summed over batch, accumulated in-kernel across the grid's
+# batch-innermost axis), so the rel_bias table trains exactly as on the
+# XLA path. Scale note: the dense bias costs T*S fp32 once (2 GB/head-8
+# at 32k) — beyond that, recomputing buckets in-kernel from the tiny
+# [n_buckets, H] table (Toeplitz structure) is the planned follow-up.
+# No GQA here (T5 has none): Hkv must equal H.
+# ---------------------------------------------------------------------------
+
+
+def _flash_bias_kernel(
+    q_ref, k_ref, v_ref, mask_ref, bias_ref, o_ref, m_ref, l_ref,
+    *, sm_scale, causal, n_chunks, ck,
+):
+    bq = q_ref.shape[1]
+    D = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)
+    row0 = pl.program_id(1) * bq
+
+    def body(j, carry):
+        o_acc, m_run, l_run = carry
+        k_c = k_ref[0, pl.ds(j * ck, ck), :].astype(jnp.float32)
+        v_c = v_ref[0, pl.ds(j * ck, ck), :].astype(jnp.float32)
+        mk = mask_ref[0, 0, pl.ds(j * ck, ck)]
+        s = jax.lax.dot_general(
+            q, k_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        s = s + bias_ref[0, :, pl.ds(j * ck, ck)]
+        valid = _tile_valid(bq, ck, row0, j * ck, causal) & (mk[None, :] > 0)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_run - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_run * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o_acc * corr + jax.lax.dot_general(
+            p, v_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((bq, D), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_chunks, body, (o0, m0, l0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m_ref[0] = m
+    l_ref[0] = l
+
+
+def _bias_block_q(block_q: int, S: int) -> int:
+    """Query block for the bias variants, shrunk with key length: each
+    grid cell holds a [bq, S] fp32 bias strip (and the dq kernel a
+    second [bq, S] dbias block) in VMEM, so bq must scale down as S
+    grows — [128, 8192] alone is 4 MB and measured over the 16 MB
+    scoped-vmem limit at 8k with the rest of the working set (double
+    -buffered strips + the chunk loop's score tiles); 1 MB strips
+    (bq=32 at 8k) fit with headroom."""
+    return min(block_q, max(8, (1 << 20) // (4 * S)))
+
+
+def _flash_bias_forward(q, k, v, key_mask, bias, causal, sm_scale, block_q,
+                        with_stats=False):
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    if k.shape[1] != H:
+        raise ValueError("flash_attention_bias does not support GQA")
+    if key_mask is None:
+        key_mask = jnp.ones((B, S), jnp.int32)
+    bq = _pick_block(T, _bias_block_q(block_q, S))
+    ck = _pick_block(S, CHUNK)
+    grid = (B * H, T // bq)
+    kernel = functools.partial(
+        _flash_bias_kernel, sm_scale=sm_scale, causal=causal,
+        n_chunks=S // ck, ck=ck,
+    )
+    out, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh // H, 0, 0)),
+            # bias strip [bq, S] fp32 in VMEM — the reason the bias
+            # variants default to block_q=128 (4 MB at 8k)
+            pl.BlockSpec((1, bq, S), lambda bh, qi: (bh % H, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(
+        q.reshape(B * H, T, D), k.reshape(B * H, S, D), v.reshape(B * H, S, D),
+        key_mask.astype(jnp.int32)[:, None, :], bias.astype(jnp.float32),
+    )
+    out = out.reshape(B, H, T, D)
+    if with_stats:
+        return out, m, l
+    return out
+
+
+def _dq_dbias_kernel(
+    q_ref, k_ref, v_ref, mask_ref, bias_ref, do_ref, m_ref, l_ref, delta_ref,
+    dq_ref, dbias_ref, *, sm_scale, causal, n_chunks, ck,
+):
+    """dq for one (head, q-block, batch) cell + dbias accumulated across
+    the batch-innermost grid axis (consecutive revisits of the same
+    output block, so pallas keeps it resident and flushes once)."""
+    bq = q_ref.shape[1]
+    D = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    m = m_ref[0]
+    l = jnp.maximum(l_ref[0], 1e-30)
+    delta = delta_ref[0]
+    row0 = pl.program_id(1) * bq
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        dbias_ref[...] = jnp.zeros_like(dbias_ref)
+
+    def body(j, dq_acc):
+        k_c = k_ref[0, pl.ds(j * ck, ck), :].astype(jnp.float32)
+        v_c = v_ref[0, pl.ds(j * ck, ck), :].astype(jnp.float32)
+        mk = mask_ref[0, 0, pl.ds(j * ck, ck)]
+        s = jax.lax.dot_general(
+            q, k_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        s = s + bias_ref[0, :, pl.ds(j * ck, ck)]
+        valid = _tile_valid(bq, ck, row0, j * ck, causal) & (mk[None, :] > 0)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - m) / l
+        dp = jax.lax.dot_general(
+            do, v_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = jnp.where(valid, p * (dp - delta), 0.0)  # d(score+bias)
+        dbias_ref[0, :, pl.ds(j * ck, ck)] += ds
+        return dq_acc + sm_scale * jax.lax.dot_general(
+            ds, k_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, n_chunks, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_bias_kernel(
+    q_ref, k_ref, v_ref, mask_ref, biasT_ref, do_ref, m_ref, l_ref, delta_ref,
+    dk_ref, dv_ref, *, sm_scale, causal, cq,
+):
+    """dk/dv for one (head, key-block) pair, transposed orientation (see
+    _dkv_kernel). Unlike the causal kernel, the q dimension is a GRID
+    axis (innermost), not an in-kernel loop: the [bk, T] biasT strip the
+    loop form needs in VMEM is 4 MB at 8k (measured over the scoped
+    limit), while grid-blocked [bk, cq] bias tiles stay ~256 KB. dk/dv
+    accumulate fp32 across the consecutive q-chunk revisits."""
+    bk = k_ref.shape[1]
+    j = pl.program_id(2)
+    col0 = pl.program_id(1) * bk
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    mk = mask_ref[0, 0, pl.ds(col0, bk)]
+    q_c = q_ref[0].astype(jnp.float32)  # [cq, D]
+    do_c = do_ref[0].astype(jnp.float32)
+    m_c = m_ref[0, 0]  # [cq]
+    l_c = jnp.maximum(l_ref[0, 0], 1e-30)
+    delta_c = delta_ref[0, 0]
+
+    @pl.when(j == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    s_t = jax.lax.dot_general(
+        k, q_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    s_t = s_t + biasT_ref[0]
+    rows = col0 + jax.lax.broadcasted_iota(jnp.int32, (bk, cq), 0)
+    cols = j * cq + jax.lax.broadcasted_iota(jnp.int32, (bk, cq), 1)
+    valid = (cols >= rows) if causal else jnp.ones((bk, cq), jnp.bool_)
+    valid = valid & (mk[:, None] > 0)
+    s_t = jnp.where(valid, s_t, NEG_INF)
+    p_t = jnp.exp(s_t - m_c[None, :]) / l_c[None, :]
+    dv_ref[0] += jax.lax.dot_general(
+        p_t, do_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp_t = jax.lax.dot_general(
+        v, do_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds_t = jnp.where(valid, p_t * (dp_t - delta_c[None, :]), 0.0)
+    dk_ref[0] += sm_scale * jax.lax.dot_general(
+        ds_t, q_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _flash_bias_backward(q, k, v, key_mask, bias, o, m, l, g, causal,
+                         sm_scale, block_q):
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    if key_mask is None:
+        key_mask = jnp.ones((B, S), jnp.int32)
+    mask3 = key_mask.astype(jnp.int32)[:, None, :]
+    bias32 = bias.astype(jnp.float32)
+
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+    dor = g.reshape(B * H, T, D)
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * o.reshape(B * H, T, D).astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+
+    bq = _pick_block(T, _bias_block_q(block_q, S))
+    ck = _pick_block(S, CHUNK)
+    # batch INNERMOST so the dbias output block (h, qi) is revisited on
+    # consecutive grid steps, accumulating the sum over batch in VMEM
+    dq, dbias = pl.pallas_call(
+        functools.partial(
+            _dq_dbias_kernel, sm_scale=sm_scale, causal=causal,
+            n_chunks=S // ck, ck=ck,
+        ),
+        grid=(H, T // bq, B),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda h, qi, b: (b * H + h, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda h, qi, b: (b * H + h, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda h, qi, b: (b * H + h, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda h, qi, b: (b, 0, 0)),
+            pl.BlockSpec((1, bq, S), lambda h, qi, b: (h, qi, 0)),
+            pl.BlockSpec((1, bq, D), lambda h, qi, b: (b * H + h, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, qi, b: (b * H + h, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, qi, b: (b * H + h, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, qi, b: (b * H + h, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda h, qi, b: (b * H + h, qi, 0)),
+            pl.BlockSpec((1, bq, S), lambda h, qi, b: (h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((H, T, S), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qr, kr, vr, mask3, bias32, dor, m, l, delta)
+
+    # key blocks stay at 128: the kernel's mask slice pl.ds(ki*bk, bk)
+    # must be statically provable as 128-aligned (Mosaic requirement on
+    # dynamic lane-dim indices); q-chunks are an innermost GRID axis so
+    # bias rides in [bk, cq] tiles (see _dkv_bias_kernel docstring)
+    bk = _pick_block(S, 128)
+    cq = _pick_block(T, CHUNK)
+    biasT = bias32.transpose(0, 2, 1)  # [H, S, T] for lane-major tiles
+    m_t = m.reshape(B * H, 1, T)
+    l_t = l.reshape(B * H, 1, T)
+    delta_t = delta.reshape(B * H, 1, T)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_bias_kernel, sm_scale=sm_scale, causal=causal, cq=cq,
+        ),
+        grid=(B * H, S // bk, T // cq),
+        in_specs=[
+            pl.BlockSpec((1, cq, D), lambda bh, ki, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, j: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, j: (bh, ki, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki, j: (bh // H, 0, 0)),
+            pl.BlockSpec((1, bk, cq), lambda bh, ki, j: (bh % H, ki, j)),
+            pl.BlockSpec((1, cq, D), lambda bh, ki, j: (bh, j, 0)),
+            pl.BlockSpec((1, 1, cq), lambda bh, ki, j: (bh, 0, j)),
+            pl.BlockSpec((1, 1, cq), lambda bh, ki, j: (bh, 0, j)),
+            pl.BlockSpec((1, 1, cq), lambda bh, ki, j: (bh, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, ki, j: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, j: (bh, ki, 0)),
+        ],
+        out_shape=[
+            # fp32: dk/dv accumulate across q-chunk grid revisits
+            jax.ShapeDtypeStruct((B * H, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, S, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qr, kr, vr, mask3, biasT, dor, m_t, l_t, delta_t)
+
+    return (
+        dq.reshape(B, H, T, D),
+        dk.reshape(B, H, S, D).astype(k.dtype),
+        dv.reshape(B, H, S, D).astype(v.dtype),
+        dbias.astype(bias.dtype),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_attention_bias(q, k, v, key_mask, bias, causal=False,
+                         sm_scale=1.0, block_q=128):
+    """Fused attention with a learned additive bias (T5 relative
+    position bias). q/k/v: [B, H, T|S, D] (no GQA); key_mask: [B, S];
+    bias: [H, T, S], batch-invariant and DIFFERENTIABLE (the backward
+    returns its gradient summed over batch). T5 semantics: sm_scale
+    defaults to 1.0 (the scale is folded into T5's init), causality is
+    optional (encoder False / decoder True), queries are assumed
+    unpadded full-sequence (T == S layouts)."""
+    return _flash_bias_forward(q, k, v, key_mask, bias, causal, sm_scale,
+                               block_q)
+
+
+def _bias_fwd(q, k, v, key_mask, bias, causal, sm_scale, block_q):
+    out, m, l = _flash_bias_forward(
+        q, k, v, key_mask, bias, causal, sm_scale, block_q, with_stats=True
+    )
+    out = checkpoint_name(out, "flash_out")
+    m = checkpoint_name(m, "flash_m")
+    l = checkpoint_name(l, "flash_l")
+    return out, (q, k, v, key_mask, bias, out, m, l)
+
+
+def _bias_bwd(causal, sm_scale, block_q, res, g):
+    q, k, v, key_mask, bias, o, m, l = res
+    dq, dk, dv, dbias = _flash_bias_backward(
+        q, k, v, key_mask, bias, o, m, l, g, causal, sm_scale, block_q
+    )
+    return dq, dk, dv, None, dbias
+
+
+flash_attention_bias.defvjp(_bias_fwd, _bias_bwd)

@@ -265,7 +265,8 @@ class TPUPPOTrainer(TPUBaseTrainer):
     def _get_experience_fn(self, P: int, N: int, S: int):
         """Jitted score+assemble step: teacher-forced policy/ref/value
         forward, per-token KL penalty, terminal (or dense) reward add."""
-        key = (P, N, S)
+        # logit_chunks is baked into the traced fn: it keys the cache
+        key = (P, N, S, self.config.train.logit_chunks)
         if key in self._experience_fns:
             return self._experience_fns[key]
         model = self.model
@@ -348,7 +349,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
         so the heaviest rollout compute overlaps decode + reward_fn — with
         a slow reward model the whole forward hides under scoring. The
         score half is `_get_score_inject_fn`."""
-        key = ("fwd", P, N)
+        key = ("fwd", P, N, self.config.train.logit_chunks)
         if key in self._experience_fns:
             return self._experience_fns[key]
         model = self.model
