@@ -47,6 +47,21 @@ BASELINE_CACHE = os.path.join(REPO, ".bench_baseline.json")
 PEAK_TFLOPS = {"TPU v4": 275.0, "TPU v5 lite": 197.0, "TPU v5": 459.0, "TPU v6 lite": 918.0}
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache (verified to work through the
+    remote-compile tunnel): at 1.3B the sampler/experience/train-step
+    compiles dominate the bench's wall clock (~7 of 9 minutes cold);
+    warm, every section fits the driver budget with minutes to spare.
+    Keyed by HLO hash, so code changes invalidate safely."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/trlx_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass  # older jax without the knobs: cold compiles, same results
+
+
 def chip_peak_tflops() -> float:
     import jax
 
@@ -131,6 +146,7 @@ PROMPTS = [
 
 
 def bench_tpu() -> tuple:
+    _enable_compile_cache()
     import jax
 
     import trlx_tpu
@@ -276,6 +292,7 @@ def bench_large_ppo() -> dict:
     remat recompute; `large_train_mfu` books the train phase alone so it
     stays comparable with round 3's train-step number.
     """
+    _enable_compile_cache()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -404,6 +421,7 @@ def bench_large_gen() -> dict:
     case (no duplicate weights copy); from fp32 masters the copy costs
     +`large_gen_weights_copy_gb` of HBM for the rollout's duration
     (docs/benchmarks.md has the decode memory budget)."""
+    _enable_compile_cache()
     import jax
     import jax.numpy as jnp
 
@@ -494,6 +512,7 @@ def bench_longctx() -> dict:
     long-context training is only practical through it. The full-model
     comparison is therefore run pallas-only and the XLA contrast is
     measured at the attention-op level where it stays cheap."""
+    _enable_compile_cache()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -630,11 +649,21 @@ def bench_randomwalks() -> dict:
             }
         )
         results = trainer.evaluate()
-    return {
+    out = {
         f"randomwalks_optimality_{steps}steps": round(
             float(results["metrics/optimality"]), 4
         )
     }
+    # diff against the committed full-curve artifact (the reference's
+    # curve-parity protocol, ref trlx/reference.py): report the recorded
+    # final optimality alongside, so regressions against the in-repo
+    # curve are visible in one JSON line
+    curve_fp = os.path.join(REPO, "docs", "curves", "randomwalks_ppo.jsonl")
+    if os.path.exists(curve_fp):
+        with open(curve_fp) as f:
+            meta = json.loads(f.readline())["meta"]
+        out["randomwalks_recorded_final_optimality"] = meta["final_optimality"]
+    return out
 
 
 def bench_torch_cpu() -> float:

@@ -62,5 +62,11 @@ def test_ppo_sentiments_smoke_executes(tmp_path, monkeypatch):
     import examples.ppo_sentiments as mod
 
     mod = importlib.reload(mod)  # re-evaluate the SMOKE flag
-    trainer = mod.main({"train.checkpoint_dir": str(tmp_path / "ckpts")})
-    assert trainer.iter_count == 2
+    try:
+        trainer = mod.main({"train.checkpoint_dir": str(tmp_path / "ckpts")})
+        assert trainer.iter_count == 2
+    finally:
+        # un-bake SMOKE from module state: later tests importing this
+        # module must see the real (non-smoke) path again
+        monkeypatch.delenv("SMOKE")
+        importlib.reload(mod)
