@@ -203,7 +203,13 @@ class T5Attention(nn.Module):
             v_all = jax.lax.dynamic_update_slice(
                 cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0)
             )
-            new_kv = {"k": k_all, "v": v_all}
+            # new COLUMNS only: T5LM._scan carries the cache and writes
+            # them in place (same decode-bandwidth fix as the causal
+            # stack — see TransformerLM Attention)
+            new_kv = {
+                "k": k.astype(cache["k"].dtype),
+                "v": v.astype(cache["v"].dtype),
+            }
             k, v = k_all.astype(cfg.dtype), v_all.astype(cfg.dtype)
 
         if bias is None:
@@ -369,25 +375,44 @@ class T5LM:
 
     def _scan(self, block: nn.Module, stacked: Dict, h: Array, *args, cache=None,
               remat=False):
-        def body(hidden, layer):
+        """Cache path mirrors TransformerLM._scan_blocks: the [L, ...]
+        cache buffers are CARRIED and each layer writes only its new
+        column in place (stacking full updated buffers as scan ys
+        rewrites the whole cache every decode step)."""
+        def body(carry, layer):
             if cache is not None:
-                lp, layer_kv = layer
-                layer_cache = dict(layer_kv, index=cache["index"])
+                hidden, ck, cv = carry
+                lp, ix = layer
+                layer_cache = {
+                    "k": jax.lax.dynamic_index_in_dim(ck, ix, 0, keepdims=False),
+                    "v": jax.lax.dynamic_index_in_dim(cv, ix, 0, keepdims=False),
+                    "index": cache["index"],
+                }
             else:
-                lp, layer_cache = layer, None
+                hidden, lp, layer_cache = carry, layer, None
             out, new_kv = block.apply({"params": lp}, hidden, *args, cache=layer_cache)
-            return out, new_kv
+            if cache is not None:
+                idx = cache["index"]
+                ck = jax.lax.dynamic_update_slice(
+                    ck, new_kv["k"][None], (ix, 0, idx, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cv, new_kv["v"][None], (ix, 0, idx, 0, 0)
+                )
+                return (out, ck, cv), None
+            return out, None
 
         if cache is None:
             from trlx_tpu.ops.remat import wrap_remat
 
             body = wrap_remat(body, remat)
-        xs = (stacked, {"k": cache["k"], "v": cache["v"]}) if cache is not None else stacked
-        h, new_kvs = jax.lax.scan(body, h, xs)
-        new_cache = None
-        if cache is not None:
-            new_cache = dict(new_kvs, index=cache["index"] + 1)
-        return h, new_cache
+            h, _ = jax.lax.scan(body, h, stacked)
+            return h, None
+        n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+        (h, ck, cv), _ = jax.lax.scan(
+            body, (h, cache["k"], cache["v"]), (stacked, jnp.arange(n))
+        )
+        return h, dict(k=ck, v=cv, index=cache["index"] + 1)
 
     def _pp_microbatches(self, n_layer: int, batch: int) -> int:
         """Microbatch count for a pipelined stack, or 0 for the
