@@ -777,10 +777,12 @@ def main():
     # generation primitives
     if os.environ.get("BENCH_LARGE", "1") != "0":
         extras.update(_run_section("large_ppo", "bench_large_ppo", deadline))
-    if os.environ.get("BENCH_LARGE_GEN", "1") != "0":
-        extras.update(_run_section("large_gen", "bench_large_gen", deadline))
+    # longctx before large_gen: if a cold compile cache starves the tail
+    # of the budget, the T5/8k rows (a round deliverable) win the race
     if os.environ.get("BENCH_LONGCTX", "1") != "0":
         extras.update(_run_section("longctx", "bench_longctx", deadline))
+    if os.environ.get("BENCH_LARGE_GEN", "1") != "0":
+        extras.update(_run_section("large_gen", "bench_large_gen", deadline))
 
     # opt-in (BENCH_RANDOMWALKS=1): ~4.5 min of BC warmup + PPO on the
     # real randomwalks task — learning-quality evidence (measured
