@@ -89,6 +89,9 @@ def test_sft_learn(tmp_path):
 
 @pytest.mark.slow
 def test_ilql_learn(tmp_path):
+    # beta as a LIST: evaluate() sweeps the advantage-shaping strength
+    # per value (the reference's gen-kwarg sweep over modeling_ilql.py's
+    # generate(beta=...)), emitting `@beta=...`-suffixed metric keys
     config = default_ilql_config().evolve(
         train=dict(
             batch_size=8, total_steps=2, eval_interval=10, checkpoint_interval=10,
@@ -99,13 +102,19 @@ def test_ilql_learn(tmp_path):
         tokenizer=dict(tokenizer_path="byte"),
         method=dict(
             steps_for_target_q_sync=1,
-            gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0),
+            gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=[0.5, 2.0]),
         ),
     )
     samples = [("q", "good"), ("q", "bad"), ("p", "fine"), ("p", "meh")] * 4
     rewards = [1.0, -1.0, 0.5, -0.5] * 4
     trainer = trlx_tpu.train(samples=samples, rewards=rewards, config=config)
     assert trainer.iter_count == 2
+    stats = trainer.evaluate()
+    assert "metrics/is_valid@beta=0.5" not in stats  # no metric_fn wired
+    assert "reward/mean@beta=0.5" not in stats  # no reward_fn either
+    # the sampler ran once per swept beta (distinct compiled variants)
+    swept = {pk for (_, _, pk) in trainer._generate_fns}
+    assert (("beta", 0.5),) in swept and (("beta", 2.0),) in swept
 
 
 @pytest.mark.slow

@@ -25,6 +25,7 @@ TPU re-design:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 from abc import abstractmethod
@@ -437,8 +438,13 @@ class TPUBaseTrainer(BaseRLTrainer):
     def _lm(self) -> TransformerLM:
         return self.model.lm
 
-    def _get_generate_fn(self, settings: SamplerSettings, shape: Tuple[int, int]):
-        key = (settings, shape)
+    def _get_generate_fn(
+        self,
+        settings: SamplerSettings,
+        shape: Tuple[int, int],
+        proc_kwargs: Tuple = (),
+    ):
+        key = (settings, shape, proc_kwargs)
         if key not in self._generate_fns:
             lm = self._lm()
             make_processor = self.generation_logits_processor
@@ -459,11 +465,14 @@ class TPUBaseTrainer(BaseRLTrainer):
 
                     return generate_seq2seq(
                         lm, base, input_ids, attention_mask, rng,
-                        settings, logits_processor=make_processor(params),
+                        settings,
+                        logits_processor=make_processor(
+                            params, **dict(proc_kwargs)
+                        ),
                     )
                 return generate(
                     lm, base, input_ids, attention_mask, rng, settings,
-                    logits_processor=make_processor(params),
+                    logits_processor=make_processor(params, **dict(proc_kwargs)),
                     soft_prompt=(
                         params["prompt"]["embedding"] if "prompt" in params else None
                     ),
@@ -474,13 +483,47 @@ class TPUBaseTrainer(BaseRLTrainer):
         return self._generate_fns[key]
 
     def generation_logits_processor(self, params):
-        """Optional logits hook for sampling, given the full param tree."""
+        """Optional logits hook for sampling, given the full param tree.
+
+        Swept gen_kwargs that aren't `SamplerSettings` fields (e.g.
+        ILQL's `beta`) arrive here as keyword arguments, so subclasses
+        declare the ones they consume; `generate()` rejects names no
+        processor parameter matches (the reference delegates the same
+        validation to HF `generate`'s kwarg checking)."""
         return None
 
     def generate(self, input_ids, attention_mask=None, settings=None, **kwargs):
         """Sample continuations for experience collection (parity:
         reference generate/generate_eval :256-288)."""
         settings = settings or self.generate_experience_settings
+        # kwargs the sampler doesn't implement belong to the logits
+        # processor (the reference hands them to the model's custom
+        # generate the same way, e.g. ILQL beta — ref modeling_ilql.py
+        # generate(beta=...)); they key the compiled-fn cache because the
+        # processor bakes them into the traced computation. Names neither
+        # side declares are an error, not a silent drop (HF generate
+        # validates its kwargs the same way).
+        import inspect
+
+        sampler_fields = {f.name for f in dataclasses.fields(SamplerSettings)}
+        proc_fields = {
+            name
+            for name, p in inspect.signature(
+                self.generation_logits_processor
+            ).parameters.items()
+            if name != "params" and p.kind is not inspect.Parameter.VAR_KEYWORD
+        }
+        unknown = set(kwargs) - sampler_fields - proc_fields
+        if unknown:
+            raise TypeError(
+                f"generate() got kwargs {sorted(unknown)} that neither "
+                f"SamplerSettings nor {type(self).__name__}."
+                "generation_logits_processor accepts"
+            )
+        proc_kwargs = tuple(
+            sorted((k, v) for k, v in kwargs.items() if k in proc_fields)
+        )
+        kwargs = {k: v for k, v in kwargs.items() if k in sampler_fields}
         if kwargs:
             settings = SamplerSettings.from_gen_kwargs(
                 {**settings.__dict__, **kwargs}
@@ -500,8 +543,9 @@ class TPUBaseTrainer(BaseRLTrainer):
         # cache keys hold GLOBAL row counts; compare in local terms
         compiled = [
             shape[0] // pc
-            for (s, shape) in self._generate_fns
-            if s == settings and shape[1] == P and shape[0] // pc >= target
+            for (s, shape, pk) in self._generate_fns
+            if s == settings and pk == proc_kwargs
+            and shape[1] == P and shape[0] // pc >= target
         ]
         if compiled:
             target = min(compiled)
@@ -520,7 +564,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             # generate fns trace over GLOBAL row counts: shape keys are
             # the global batch shape
             gshape = (input_ids.shape[0] * pc, input_ids.shape[1])
-            fn = self._get_generate_fn(settings, gshape)
+            fn = self._get_generate_fn(settings, gshape, proc_kwargs)
             self.rng, key = jax.random.split(self.rng)
             sharding = data_sharding(self.mesh)
             device_mask = mh.global_from_local(attention_mask, sharding)

@@ -202,9 +202,18 @@ class TPUILQLTrainer(TPUBaseTrainer):
             awac_scale=method.awac_scale, beta=method.beta, two_qs=method.two_qs,
         )
 
-    def generation_logits_processor(self, params):
-        beta = float(self.config.method.gen_kwargs.get("beta", 1.0))
-        return self.model.make_logits_processor(params["heads"], beta)
+    def generation_logits_processor(self, params, beta=None):
+        """`beta` arrives per-call when evaluate() sweeps `gen_kwargs.beta`
+        over a list (the reference's gen-kwarg sweep protocol, ref
+        accelerate_base_trainer.py:339-505 / modeling_ilql.py generate);
+        otherwise the config scalar applies."""
+        if beta is None:
+            beta = self.config.method.gen_kwargs.get("beta", 1.0)
+            if isinstance(beta, (list, tuple)):
+                # sweep-shaped config reached a non-sweeping call site
+                # (e.g. experience generation): shape with the first value
+                beta = beta[0]
+        return self.model.make_logits_processor(params["heads"], float(beta))
 
     def make_experience(self, samples, rewards, seq_length: int = 1024) -> None:
         if self.seq2seq:
