@@ -654,15 +654,24 @@ def bench_randomwalks() -> dict:
             float(results["metrics/optimality"]), 4
         )
     }
-    # diff against the committed full-curve artifact (the reference's
+    # diff against the committed full-curve artifacts (the reference's
     # curve-parity protocol, ref trlx/reference.py): report the recorded
     # final optimality alongside, so regressions against the in-repo
-    # curve are visible in one JSON line
-    curve_fp = os.path.join(REPO, "docs", "curves", "randomwalks_ppo.jsonl")
-    if os.path.exists(curve_fp):
-        with open(curve_fp) as f:
-            meta = json.loads(f.readline())["meta"]
-        out["randomwalks_recorded_final_optimality"] = meta["final_optimality"]
+    # curves are visible in one JSON line. ILQL is echo-only (no fresh
+    # ILQL run here); the fresh measurement above is PPO.
+    for fname, meta_key, out_key in [
+        ("randomwalks_ppo.jsonl", "final_optimality",
+         "randomwalks_recorded_final_optimality"),
+        ("randomwalks_ilql.jsonl", "final_optimality@beta=100",
+         "randomwalks_ilql_recorded_final_optimality"),
+    ]:
+        fp = os.path.join(REPO, "docs", "curves", fname)
+        if os.path.exists(fp):
+            with open(fp) as f:
+                meta = json.loads(f.readline())["meta"]
+            val = meta.get(meta_key)
+            if val is not None:
+                out[out_key] = val
     return out
 
 
