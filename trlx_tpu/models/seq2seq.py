@@ -196,21 +196,23 @@ class T5Attention(nn.Module):
 
         new_kv = None
         if cache is not None:
+            # update-carry-first, same as the causal stack (rationale and
+            # measured design history in TransformerLM Attention): write
+            # this layer's new column into the scan-carried stacked
+            # buffer, then attend against a slice of the UPDATED buffer —
+            # one full cache read + one column write per step, no
+            # per-layer updated-row copy
             idx = cache["index"]
-            k_all = jax.lax.dynamic_update_slice(
-                cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0)
+            ix = cache["ix"]
+            ck = jax.lax.dynamic_update_slice(
+                cache["ck"], k[None].astype(cache["ck"].dtype), (ix, 0, idx, 0, 0)
             )
-            v_all = jax.lax.dynamic_update_slice(
-                cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0)
+            cv = jax.lax.dynamic_update_slice(
+                cache["cv"], v[None].astype(cache["cv"].dtype), (ix, 0, idx, 0, 0)
             )
-            # new COLUMNS only: T5LM._scan carries the cache and writes
-            # them in place (same decode-bandwidth fix as the causal
-            # stack — see TransformerLM Attention)
-            new_kv = {
-                "k": k.astype(cache["k"].dtype),
-                "v": v.astype(cache["v"].dtype),
-            }
-            k, v = k_all.astype(cfg.dtype), v_all.astype(cfg.dtype)
+            new_kv = {"ck": ck, "cv": cv}
+            k = jax.lax.dynamic_index_in_dim(ck, ix, 0, keepdims=False).astype(cfg.dtype)
+            v = jax.lax.dynamic_index_in_dim(cv, ix, 0, keepdims=False).astype(cfg.dtype)
 
         if bias is None:
             # fused path (NOTE: T5 has no 1/sqrt(d) — sm_scale=1.0):
@@ -376,30 +378,25 @@ class T5LM:
     def _scan(self, block: nn.Module, stacked: Dict, h: Array, *args, cache=None,
               remat=False):
         """Cache path mirrors TransformerLM._scan_blocks: the [L, ...]
-        cache buffers are CARRIED and each layer writes only its new
-        column in place (stacking full updated buffers as scan ys
-        rewrites the whole cache every decode step)."""
+        cache buffers are CARRIED and each layer's attention writes its
+        new column in place then attends against a slice of the updated
+        buffer (update-carry-first; design history in TransformerLM
+        Attention)."""
         def body(carry, layer):
             if cache is not None:
                 hidden, ck, cv = carry
                 lp, ix = layer
                 layer_cache = {
-                    "k": jax.lax.dynamic_index_in_dim(ck, ix, 0, keepdims=False),
-                    "v": jax.lax.dynamic_index_in_dim(cv, ix, 0, keepdims=False),
+                    "ck": ck,
+                    "cv": cv,
+                    "ix": ix,
                     "index": cache["index"],
                 }
             else:
                 hidden, lp, layer_cache = carry, layer, None
             out, new_kv = block.apply({"params": lp}, hidden, *args, cache=layer_cache)
             if cache is not None:
-                idx = cache["index"]
-                ck = jax.lax.dynamic_update_slice(
-                    ck, new_kv["k"][None], (ix, 0, idx, 0, 0)
-                )
-                cv = jax.lax.dynamic_update_slice(
-                    cv, new_kv["v"][None], (ix, 0, idx, 0, 0)
-                )
-                return (out, ck, cv), None
+                return (out, new_kv["ck"], new_kv["cv"]), None
             return out, None
 
         if cache is None:

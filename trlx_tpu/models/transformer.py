@@ -206,7 +206,7 @@ class Attention(nn.Module):
         x: Array,  # [B, T, E]
         attn_bias: Array,  # [B, 1, T, S] additive fp32
         positions: Array,  # [B, T] absolute positions (for rope)
-        cache: Optional[Dict[str, Array]] = None,  # {"k","v"}: [B, S, Hkv, D], "index"
+        cache: Optional[Dict[str, Array]] = None,  # {"ck","cv"}: [L, B, S, Hkv, D], "ix", "index"
         key_mask: Optional[Array] = None,  # [B, T]; enables the pallas path
         ring_mesh=None,  # Mesh; non-None routes to ring attention over `sp`
     ) -> Tuple[Array, Optional[Dict[str, Array]]]:
@@ -233,29 +233,32 @@ class Attention(nn.Module):
 
         new_kv = None
         if cache is not None:
+            # update-carry-FIRST: write this layer's new [B, T, Hkv, D]
+            # column into the scan-carried stacked buffer, then attend
+            # against a slice of the UPDATED buffer. The column write
+            # aliases in place (the buffer is a scan carry) and the row
+            # slice is a read, so the only cache traffic per step is one
+            # full read + one column write. The previous design built a
+            # per-layer `dynamic_update_slice(row, col)` copy BEFORE the
+            # carry write — a second full-cache materialization costing
+            # 3.2 GB of extra HBM writes per decoded token at 1.3B,
+            # measured 13.6 vs 6.5 ms/step on the cache mechanics alone
+            # (v5e, 24L x b8 x 2048 slots). Two earlier designs were
+            # worse still: stacking full updated buffers as scan ys
+            # (rewrites the whole cache every token), and attending
+            # against the stale buffer + patching new-column scores
+            # (defeats XLA's in-place aliasing entirely, 15x slower).
             idx = cache["index"]
-            k_all = jax.lax.dynamic_update_slice(
-                cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0)
+            ix = cache["ix"]
+            ck = jax.lax.dynamic_update_slice(
+                cache["ck"], k[None].astype(cache["ck"].dtype), (ix, 0, idx, 0, 0)
             )
-            v_all = jax.lax.dynamic_update_slice(
-                cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0)
+            cv = jax.lax.dynamic_update_slice(
+                cache["cv"], v[None].astype(cache["cv"].dtype), (ix, 0, idx, 0, 0)
             )
-            # return only the NEW columns: the layer scan writes them
-            # into its carried cache at this layer's row. Returning the
-            # full updated buffers (the old design) made the scan's ys
-            # stacking rewrite the ENTIRE cache every decode step —
-            # 3.2 GB of HBM writes per token at 1.3B, the difference
-            # between decode being weight-bound or cache-write-bound.
-            # (Attending against the stale cache + patching new-column
-            # scores — skipping this k_all/v_all materialization — was
-            # tried and measured 15x SLOWER: the direct einsum read of
-            # the carried buffer defeats XLA's in-place aliasing of the
-            # column write, forcing a full-cache copy per layer.)
-            new_kv = {
-                "k": k.astype(cache["k"].dtype),
-                "v": v.astype(cache["v"].dtype),
-            }
-            k, v = k_all.astype(cfg.dtype), v_all.astype(cfg.dtype)
+            new_kv = {"ck": ck, "cv": cv}
+            k = jax.lax.dynamic_index_in_dim(ck, ix, 0, keepdims=False).astype(cfg.dtype)
+            v = jax.lax.dynamic_index_in_dim(cv, ix, 0, keepdims=False).astype(cfg.dtype)
 
         # the pallas kernel bakes in 1/sqrt(D) scaling and a plain
         # causal+padding mask; architectures with nonstandard scaling or
@@ -281,7 +284,7 @@ class Attention(nn.Module):
             cache is not None
             and T > 1
             and isinstance(cache.get("static_index"), int)
-            and cache["k"].shape[1] % 128 == 0
+            and cache["ck"].shape[2] % 128 == 0
             and T % 8 == 0
         ):
             prefill_offset = cache["static_index"]
@@ -724,21 +727,24 @@ class TransformerLM:
         per-layer attention kinds (gpt-neo global/local) line up.
 
         Cache path: the [L, B, S, Hkv, D] buffers are CARRIED through
-        the scan and each layer writes only its new [B, T, Hkv, D]
-        column in place. (The previous design threaded per-layer cache
-        slices as scan xs and stacked full updated buffers as ys —
-        correct, but the ys stacking rewrote the whole cache every
-        step: 3.2 GB of writes per decoded token at 1.3B.)"""
+        the scan; each layer's attention writes only its new
+        [B, T, Hkv, D] column in place and attends against a slice of
+        the updated buffer (update-carry-first — the full design
+        history and measured costs are in Attention.__call__)."""
         n = jax.tree_util.tree_leaves(block_params)[0].shape[0]
         flags = self._layer_flags(n, layer_offset)
 
         def body(carry, layer):
             if cache is not None:
                 hidden, ck, cv = carry
-                ix = layer["ix"]
+                # hand the attention the FULL carried buffers + this
+                # layer's row index: it writes its new column in place
+                # and attends against a slice of the updated buffer (the
+                # update-carry-first design; rationale in Attention)
                 layer_cache = {
-                    "k": jax.lax.dynamic_index_in_dim(ck, ix, 0, keepdims=False),
-                    "v": jax.lax.dynamic_index_in_dim(cv, ix, 0, keepdims=False),
+                    "ck": ck,
+                    "cv": cv,
+                    "ix": layer["ix"],
                     "index": cache["index"],
                 }
                 if "static_index" in cache:  # pallas prefill offset
@@ -755,14 +761,7 @@ class TransformerLM:
                 ring_mesh,
             )
             if cache is not None:
-                idx = cache["index"]
-                ck = jax.lax.dynamic_update_slice(
-                    ck, new_kv["k"][None], (ix, 0, idx, 0, 0)
-                )
-                cv = jax.lax.dynamic_update_slice(
-                    cv, new_kv["v"][None], (ix, 0, idx, 0, 0)
-                )
-                return (out, ck, cv), None
+                return (out, new_kv["ck"], new_kv["cv"]), None
             return out, None
 
         from trlx_tpu.ops.remat import wrap_remat
