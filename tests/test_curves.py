@@ -1,0 +1,56 @@
+"""Committed learning-curve artifacts (docs/curves/*.jsonl) keep the
+contract the bench and the branch-diff harness rely on: a meta first
+line with task/protocol/final-metric keys, then step-keyed numeric
+rows (parity: the reference's curve-parity protocol keeps these on
+W&B — ref trlx/reference.py; here they are in-repo artifacts)."""
+
+import glob
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CURVES = sorted(glob.glob(os.path.join(REPO, "docs", "curves", "*.jsonl")))
+
+
+def test_curves_exist():
+    names = {os.path.basename(p) for p in CURVES}
+    assert "randomwalks_ppo.jsonl" in names
+    assert "randomwalks_ilql.jsonl" in names
+
+
+@pytest.mark.parametrize("path", CURVES, ids=os.path.basename)
+def test_curve_contract(path):
+    with open(path) as f:
+        lines = f.read().splitlines()
+    meta = json.loads(lines[0])["meta"]
+    for key in ("task", "protocol", "hardware", "date", "reference_protocol"):
+        assert key in meta, f"{path}: meta missing {key!r}"
+    finals = [k for k in meta if k.startswith("final_")]
+    assert finals, f"{path}: meta has no final_* metric"
+    assert all(isinstance(meta[k], (int, float)) for k in finals)
+
+    steps = []
+    for line in lines[1:]:
+        rec = json.loads(line)
+        assert "step" in rec and len(rec) > 1, f"{path}: row without metrics"
+        assert all(
+            isinstance(v, (int, float)) for v in rec.values()
+        ), f"{path}: non-numeric row value"
+        steps.append(rec["step"])
+    assert steps == sorted(steps), f"{path}: steps not monotonic"
+
+
+def test_bench_reads_recorded_finals():
+    """The exact meta keys bench.bench_randomwalks echoes must resolve
+    in the committed artifacts (guards the KeyError class of regression
+    when a curve is re-recorded with a different sweep)."""
+    for fname, meta_key in [
+        ("randomwalks_ppo.jsonl", "final_optimality"),
+        ("randomwalks_ilql.jsonl", "final_optimality@beta=100"),
+    ]:
+        fp = os.path.join(REPO, "docs", "curves", fname)
+        with open(fp) as f:
+            meta = json.loads(f.readline())["meta"]
+        assert meta_key in meta, f"{fname}: bench echo key {meta_key!r} missing"
