@@ -428,7 +428,11 @@ def bench_large_gen() -> dict:
     import jax.numpy as jnp
 
     from trlx_tpu.models.generation import cast_params_for_decode
-    from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+    from trlx_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+        logit_projection,
+    )
 
     SEQ_L = LP + LN
     cfg = TransformerConfig(
@@ -457,8 +461,12 @@ def bench_large_gen() -> dict:
             [am, jnp.ones((LB, SEQ_L - LP), jnp.int32)], axis=1
         )
         cache = lm.init_cache(LB, SEQ_L, key_mask)  # static_index=0
-        out = lm(p, ids, am, cache=cache)
-        tok = jnp.argmax(out["logits"][:, -1], -1).astype(jnp.int32)
+        # mirror the sampler: only the last position's logits are ever
+        # sampled, so the [B, P, V] prefill logits never materialize
+        out = lm(p, ids, am, cache=cache, compute_logits=False)
+        tok = jnp.argmax(
+            logit_projection(p)(out["hidden_states"][:, -1]), -1
+        ).astype(jnp.int32)
         return tok, out["cache"]
 
     @jax.jit

@@ -30,7 +30,7 @@ from typing import Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from trlx_tpu.models.transformer import TransformerLM
+from trlx_tpu.models.transformer import TransformerLM, logit_projection
 from trlx_tpu.ops.common import topk_mask
 
 Array = jnp.ndarray
@@ -242,6 +242,7 @@ def generate(
             jnp.zeros((B, n_virt), input_ids.dtype),
             cache=cache,
             prefix_embeds=soft_prompt,
+            compute_logits=False,  # cache warm only; nothing samples here
         )
         # forwards drop the static index from the cache they return;
         # re-attach it so the main prefill keeps the pallas path
@@ -250,7 +251,14 @@ def generate(
     # real positions (rope/wpe) run over non-pad tokens only, offset past
     # any virtual prefix (HF past-length semantics)
     positions = n_virt + jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-    out = model(params, input_ids, attention_mask, positions=positions, cache=cache)
+    # compute_logits=False: only the LAST position samples, so the full
+    # [B, P, V] prefill logits (3.3 GB fp32 at b8/seq2048/vocab50257 —
+    # and ~7% of prefill FLOPs) are never materialized; the one needed
+    # row is projected from the final hidden below
+    out = model(
+        params, input_ids, attention_mask, positions=positions, cache=cache,
+        compute_logits=False,
+    )
     prompt_len = n_virt + attention_mask.sum(axis=1)  # [B] next real position
 
     def pick_next(rng, hidden_last, logits_last, finished):
@@ -263,9 +271,9 @@ def generate(
 
     rng, sub = jax.random.split(rng)
     finished0 = jnp.zeros((B,), bool)
-    tok0, finished0 = pick_next(
-        sub, out["hidden_states"][:, -1], out["logits"][:, -1], finished0
-    )
+    h_last = out["hidden_states"][:, -1]
+    logits_last = logit_projection(params)(h_last)
+    tok0, finished0 = pick_next(sub, h_last, logits_last, finished0)
 
     if N > 1:
         pos0 = prompt_len  # next token's real position
