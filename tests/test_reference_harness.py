@@ -1,0 +1,104 @@
+"""Branch-benchmark harness (trlx_tpu/reference.py — parity: ref
+trlx/reference.py's clone-branch-and-diff protocol) and the metric
+Tracker (utils/trackers.py — parity: accelerator.init_trackers/log)."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+from trlx_tpu.reference import run_ref
+
+
+def _repo_root():
+    return subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+
+
+def _head_is_committed():
+    try:
+        root = _repo_root()
+    except subprocess.CalledProcessError:
+        return False
+    return subprocess.run(
+        ["git", "rev-parse", "HEAD"], cwd=root, capture_output=True
+    ).returncode == 0
+
+
+@pytest.mark.skipif(not _head_is_committed(), reason="needs a git checkout")
+def test_run_ref_worktree_scrapes_last_json_line():
+    """run_ref checks the ref out into a temp worktree, runs the bench
+    command there, and returns the LAST parseable JSON line (log noise
+    above it must be ignored)."""
+    root = _repo_root()
+    before = subprocess.run(
+        ["git", "worktree", "list"], cwd=root, capture_output=True, text=True
+    ).stdout
+    cmd = (
+        "python -c \"print('warming up...'); print('not json'); "
+        "import json; print(json.dumps({'value': 42.5, 'metric': 'x'}))\""
+    )
+    out = run_ref(root, "HEAD", cmd)
+    assert out == {"value": 42.5, "metric": "x"}
+    # the temporary worktree must be gone afterwards
+    after = subprocess.run(
+        ["git", "worktree", "list"], cwd=root, capture_output=True, text=True
+    ).stdout
+    assert after == before
+
+
+@pytest.mark.skipif(not _head_is_committed(), reason="needs a git checkout")
+def test_run_ref_no_json_line_raises():
+    root = _repo_root()
+    with pytest.raises(RuntimeError, match="no JSON metric line"):
+        run_ref(root, "HEAD", "echo not-json-at-all")
+
+
+def _tiny_config(tmp_path, tracker):
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    return default_ppo_config().evolve(
+        train=dict(
+            tracker=tracker,
+            logging_dir=str(tmp_path / "logs"),
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            run_name="unit/run",
+        ),
+    )
+
+
+def test_tracker_jsonl_writes_scalars_only(tmp_path):
+    from trlx_tpu.utils.trackers import Tracker
+
+    tracker = Tracker(_tiny_config(tmp_path, "jsonl"))
+    tracker.log({"reward/mean": 1.5, "table": ["not", "scalar"], "n": 2}, step=3)
+    tracker.close()
+    recs = [
+        json.loads(line)
+        for line in open(os.path.join(str(tmp_path / "logs"), "metrics.jsonl"))
+    ]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["reward/mean"] == 1.5 and rec["n"] == 2.0 and rec["_step"] == 3
+    assert "table" not in rec  # non-numeric stats stay out of the jsonl
+
+
+def test_tracker_unknown_backend_raises(tmp_path):
+    from trlx_tpu.utils.trackers import Tracker
+
+    with pytest.raises(ValueError, match="unknown tracker"):
+        Tracker(_tiny_config(tmp_path, "no_such_backend"))
+
+
+def test_tracker_none_backend_still_writes_jsonl(tmp_path):
+    """tracker=None keeps the scrapeable jsonl (benchmark tooling
+    depends on it) without any backend."""
+    from trlx_tpu.utils.trackers import Tracker
+
+    tracker = Tracker(_tiny_config(tmp_path, None))
+    tracker.log({"a": 1.0}, step=0)
+    tracker.close()
+    assert os.path.exists(os.path.join(str(tmp_path / "logs"), "metrics.jsonl"))
