@@ -50,7 +50,13 @@ def main():
             kept = {k: round(v, 4) for k, v in rec.items() if keep(k)}
             if not kept:
                 continue
-            rows.append({"step": rec.get("_step", 0), **kept})
+            if "_step" not in rec:
+                # a non-Tracker jsonl row defaulting to step 0 mid-file
+                # would violate the monotonic-steps contract that
+                # tests/test_curves.py enforces only AFTER the artifact
+                # is committed — skip it at record time instead
+                continue
+            rows.append({"step": rec["_step"], **kept})
             fk = {
                 k: v for k, v in kept.items()
                 if k == args.final_key or k.startswith(args.final_key + "@")

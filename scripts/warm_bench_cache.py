@@ -1,0 +1,31 @@
+"""Warm the persistent XLA compile cache for every bench section.
+
+Run this AFTER the last code change that touches bench.py or any model
+code it drives: the compile-cache key covers the lowered module
+(including source locations of traced functions), so an edit to bench.py
+invalidates the entries its sections wrote. With a warm cache every
+bench section fits its reserved time slice with minutes to spare; cold,
+the 1.3B sections alone can blow the whole budget (the r04 failure
+mode — see bench.SECTIONS).
+
+Each section runs in its own process (same as bench.main) with a
+generous timeout, and results are printed so a warm run doubles as a
+sanity check of the numbers.
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+if __name__ == "__main__":
+    for name, fn_name, _reserve, gate in bench.SECTIONS:
+        if os.environ.get(gate, "1") == "0":
+            continue
+        t0 = time.time()
+        out = bench._run_section(name, fn_name, timeout_s=1200)
+        print(f"warm[{name}] {time.time() - t0:.1f}s -> {out}", flush=True)

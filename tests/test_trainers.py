@@ -389,3 +389,55 @@ def test_ppo_short_final_chunk_indivisible_rows(tmp_path):
         reward_fn=word_count_reward, prompts=prompts, config=config
     )
     assert trainer.iter_count == 2
+
+
+def test_generate_kwarg_validation(tmp_path):
+    """generate() kwarg edges (advisor round-4 findings): unknown
+    HF-but-unimplemented names warn-and-drop at call time (matching
+    SamplerSettings.from_gen_kwargs at config load, so a reference
+    config sweeping e.g. num_beams doesn't load fine then crash
+    evaluate()); non-scalar processor kwargs fail with a clear message
+    instead of an opaque unhashable-type error; genuinely unknown names
+    still raise."""
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = default_ilql_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=1, eval_interval=10,
+            checkpoint_interval=10, seq_length=12, epochs=1, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=tiny_model_cfg(),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(gen_kwargs=dict(max_new_tokens=4)),
+    )
+    trainer = get_trainer(config.train.trainer)(config=config)
+    ids = np.full((8, 4), 3, np.int32)
+
+    # ILQL declares `beta` on its logits processor: scalar works
+    out = trainer.generate(ids, beta=1.0)
+    assert np.asarray(out["sequences"]).shape[0] == 8
+
+    # numpy scalars (what iterating a swept np.array yields) are scalars
+    out = trainer.generate(ids, beta=np.float32(0.5))
+    assert np.asarray(out["sequences"]).shape[0] == 8
+
+    # a swept list is the config's sweep axis, not a per-call value
+    with pytest.raises(TypeError, match="must be a scalar"):
+        trainer.generate(ids, beta=[0, 1, 100])
+
+    # config load consults the same HF-unimplemented set (warn + drop)
+    from trlx_tpu.models.generation import SamplerSettings
+
+    s = SamplerSettings.from_gen_kwargs(
+        dict(max_new_tokens=4, num_beams=4, beta=1.0)
+    )
+    assert s.max_new_tokens == 4 and not hasattr(s, "num_beams")
+
+    # HF-known-but-unimplemented: dropped with a warning, not fatal
+    out = trainer.generate(ids, num_beams=4)
+    assert np.asarray(out["sequences"]).shape[0] == 8
+
+    # neither HF-known nor declared anywhere: still an error
+    with pytest.raises(TypeError, match="neither"):
+        trainer.generate(ids, not_a_kwarg=1)

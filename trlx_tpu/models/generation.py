@@ -35,6 +35,28 @@ from trlx_tpu.ops.common import topk_mask
 
 Array = jnp.ndarray
 
+# HF `generate` kwargs this sampler deliberately does not implement.
+# Reference configs pass HF gen_kwargs verbatim (ref
+# trlx/data/default_configs.py gen_kwargs), so these degrade with a
+# warning — at config load (from_gen_kwargs) and per-call
+# (BaseTrainer.generate consults the same set) — instead of loading
+# fine then crashing evaluate() mid-sweep. Names outside this set are
+# either sampler/processor-owned (validated by the trainer, which knows
+# the processor's signature) or unknown.
+HF_GEN_KWARGS_UNIMPLEMENTED = frozenset({
+    "num_beams", "num_beam_groups", "penalty_alpha", "use_cache",
+    "typical_p", "epsilon_cutoff", "eta_cutoff", "diversity_penalty",
+    "repetition_penalty", "encoder_repetition_penalty", "length_penalty",
+    "no_repeat_ngram_size", "bad_words_ids", "force_words_ids",
+    "renormalize_logits", "constraints", "forced_bos_token_id",
+    "forced_eos_token_id", "remove_invalid_values", "early_stopping",
+    "exponential_decay_length_penalty", "suppress_tokens",
+    "begin_suppress_tokens", "forced_decoder_ids", "num_return_sequences",
+    "output_attentions", "output_hidden_states", "output_scores",
+    "return_dict_in_generate", "min_length", "min_new_tokens",
+    "max_length", "max_time",
+})
+
 
 @dataclass(frozen=True)
 class SamplerSettings:
@@ -59,9 +81,20 @@ class SamplerSettings:
         eos = kw.pop("eos_token_id", eos_token_id)
         pad = kw.pop("pad_token_id", pad_token_id)
         known = {f.name for f in dataclasses.fields(cls)}
-        # HF gen_kwargs this sampler doesn't implement (beta is ILQL's
-        # shaping strength, consumed by the logits processor) are ignored
-        # rather than fatal, so reference configs run unmodified
+        # HF gen_kwargs this sampler doesn't implement are ignored
+        # rather than fatal, so reference configs run unmodified — with
+        # a warning for recognized-HF names (the same set the trainer's
+        # generate() warns on per-call). Other unknown names (e.g. beta,
+        # ILQL's shaping strength consumed by the logits processor) pass
+        # silently here: only the trainer knows its processor signature.
+        dropped_hf = set(kw) & HF_GEN_KWARGS_UNIMPLEMENTED
+        if dropped_hf:
+            from trlx_tpu.utils import logging
+
+            logging.get_logger(__name__).warning(
+                "SamplerSettings: ignoring HF gen_kwargs this sampler "
+                f"does not implement: {sorted(dropped_hf)}"
+            )
         kw = {k: v for k, v in kw.items() if k in known}
         return cls(
             **kw,

@@ -37,7 +37,11 @@ import numpy as np
 import optax
 
 from trlx_tpu.data.configs import TRLConfig
-from trlx_tpu.models.generation import SamplerSettings, generate
+from trlx_tpu.models.generation import (
+    HF_GEN_KWARGS_UNIMPLEMENTED,
+    SamplerSettings,
+    generate,
+)
 from trlx_tpu.models.hf import load_pretrained, save_pretrained_hf
 from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
 from trlx_tpu.parallel import (
@@ -514,12 +518,36 @@ class TPUBaseTrainer(BaseRLTrainer):
             if name != "params" and p.kind is not inspect.Parameter.VAR_KEYWORD
         }
         unknown = set(kwargs) - sampler_fields - proc_fields
+        # names HF generate knows but this sampler doesn't implement get
+        # the same treatment per-call as at config load (the SAME set
+        # SamplerSettings.from_gen_kwargs warns on): warn and drop — a
+        # config sweeping e.g. num_beams must not load fine then crash
+        # evaluate()
+        hf_unimplemented = unknown & HF_GEN_KWARGS_UNIMPLEMENTED
+        if hf_unimplemented:
+            logger.warning(
+                "generate(): ignoring HF gen_kwargs this sampler does "
+                f"not implement: {sorted(hf_unimplemented)}"
+            )
+            unknown -= hf_unimplemented
+            kwargs = {k: v for k, v in kwargs.items() if k not in hf_unimplemented}
         if unknown:
             raise TypeError(
                 f"generate() got kwargs {sorted(unknown)} that neither "
                 f"SamplerSettings nor {type(self).__name__}."
                 "generation_logits_processor accepts"
             )
+        for k, v in kwargs.items():
+            # processor kwargs key the compiled-fn cache and are baked
+            # into the trace: they must be hashable scalars, one value
+            # per call (a swept list like beta=[0,1,100] is the config's
+            # sweep axis — callers pass each value separately)
+            if k in proc_fields and not (v is None or np.isscalar(v)):
+                raise TypeError(
+                    f"generate() kwarg {k}={v!r} must be a scalar "
+                    "(int/float/bool/str); swept values are passed one "
+                    "per call, not as a list"
+                )
         proc_kwargs = tuple(
             sorted((k, v) for k, v in kwargs.items() if k in proc_fields)
         )
