@@ -441,3 +441,46 @@ def test_generate_kwarg_validation(tmp_path):
     # neither HF-known nor declared anywhere: still an error
     with pytest.raises(TypeError, match="neither"):
         trainer.generate(ids, not_a_kwarg=1)
+
+
+def test_runtime_extra_keys_do_not_reroute_to_random(tmp_path):
+    """Mesh presets ship runtime-only model_extra_configs (e.g.
+    kv_cache_quant) — applying one on top of a config that points at a
+    trained checkpoint must LOAD that checkpoint with the knobs applied,
+    not silently re-randomize the model (advisor round-5 finding)."""
+    from trlx_tpu.utils.loading import get_trainer
+
+    ckpt = str(tmp_path / "native_ckpt")
+    config = default_sft_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=1, eval_interval=10,
+            checkpoint_interval=10, seq_length=12, epochs=1, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=tiny_model_cfg(),
+        tokenizer=dict(tokenizer_path="byte"),
+    )
+    trainer = get_trainer(config.train.trainer)(config=config)
+    trainer.save_pretrained(ckpt)
+    saved_leaf = np.asarray(
+        jax.tree_util.tree_leaves(trainer.params["base"])[0]
+    )
+
+    # preset-style config: checkpoint path + RUNTIME-only transformer keys
+    config2 = config.evolve(
+        model=dict(
+            model_path=ckpt,
+            model_extra_configs={
+                "transformer": dict(
+                    kv_cache_quant="int8", decode_weights_quant="int8"
+                )
+            },
+        ),
+    )
+    trainer2 = get_trainer(config2.train.trainer)(config=config2)
+    assert trainer2.model.cfg.kv_cache_quant == "int8"
+    assert trainer2.model.cfg.decode_weights_quant == "int8"
+    loaded_leaf = np.asarray(
+        jax.tree_util.tree_leaves(trainer2.params["base"])[0]
+    )
+    np.testing.assert_array_equal(saved_leaf, loaded_leaf)

@@ -218,6 +218,15 @@ def generate(
     from trlx_tpu.parallel.sharding import unshard_for_decode
 
     params = unshard_for_decode(params, getattr(model, "mesh", None))
+    if getattr(model.cfg, "decode_weights_quant", None) == "int8":
+        # rollout-policy weight quantization: block kernels go int8 +
+        # per-channel scale (QDense picks the scale up via
+        # has_variable). One-time cost per generate call (a read+write
+        # of the block weights), amortized over prefill + every decode
+        # step; see transformer.quantize_decode_weights for numerics.
+        from trlx_tpu.models.transformer import quantize_decode_weights
+
+        params = quantize_decode_weights(params)
     n_virt = 0
     if soft_prompt is not None:
         n_virt = soft_prompt.shape[0]
@@ -308,6 +317,16 @@ def generate(
     logits_last = logit_projection(params)(h_last)
     tok0, finished0 = pick_next(sub, h_last, logits_last, finished0)
 
+    decode_cache = out["cache"]
+    if model.cfg.kv_cache_quant in ("int8", "int8_kernel"):
+        # quantize ONCE after prefill (prefill numerics/pallas path stay
+        # untouched); every decode step then reads an int8 cache stream
+        # — half the HBM traffic of bf16, which is what bounds decode at
+        # large batch×seq (models/transformer.py:quantize_kv_cache)
+        from trlx_tpu.models.transformer import quantize_kv_cache
+
+        decode_cache = quantize_kv_cache(decode_cache)
+
     if N > 1:
         pos0 = prompt_len  # next token's real position
         ids_buf = jnp.full((B, N), jnp.int32(settings.pad_token_id))
@@ -347,7 +366,7 @@ def generate(
                 rng, ids_buf, mask_buf,
             )
 
-        state = (out["cache"], tok0, pos0, finished0, jnp.int32(1), rng,
+        state = (decode_cache, tok0, pos0, finished0, jnp.int32(1), rng,
                  ids_buf, mask_buf)
         (_, _, _, _, _, _, response_ids, response_mask) = jax.lax.while_loop(
             cond, body, state

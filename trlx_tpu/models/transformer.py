@@ -91,6 +91,22 @@ class TransformerConfig:
     # score tensor never exists, so training at 8k+ tokens is where it
     # pays for itself.
     attention_impl: str = "xla"
+    # None | "int8" | "int8_kernel": generate() quantizes the KV cache
+    # after prefill so the decode loop's full-cache read rides an int8
+    # stream (half the HBM traffic of bf16 — decode at large batch×seq
+    # is bound on exactly that read). Prefill numerics are untouched;
+    # decode picks up symmetric quantization noise (bounded in
+    # tests/test_generation.py). "int8" drives the folded-scale XLA
+    # path; "int8_kernel" additionally routes aligned caches through
+    # the pallas decode kernel (slower on v5e today — see the measured
+    # note in Attention's int8 branch — kept for tuning).
+    kv_cache_quant: Optional[str] = None
+    # None | "int8": generate() rewrites block kernels to int8 +
+    # per-output-channel scales for the rollout (prefill AND decode run
+    # the same quantized policy; the teacher-forced experience pass
+    # keeps full precision). Halves the 2.4 GB/step block-weight read
+    # that dominates decode after the int8 KV cache.
+    decode_weights_quant: Optional[str] = None
     # pipeline parallelism: microbatches per pipelined forward when the
     # mesh has a pp axis > 1 (0 = one microbatch per pipeline stage).
     # The bubble fraction is (pp-1)/(M+pp-1); raise M to amortize it.
@@ -215,7 +231,7 @@ class Attention(nn.Module):
         H, Hkv, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
 
         dense = partial(
-            nn.DenseGeneral,
+            QDense,
             axis=-1,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
@@ -232,6 +248,7 @@ class Attention(nn.Module):
             k = apply_rope(k, cos, sin, cfg.rotary_style)
 
         new_kv = None
+        kernel_out = None  # set by the fused int8 decode kernel path
         if cache is not None:
             # update-carry-FIRST: write this layer's new [B, T, Hkv, D]
             # column into the scan-carried stacked buffer, then attend
@@ -250,15 +267,137 @@ class Attention(nn.Module):
             # (defeats XLA's in-place aliasing entirely, 15x slower).
             idx = cache["index"]
             ix = cache["ix"]
-            ck = jax.lax.dynamic_update_slice(
-                cache["ck"], k[None].astype(cache["ck"].dtype), (ix, 0, idx, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cache["cv"], v[None].astype(cache["cv"].dtype), (ix, 0, idx, 0, 0)
-            )
-            new_kv = {"ck": ck, "cv": cv}
-            k = jax.lax.dynamic_index_in_dim(ck, ix, 0, keepdims=False).astype(cfg.dtype)
-            v = jax.lax.dynamic_index_in_dim(cv, ix, 0, keepdims=False).astype(cfg.dtype)
+            if "ck_scale" in cache:
+                # int8 cache (decode only; generate() quantizes the
+                # prefilled cache once — see quantize_kv_cache).
+                # Buffer layout is [L, B, Hkv, S, D] (kv-head OUTSIDE
+                # the slot axis) so the fused decode kernel's per-cell
+                # blocks are plain trailing (S, D) tiles; scales are
+                # K per (slot, kv-head) / V per (kv-head, channel) so
+                # both dequants commute out of the attention reductions
+                # (rationale + measured per-token-V cost in
+                # ops/decode_attention.py).
+                kq, ks = _quantize_kv(k)  # [B,T,Hkv,D] int8, [B,T,Hkv]
+                layer_vs = cache["v_scale"]  # [B, Hkv, 1, D]
+                vq = jnp.clip(
+                    jnp.round(
+                        v.astype(jnp.float32)
+                        / jnp.maximum(layer_vs.transpose(0, 2, 1, 3), 1e-12)
+                    ),
+                    -127.0,
+                    127.0,
+                ).astype(jnp.int8)
+                ck = jax.lax.dynamic_update_slice(
+                    cache["ck"], kq.transpose(0, 2, 1, 3)[None],
+                    (ix, 0, 0, idx, 0),
+                )
+                # V stores [.., S, D] like K. A [.., D, S] variant
+                # (contracting axis minor for the AV dot) was measured
+                # 2026-07-31: it re-fuses the AV convert but makes the
+                # per-step column write strided across the minor axis —
+                # net wash (849 vs 868 tok/s, inside run noise), so the
+                # write-friendly layout stays
+                cv = jax.lax.dynamic_update_slice(
+                    cache["cv"], vq.transpose(0, 2, 1, 3)[None],
+                    (ix, 0, 0, idx, 0),
+                )
+                cks = jax.lax.dynamic_update_slice(
+                    cache["ck_scale"],
+                    ks.transpose(0, 2, 1)[:, :, None][None].astype(
+                        cache["ck_scale"].dtype
+                    ),
+                    (ix, 0, 0, 0, idx),
+                )
+                new_kv = {"ck": ck, "cv": cv, "ck_scale": cks}
+                S = ck.shape[3]
+                plain = (
+                    cfg.attn_scale is None
+                    and cfg.pos_embed != "alibi"
+                    and cfg.local_window is None
+                )
+                if (
+                    cfg.kv_cache_quant == "int8_kernel"
+                    and T == 1
+                    and plain
+                    and key_mask is not None
+                    and S % 128 == 0
+                ):
+                    # fused pallas decode kernel: int8 K/V stream
+                    # straight from the full carried buffer
+                    # (scalar-prefetched layer index), scales folded
+                    # in-kernel. Measured SLOWER than the folded-scale
+                    # XLA path below at 1.3B b8 seq2048 on v5e (0.185
+                    # vs ~0.13 ms/layer — per-cell M=1 dots underuse
+                    # the MXU), so it is opt-in until tuned; kept
+                    # because its per-cell VMEM streaming is the right
+                    # shape for longer caches (ops/decode_attention.py)
+                    from trlx_tpu.ops.decode_attention import (
+                        decode_attention_int8,
+                    )
+
+                    kernel_out = decode_attention_int8(
+                        q[:, 0], ck, cv, cks, layer_vs, key_mask, ix,
+                        sm_scale=1.0 / math.sqrt(D),
+                    )[:, None]  # [B, 1, H, D]
+                elif plain:
+                    # folded-scale XLA path (the production "int8"
+                    # decode): keep K/V int8 end to end — the per-slot
+                    # K scale rides the [B,H,T,S] scores (fuses into
+                    # the softmax chain), the per-channel V scale rides
+                    # the [B,T,H,D] output; nothing S-sized is ever
+                    # dequantized to HBM
+                    k_i8 = jax.lax.dynamic_index_in_dim(
+                        ck, ix, 0, keepdims=False
+                    )  # [B, Hkv, S, D]
+                    v_i8 = jax.lax.dynamic_index_in_dim(
+                        cv, ix, 0, keepdims=False
+                    )  # [B, Hkv, S, D]
+                    ks_l = jax.lax.dynamic_index_in_dim(
+                        cks, ix, 0, keepdims=False
+                    )  # [B, Hkv, 1, S]
+                    if Hkv != H:
+                        rep = H // Hkv
+                        k_i8 = jnp.repeat(k_i8, rep, axis=1)
+                        v_i8 = jnp.repeat(v_i8, rep, axis=1)
+                        ks_l = jnp.repeat(ks_l, rep, axis=1)
+                        layer_vs = jnp.repeat(layer_vs, rep, axis=1)
+                    scores = jnp.einsum(
+                        "bthd,bhsd->bhts",
+                        q,
+                        k_i8.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32,
+                    ) * (1.0 / math.sqrt(D))
+                    scores = scores * ks_l + attn_bias
+                    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+                    kernel_out = jnp.einsum(
+                        "bhts,bhsd->bthd", probs, v_i8.astype(cfg.dtype)
+                    ) * layer_vs.transpose(0, 2, 1, 3).astype(cfg.dtype)
+                else:
+                    # non-plain-bias fallback: full dequant back to the
+                    # [B, S, Hkv, D] orientation the generic XLA path
+                    # expects — correctness, not a fast path
+                    k = (
+                        jax.lax.dynamic_index_in_dim(ck, ix, 0, keepdims=False)
+                        .astype(jnp.float32)
+                        * jax.lax.dynamic_index_in_dim(
+                            cks, ix, 0, keepdims=False
+                        ).transpose(0, 1, 3, 2)
+                    ).astype(cfg.dtype).transpose(0, 2, 1, 3)
+                    v = (
+                        jax.lax.dynamic_index_in_dim(cv, ix, 0, keepdims=False)
+                        .astype(jnp.float32)
+                        * layer_vs
+                    ).astype(cfg.dtype).transpose(0, 2, 1, 3)
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    cache["ck"], k[None].astype(cache["ck"].dtype), (ix, 0, idx, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cache["cv"], v[None].astype(cache["cv"].dtype), (ix, 0, idx, 0, 0)
+                )
+                new_kv = {"ck": ck, "cv": cv}
+                k = jax.lax.dynamic_index_in_dim(ck, ix, 0, keepdims=False).astype(cfg.dtype)
+                v = jax.lax.dynamic_index_in_dim(cv, ix, 0, keepdims=False).astype(cfg.dtype)
 
         # the pallas kernel bakes in 1/sqrt(D) scaling and a plain
         # causal+padding mask; architectures with nonstandard scaling or
@@ -294,8 +433,9 @@ class Attention(nn.Module):
             and key_mask is not None
             and plain_bias
             and (cache is None or prefill_offset is not None)
+            and kernel_out is None
         )
-        if Hkv != H and not use_pallas:
+        if Hkv != H and not use_pallas and kernel_out is None:
             # grouped-query on the XLA/ring paths: repeat kv heads (the
             # pallas kernel handles GQA natively and must NOT see
             # repeated kv — that would forfeit its grouped HBM reads)
@@ -303,7 +443,9 @@ class Attention(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        if ring_mesh is not None:
+        if kernel_out is not None:
+            out = kernel_out
+        elif ring_mesh is not None:
             # sequence-parallel path: K/V rotate around the `sp` ring via
             # ppermute while each shard accumulates its queries' attention
             # (TransformerLM._ring_mesh gates on plain-bias archs, full
@@ -338,7 +480,7 @@ class Attention(nn.Module):
             if cfg.use_attn_out_bias is not None
             else cfg.use_attn_bias
         )
-        proj = nn.DenseGeneral(
+        proj = QDense(
             features=E,
             axis=(-2, -1),
             dtype=cfg.dtype,
@@ -350,6 +492,156 @@ class Attention(nn.Module):
         return proj(out), new_kv
 
 
+class QDense(nn.Module):
+    """DenseGeneral-compatible linear that additionally accepts an int8
+    kernel with a per-output-channel dequant scale.
+
+    Same param names/shapes/init as `nn.DenseGeneral` (kernel =
+    (input_dims..., features...), zero bias), so checkpoints and HF
+    interop are unchanged. At decode time `quantize_decode_weights`
+    rewrites the param tree: kernel → int8, plus a `kernel_scale` leaf
+    this module detects via `has_variable`. The int8→compute-dtype
+    convert fuses into the dot's operand load, so the HBM weight stream
+    halves (the dominant decode cost at 1.3B: 2.4 GB of block weights
+    per step); the scale multiplies the tiny output because per-output-
+    channel scaling commutes out of the contraction. Training paths
+    never see a scale and run the exact DenseGeneral math.
+    """
+
+    features: Any  # int or tuple
+    axis: Any = -1  # int or tuple of input axes to contract
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.normal(0.02)
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        feats = (
+            self.features if isinstance(self.features, tuple)
+            else (self.features,)
+        )
+        axes = self.axis if isinstance(self.axis, tuple) else (self.axis,)
+        axes = tuple(a % x.ndim for a in axes)
+        in_shape = tuple(x.shape[a] for a in axes)
+        kernel = self.param(
+            "kernel", self.kernel_init, in_shape + feats, self.param_dtype
+        )
+        y = jax.lax.dot_general(
+            x.astype(self.dtype),
+            kernel.astype(self.dtype),
+            ((axes, tuple(range(len(axes)))), ((), ())),
+        )
+        if self.has_variable("params", "kernel_scale"):
+            y = y * self.get_variable("params", "kernel_scale").astype(
+                self.dtype
+            )
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, feats, self.param_dtype
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+def quantize_decode_weights(params: Dict) -> Dict:
+    """Rewrite every stacked block kernel to int8 + per-output-channel
+    scale (consumed by QDense) for the decode loop.
+
+    Decode reads every weight once per token: at 1.3B the 2.4 GB of
+    block kernels dominate the per-step HBM budget even after the int8
+    KV cache. Per-output-channel symmetric scales keep the error at the
+    per-matmul level (~0.4% relative); sampling runs the SAME quantized
+    policy for prefill and every decode step, so trajectories are
+    self-consistent — the teacher-forced experience pass then scores
+    them with the full-precision weights, which is the usual
+    behavior-policy/scoring split (same contract as the int8 KV cache,
+    quantize_kv_cache above). Embeddings and the logit projection stay
+    in compute dtype (the tied wte must serve lookups).
+
+    Only kernels under `blocks` dense modules are rewritten; scan
+    xs-slicing delivers per-layer int8 kernels + scales to QDense
+    automatically.
+    """
+    # feature rank by dense-module name (kernel = (L, inputs..., feats...))
+    n_feats = {"q": 2, "k": 2, "v": 2, "o": 1,
+               "fc_in": 1, "fc_gate": 1, "fc_out": 1}
+
+    def walk(tree, name=None):
+        out = {}
+        for child_name, leaf in tree.items():
+            if isinstance(leaf, dict):
+                out[child_name] = walk(leaf, child_name)
+            else:
+                out[child_name] = leaf
+        if name in n_feats and "kernel" in tree:
+            w = tree["kernel"].astype(jnp.float32)
+            red = tuple(range(1, w.ndim - n_feats[name]))  # input dims
+            s = jnp.max(jnp.abs(w), axis=red) / 127.0  # [L, feats...]
+            out["kernel"] = jnp.round(
+                w / jnp.maximum(jnp.expand_dims(s, red), 1e-12)
+            ).astype(jnp.int8)
+            out["kernel_scale"] = s.astype(jnp.float32)
+        return out
+
+    return dict(params, blocks=walk(params["blocks"]))
+
+
+def _quantize_kv(x: Array) -> Tuple[Array, Array]:
+    """Symmetric per-(…, head) int8 quantization over the trailing D
+    axis: returns (int8 values, per-row fp32 scales shaped x.shape[:-1]).
+    Rows of zeros (unwritten cache slots) get scale 0 and dequantize
+    back to exact zeros."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    s = amax / 127.0
+    q = jnp.round(
+        x.astype(jnp.float32) / jnp.maximum(s, 1e-12)[..., None]
+    ).astype(jnp.int8)
+    return q, s
+
+
+def quantize_kv_cache(cache: Dict) -> Dict:
+    """One-shot int8 quantization of a prefilled KV cache.
+
+    Decode at large batch×seq is HBM-bandwidth-bound on the full-cache
+    read every step (3.22 GB at 1.3B b8 seq2048 in bf16); int8 halves
+    that stream. Quantizing AFTER prefill keeps the pallas prefill path
+    byte-identical — only the decode loop sees int8, and Attention's
+    scaled-score path (see the cache branch in Attention.__call__)
+    never materializes a dequantized copy. The reference has no KV
+    quantization at all (HF `generate` caches follow model dtype); this
+    is a TPU-roofline design choice, opt-in via
+    TransformerConfig.kv_cache_quant="int8".
+
+    Layout change: the bf16 cache is [L, B, S, Hkv, D]; the quantized
+    cache is [L, B, Hkv, S, D] — kv-head OUTSIDE the slot axis, so the
+    fused decode kernel's per-(batch, kv-head) grid cells read plain
+    trailing (S, D) tiles (ops/decode_attention.py). Scales: K per
+    (layer, batch, kv-head, slot) over D, stored [L, B, Hkv, 1, S]; V
+    per (layer, batch, kv-head, channel) over the slot axis, stored
+    [L, B, Hkv, 1, D] and FROZEN here — decode writes saturate against
+    it. The 1.25x headroom covers new tokens whose |v| drifts past the
+    prefix max on a channel (post-norm value magnitudes are
+    near-stationary across decode); saturation error is bounded either
+    way, and the headroom costs ~0.3 bits of prefix precision.
+    """
+    k = cache["k"].astype(jnp.float32).transpose(0, 1, 3, 2, 4)
+    v = cache["v"].astype(jnp.float32).transpose(0, 1, 3, 2, 4)
+    ks = jnp.max(jnp.abs(k), axis=-1) / 127.0  # [L, B, Hkv, S]
+    kq = jnp.round(k / jnp.maximum(ks, 1e-12)[..., None]).astype(jnp.int8)
+    vs = jnp.max(jnp.abs(v), axis=3) * (1.25 / 127.0)  # [L, B, Hkv, D]
+    vq = jnp.clip(
+        jnp.round(v / jnp.maximum(vs, 1e-12)[:, :, :, None]), -127.0, 127.0
+    ).astype(jnp.int8)
+    out = dict(
+        cache, k=kq, v=vq,
+        k_scale=ks[:, :, :, None].astype(jnp.float32),
+        v_scale=vs[:, :, :, None].astype(jnp.float32),
+    )
+    out.pop("static_index", None)  # decode loops carry arrays only
+    return out
+
+
 class MLP(nn.Module):
     cfg: TransformerConfig
 
@@ -358,7 +650,7 @@ class MLP(nn.Module):
         cfg = self.cfg
         act = _activation(cfg.activation)
         up = partial(
-            nn.DenseGeneral,
+            QDense,
             features=cfg.intermediate_size,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
@@ -368,7 +660,7 @@ class MLP(nn.Module):
         h = act(up(name="fc_in")(x))
         if cfg.mlp_gated:
             h = h * up(name="fc_gate")(x)
-        down = nn.DenseGeneral(
+        down = QDense(
             features=cfg.hidden_size,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
@@ -734,19 +1026,33 @@ class TransformerLM:
         n = jax.tree_util.tree_leaves(block_params)[0].shape[0]
         flags = self._layer_flags(n, layer_offset)
 
+        quant = cache is not None and "k_scale" in cache
+
         def body(carry, layer):
             if cache is not None:
-                hidden, ck, cv = carry
                 # hand the attention the FULL carried buffers + this
                 # layer's row index: it writes its new column in place
                 # and attends against a slice of the updated buffer (the
                 # update-carry-first design; rationale in Attention)
-                layer_cache = {
-                    "ck": ck,
-                    "cv": cv,
-                    "ix": layer["ix"],
-                    "index": cache["index"],
-                }
+                if quant:
+                    hidden, ck, cv, cks = carry
+                    layer_cache = {
+                        "ck": ck, "cv": cv,
+                        "ck_scale": cks,
+                        # frozen per-layer V scales ride the scan's xs
+                        # (sliced to this layer's [B, Hkv, D] row), not
+                        # the carry: decode never updates them
+                        "v_scale": layer["vs"],
+                        "ix": layer["ix"], "index": cache["index"],
+                    }
+                else:
+                    hidden, ck, cv = carry
+                    layer_cache = {
+                        "ck": ck,
+                        "cv": cv,
+                        "ix": layer["ix"],
+                        "index": cache["index"],
+                    }
                 if "static_index" in cache:  # pallas prefill offset
                     layer_cache["static_index"] = cache["static_index"]
             else:
@@ -760,6 +1066,8 @@ class TransformerLM:
                 {"params": lp}, hidden, bias, positions, layer_cache, key_mask,
                 ring_mesh,
             )
+            if quant:
+                return (out, new_kv["ck"], new_kv["cv"], new_kv["ck_scale"]), None
             if cache is not None:
                 return (out, new_kv["ck"], new_kv["cv"]), None
             return out, None
@@ -773,7 +1081,19 @@ class TransformerLM:
             xs["ix"] = jnp.arange(n)
         if flags is not None:
             xs["flag"] = flags
-        if cache is not None:
+        if quant:
+            xs["vs"] = cache["v_scale"]
+            (h, ck, cv, cks), _ = jax.lax.scan(
+                body,
+                (h, cache["k"], cache["v"], cache["k_scale"]),
+                xs,
+            )
+            new_cache = dict(
+                k=ck, v=cv, k_scale=cks, v_scale=cache["v_scale"],
+                index=cache["index"] + positions.shape[1],
+                key_mask=cache["key_mask"],
+            )
+        elif cache is not None:
             (h, ck, cv), _ = jax.lax.scan(body, (h, cache["k"], cache["v"]), xs)
             new_cache = dict(
                 k=ck, v=cv, index=cache["index"] + positions.shape[1],
@@ -858,7 +1178,9 @@ class TransformerLM:
             positions = n + jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
         ring = None
         if cache is not None:
-            S = cache["k"].shape[2]  # [L, B, S, Hkv, D]
+            # bf16 cache: [L, B, S, Hkv, D]; int8 (quantized) cache:
+            # [L, B, Hkv, S, D] (layout rationale: quantize_kv_cache)
+            S = cache["k"].shape[3 if "k_scale" in cache else 2]
             q_slots = cache["index"] + jnp.arange(T)
             if positions is None:
                 positions = q_slots[None, :] * jnp.ones((B, 1), jnp.int32)

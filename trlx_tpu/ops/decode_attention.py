@@ -1,0 +1,172 @@
+"""Pallas fused single-token decode attention over an int8 KV cache.
+
+Decode at large batch×seq is bound on the full-cache read every step
+(1.61 GB int8 at 1.3B b8 seq2048). Driving that read through XLA ops
+costs three extra O(S·D) materializations per layer (measured via
+profile trace, 2026-07-31: the int8→bf16 convert un-fuses from the AV
+dot, the QK dot runs as a kLoop fusion at ~60% of the read roofline,
+and a per-token V dequant costs a 0.56 ms/step probs multiply). This
+kernel does the whole per-layer attention step in one pass: each
+(batch, kv-head) grid cell streams its int8 K/V rows into VMEM once,
+computes fp32 scores with the per-slot K scales folded in, runs an
+online softmax, and applies the per-channel V scales to the tiny
+[rep, D] output — nothing S-sized ever goes back to HBM.
+
+Layer indexing: the decode loop scans over layers carrying the stacked
+[L, B, Hkv, S, D] buffers; the layer index arrives as a SCALAR-PREFETCH
+argument so the kernel reads its layer's blocks straight out of the
+full carried buffer — slicing the layer out in XLA first would
+materialize a 33 MB copy per layer per step, which is the exact
+traffic the kernel exists to avoid.
+
+Scale layout (chosen so both dequants commute out of the reductions —
+see transformer.Attention's int8 branch for the measured alternative):
+  k_scale [L, B, Hkv, 1, S] fp32 — multiplies scores per key slot
+  v_scale [L, B, Hkv, 1, D] fp32 — multiplies the output per channel
+
+The reference has no decode-attention kernel at all: its rollout
+generation is HF `model.generate` over full-precision torch caches
+(/root/reference/trlx/trainer/accelerate_ppo_trainer.py:285).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+CHUNK = 512  # fp32 score tile per in-kernel step: [rep, CHUNK]
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _decode_kernel(
+    lx_ref,  # scalar prefetch: [1] layer index (consumed by index maps)
+    q_ref,  # [1, 1, rep, D]
+    k_ref,  # [1, 1, 1, S, D] int8
+    v_ref,  # [1, 1, 1, S, D] int8
+    ks_ref,  # [1, 1, 1, 1, S] f32
+    vs_ref,  # [1, 1, 1, D] f32 (per-layer slice; no layer axis)
+    mask_ref,  # [1, 1, S] int32
+    o_ref,  # [1, 1, rep, D]
+    *,
+    sm_scale,
+    n_chunks,
+    ck,
+):
+    rep, D = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[0, 0].astype(jnp.float32)  # [rep, D]
+
+    def body(j, carry):
+        o_acc, m_run, l_run = carry
+        k_c = k_ref[0, 0, 0, pl.ds(j * ck, ck), :].astype(jnp.float32)
+        ks_c = ks_ref[0, 0, 0, 0, pl.ds(j * ck, ck)]  # [ck]
+        mk = mask_ref[0, 0, pl.ds(j * ck, ck)]  # [ck]
+        s = jax.lax.dot_general(
+            q, k_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [rep, ck]
+        # per-slot K dequant + softmax scale fold into the score tile
+        s = s * (ks_c * sm_scale)[None, :]
+        s = jnp.where(mk[None, :] > 0, s, NEG_INF)
+
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_run - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_run * corr + jnp.sum(p, axis=-1, keepdims=True)
+        v_c = v_ref[0, 0, 0, pl.ds(j * ck, ck), :].astype(jnp.float32)
+        o_new = o_acc * corr + jax.lax.dot_general(
+            p, v_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((rep, D), jnp.float32)
+    m0 = jnp.full((rep, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rep, 1), jnp.float32)
+    o, _, l = jax.lax.fori_loop(0, n_chunks, body, (o0, m0, l0))
+    # per-channel V dequant commutes out of the over-S dot: one [rep, D]
+    # multiply after normalization
+    o = (o / jnp.maximum(l, 1e-30)) * vs_ref[0, 0]
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def decode_attention_int8(
+    q,  # [B, H, D] (rope already applied)
+    ck,  # [L, B, Hkv, S, D] int8 — full stacked cache
+    cv,  # [L, B, Hkv, S, D] int8
+    k_scale,  # [L, B, Hkv, 1, S] f32
+    v_scale,  # [B, Hkv, 1, D] f32 — this layer's slice (frozen scales
+    #           ride the layer scan's xs, so no layer axis here)
+    key_mask,  # [B, S] int32 — 1 for attendable slots (incl. this token)
+    layer_ix,  # scalar int32: which layer's blocks to read
+    sm_scale: float,
+):
+    """One decode step's attention for ONE layer of the stacked cache.
+
+    Returns [B, H, D] in q.dtype. Requires S % 128 == 0 (Mosaic lane
+    granularity for the in-kernel chunk loads; generate() rounds real
+    rollout caches to 128 slots) — callers fall back to the XLA path
+    otherwise (transformer.Attention gates on the same condition).
+    """
+    L, B, Hkv, S, D = ck.shape
+    H = q.shape[1]
+    if H % Hkv:
+        raise ValueError(f"n_head={H} not a multiple of n_kv_head={Hkv}")
+    rep = H // Hkv
+    # largest power-of-two chunk <= CHUNK that divides S: callers are
+    # gated on S % 128 == 0, so this bottoms out at >= 128 (lane-aligned
+    # for the in-kernel dynamic loads) instead of rejecting e.g. S=640
+    ckk = min(CHUNK, S)
+    while S % ckk:
+        ckk //= 2
+    if ckk < 128:
+        raise ValueError(f"cache length {S} must be a multiple of 128")
+
+    # consecutive rep query heads share a kv head (head h -> group
+    # h // rep), so [B, H, D] -> [B, Hkv, rep, D] groups them per cell
+    qr = q.reshape(B, Hkv, rep, D)
+    grid = (B, Hkv)
+
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=sm_scale, n_chunks=S // ckk, ck=ckk
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, rep, D), lambda b, h, lx: (b, h, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, 1, S, D), lambda b, h, lx: (lx[0], b, h, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1, S, D), lambda b, h, lx: (lx[0], b, h, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1, 1, S), lambda b, h, lx: (lx[0], b, h, 0, 0)
+                ),
+                pl.BlockSpec((1, 1, 1, D), lambda b, h, lx: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, S), lambda b, h, lx: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, rep, D), lambda b, h, lx: (b, h, 0, 0)
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, D), q.dtype),
+        interpret=_interpret(),
+    )(
+        jnp.reshape(layer_ix, (1,)).astype(jnp.int32),
+        qr,
+        ck,
+        cv,
+        k_scale,
+        v_scale,
+        key_mask.astype(jnp.int32)[:, None, :],
+    )
+    return out.reshape(B, H, D)

@@ -58,6 +58,28 @@ from trlx_tpu.utils.trackers import Tracker
 
 logger = logging.get_logger(__name__)
 
+# TransformerConfig knobs that tune EXECUTION, not architecture. Mesh
+# presets ship these in model_extra_configs["transformer"]; they apply
+# on top of whatever checkpoint is loaded, and their presence alone
+# must not trigger the random-init path (architecture keys do).
+_RUNTIME_TRANSFORMER_KEYS = frozenset({
+    "attention_impl", "kv_cache_quant", "decode_weights_quant",
+    "pp_microbatches", "pp_schedule",
+})
+
+
+def _apply_runtime_overrides(cfg, extra_dict):
+    """Apply _RUNTIME_TRANSFORMER_KEYS present in a model_extra_configs
+    sub-dict onto a loaded model config (only the fields the config
+    actually has — seq2seq lacks the quant knobs, for instance)."""
+    names = {f.name for f in dataclasses.fields(cfg)}
+    ov = {
+        k: v
+        for k, v in extra_dict.items()
+        if k in _RUNTIME_TRANSFORMER_KEYS and k in names
+    }
+    return cfg.replace(**ov) if ov else cfg
+
 _DTYPES = {
     "float32": jnp.float32,
     "bfloat16": jnp.bfloat16,
@@ -183,6 +205,10 @@ class TPUBaseTrainer(BaseRLTrainer):
             return self._load_seq2seq_base(mc, extra)
 
         def finalize(tcfg):
+            # runtime knobs from model_extra_configs apply to EVERY load
+            # path (mesh presets ship e.g. kv_cache_quant — they must
+            # tune a loaded checkpoint, not reroute it to random init)
+            tcfg = _apply_runtime_overrides(tcfg, extra.get("transformer", {}))
             # mesh sp>1 means the user asked for context parallelism: switch
             # the default attention to the ring implementation (an explicit
             # attention_impl, e.g. "pallas", is respected as-is)
@@ -220,7 +246,11 @@ class TPUBaseTrainer(BaseRLTrainer):
             if os.path.isdir(aux_dir):
                 self._loaded_aux = ocp.PyTreeCheckpointer().restore(aux_dir)
             return finalize(tcfg), params, meta.get("model_type")
-        if mc.model_path == "random" or "transformer" in extra:
+        # random-init only when asked by path or by ARCHITECTURE keys —
+        # a preset carrying only runtime knobs (kv_cache_quant, ...)
+        # must not silently replace a pretrained model with random init
+        arch_keys = set(extra.get("transformer", {})) - _RUNTIME_TRANSFORMER_KEYS
+        if mc.model_path == "random" or arch_keys:
             tdict = dict(extra.get("transformer", {}))
             tdict.setdefault("vocab_size", getattr(self.tokenizer, "vocab_size", 258))
             tcfg = TransformerConfig(
@@ -254,8 +284,13 @@ class TPUBaseTrainer(BaseRLTrainer):
             aux_dir = os.path.join(os.path.abspath(mc.model_path), "aux")
             if os.path.isdir(aux_dir):
                 self._loaded_aux = ocp.PyTreeCheckpointer().restore(aux_dir)
+            scfg = _apply_runtime_overrides(scfg, extra.get("seq2seq", {}))
             return scfg, params, meta.get("model_type", "t5")
-        if mc.model_path == "random" or "seq2seq" in extra:
+        # same contract as the causal loader: runtime-only keys don't
+        # reroute a pretrained model to random init
+        if mc.model_path == "random" or (
+            set(extra.get("seq2seq", {})) - _RUNTIME_TRANSFORMER_KEYS
+        ):
             sdict = dict(extra.get("seq2seq", {}))
             sdict.setdefault("vocab_size", getattr(self.tokenizer, "vocab_size", 258))
             pad = getattr(self.tokenizer, "pad_token_id", None)
@@ -272,7 +307,8 @@ class TPUBaseTrainer(BaseRLTrainer):
             mc.model_path, dtype=self.compute_dtype, param_dtype=self.param_dtype
         )
         self._hf_config_path = mc.model_path
-        return lm.cfg, params, model_type
+        scfg = _apply_runtime_overrides(lm.cfg, extra.get("seq2seq", {}))
+        return scfg, params, model_type
 
     @abstractmethod
     def setup_model(self) -> None:
