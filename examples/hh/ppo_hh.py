@@ -5,8 +5,11 @@ transport-agnostic (HTTP JSON via HH_RM_URL, or an in-process HF reward
 model via HH_RM_PATH) since reward serving is host-side I/O, not TPU
 compute (SURVEY.md §2.8 last row).
 
-Scale preset: GPT-J-class fits a v3-32 with fsdp=8 (mesh_preset_6b_v3_32)
-— the counterpart of the reference's 7-train-GPU + 1-RM-GPU layout.
+Scale preset: GPT-J-class on a v4-8 with fsdp=4 x tp=2 — the AOT memory
+fit (__graft_entry__.dryrun_scale, row 6b_v4_fsdp4_tp2) shows ~24.4 GB
+peak per 32 GB chip (~24% headroom; the pure-fsdp8 layout fits at <7%,
+too tight once real-run HBM fragmentation eats ~2 GB). Counterpart of
+the reference's 7-train-GPU + 1-RM-GPU layout.
 """
 
 import os
@@ -34,7 +37,7 @@ default_config = TRLConfig(
         pipeline="PromptPipeline",
         trainer="TPUPPOTrainer",
         checkpoint_dir="ckpts/ppo_hh",
-        mesh={"dp": -1, "fsdp": 8, "tp": 1, "sp": 1},
+        mesh={"dp": -1, "fsdp": 4, "tp": 2, "sp": 1},
         compute_dtype="bfloat16",
     ),
     model=ModelConfig(model_path="EleutherAI/gpt-j-6B", num_layers_unfrozen=2),

@@ -384,3 +384,137 @@ def test_ppo_lora_on_pp_mesh(tmp_path):
     assert any(
         float(jnp.abs(ab["b"]).max()) > 0 for ab in trainer.params["lora"].values()
     )
+
+
+def test_hf_peft_adapter_roundtrip(tmp_path):
+    """save/load equivalence through the HF-peft checkpoint layout
+    (parity: ref tests/test_peft.py:54-62): train a LoRA SFT briefly,
+    save_pretrained (which now writes adapter_config.json +
+    adapter_model.safetensors), reload the TRAINED adapter through
+    ModelConfig.peft_config=<dir> on a fresh trainer over the same base
+    checkpoint, and demand identical adapter params + logits."""
+    import os
+
+    from trlx_tpu.utils.loading import get_trainer
+
+    out_dir = str(tmp_path / "export")
+    config = default_sft_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=10,
+            checkpoint_interval=10, seq_length=12, epochs=2, tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=tiny_model_cfg(peft_config=PEFT),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=False)),
+    )
+    samples = [("question", "answer"), ("hi", "there")] * 8
+    trainer = trlx_tpu.train(samples=samples, config=config)
+    # make sure the adapter is non-trivial before export
+    trainer.params["lora"] = jax.tree_util.tree_map(
+        lambda x: x + 0.01, trainer.params["lora"]
+    )
+    trainer.save_pretrained(out_dir)
+    assert os.path.exists(os.path.join(out_dir, "adapter_config.json"))
+    assert os.path.exists(os.path.join(out_dir, "adapter_model.safetensors"))
+
+    # fresh trainer: same base (native checkpoint), adapter FROM THE DIR
+    config2 = config.evolve(
+        model=dict(model_path=out_dir, peft_config=out_dir),
+    )
+    trainer2 = get_trainer(config2.train.trainer)(config=config2)
+    for path, ab in trainer.params["lora"].items():
+        ab2 = trainer2.params["lora"][path]
+        np.testing.assert_allclose(
+            np.asarray(ab["a"]), np.asarray(ab2["a"]), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(ab["b"]), np.asarray(ab2["b"]), atol=1e-6
+        )
+    assert trainer2.model.lora_scaling == trainer.model.lora_scaling
+
+    ids = np.full((2, 6), 7, np.int32)
+    l1 = trainer.model.forward(
+        trainer.params, jnp.asarray(ids), jnp.ones_like(jnp.asarray(ids))
+    )["logits"]
+    l2 = trainer2.model.forward(
+        trainer2.params, jnp.asarray(ids), jnp.ones_like(jnp.asarray(ids))
+    )["logits"]
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+
+def test_hf_peft_foreign_lora_load(tmp_path):
+    """A LoRA authored by HF peft (per-layer q_proj/v_proj torch
+    tensors, torch [r,in]/[out,r] conventions) loads into the stacked
+    layout, and a fused-c_attn adapter splits into exact q/k/v column
+    blocks."""
+    import json
+
+    import torch
+    from safetensors.torch import save_file
+
+    from trlx_tpu.models.peft import load_peft_adapter
+
+    cfg = TransformerConfig(vocab_size=64, dtype=jnp.float32, **TINY)
+    E, L, r = cfg.hidden_size, cfg.n_layer, 4
+    rng = np.random.default_rng(0)
+    tensors = {}
+    for i in range(L):
+        for mod in ("q_proj", "v_proj"):
+            base = f"base_model.model.transformer.h.{i}.attn.{mod}"
+            tensors[f"{base}.lora_A.weight"] = torch.from_numpy(
+                rng.normal(size=(r, E)).astype(np.float32)
+            )
+            tensors[f"{base}.lora_B.weight"] = torch.from_numpy(
+                rng.normal(size=(E, r)).astype(np.float32)
+            )
+    d = tmp_path / "foreign"
+    d.mkdir()
+    save_file(tensors, str(d / "adapter_model.safetensors"))
+    (d / "adapter_config.json").write_text(json.dumps(
+        {"peft_type": "LORA", "r": r, "lora_alpha": 8,
+         "target_modules": ["q_proj", "v_proj"]}
+    ))
+    pc, adapter = load_peft_adapter(str(d), cfg)
+    assert pc["r"] == r
+    lora = adapter["lora"]
+    assert set(lora) == {"blocks/attn/q/kernel", "blocks/attn/v/kernel"}
+    q = lora["blocks/attn/q/kernel"]
+    assert q["a"].shape == (L, E, r) and q["b"].shape == (L, r, E)
+    # layer 1's A equals the authored tensor transposed
+    np.testing.assert_allclose(
+        np.asarray(q["a"][1]),
+        tensors["base_model.model.transformer.h.1.attn.q_proj.lora_A.weight"].numpy().T,
+    )
+
+    # fused c_attn variant: shared A, B split by thirds
+    tensors2 = {}
+    for i in range(L):
+        base = f"base_model.model.transformer.h.{i}.attn.c_attn"
+        tensors2[f"{base}.lora_A.weight"] = torch.from_numpy(
+            rng.normal(size=(r, E)).astype(np.float32)
+        )
+        tensors2[f"{base}.lora_B.weight"] = torch.from_numpy(
+            rng.normal(size=(3 * E, r)).astype(np.float32)
+        )
+    d2 = tmp_path / "fused"
+    d2.mkdir()
+    save_file(tensors2, str(d2 / "adapter_model.safetensors"))
+    (d2 / "adapter_config.json").write_text(json.dumps(
+        {"peft_type": "LORA", "r": r, "lora_alpha": 8,
+         "target_modules": ["c_attn"]}
+    ))
+    _, adapter2 = load_peft_adapter(str(d2), cfg)
+    lora2 = adapter2["lora"]
+    assert set(lora2) == {
+        "blocks/attn/q/kernel", "blocks/attn/k/kernel", "blocks/attn/v/kernel"
+    }
+    bfull = tensors2["base_model.model.transformer.h.0.attn.c_attn.lora_B.weight"].numpy().T
+    np.testing.assert_allclose(
+        np.asarray(lora2["blocks/attn/k/kernel"]["b"][0]), bfull[:, E : 2 * E]
+    )
+    # q/k/v share the fused module's A
+    np.testing.assert_allclose(
+        np.asarray(lora2["blocks/attn/q/kernel"]["a"][0]),
+        np.asarray(lora2["blocks/attn/v/kernel"]["a"][0]),
+    )

@@ -94,3 +94,248 @@ def adapter_call_kwargs(params: Dict) -> Dict[str, Any]:
     if "prefix" in params:
         kw["kv_prefix"] = params["prefix"]
     return kw
+
+
+# ---------------------------------------------------------------------------
+# HF-peft checkpoint interop
+# ---------------------------------------------------------------------------
+# Parity: the reference loads both fresh peft configs and already-trained
+# adapter checkpoints, and saves adapters in the HF-peft layout
+# (/root/reference/trlx/models/modeling_base.py:124-326, 347-353). Here
+# the on-disk contract is the same (adapter_config.json +
+# adapter_model.safetensors) while the in-memory layout stays the
+# TPU-shaped stacked tree above.
+
+# per-layer HF module prefixes for families with SEPARATE q/k/v
+# projections (matching models/hf.py's weight naming); fused-attention
+# families (gpt2 c_attn, neox/bloom query_key_value) fall back to the
+# logical layout below, which round-trips through load_peft_adapter but
+# needs name adaptation for HF-side serving
+_HF_LORA_MODULES = {
+    "gptj": ("transformer.h.{i}.attn.", {"q": "q_proj", "k": "k_proj",
+                                         "v": "v_proj", "o": "out_proj"}),
+    "llama": ("model.layers.{i}.self_attn.", {"q": "q_proj", "k": "k_proj",
+                                              "v": "v_proj", "o": "o_proj"}),
+    "mistral": ("model.layers.{i}.self_attn.", {"q": "q_proj", "k": "k_proj",
+                                                "v": "v_proj", "o": "o_proj"}),
+    "opt": ("model.decoder.layers.{i}.self_attn.", {"q": "q_proj", "k": "k_proj",
+                                                    "v": "v_proj", "o": "out_proj"}),
+}
+# logical fallback (also what load_peft_adapter emits for foreign names)
+_LOGICAL_MODULE = "layers.{i}.{mod}"
+
+# foreign HF module name -> our block-local module
+_HF_TO_OURS = {
+    "q_proj": "q", "k_proj": "k", "v_proj": "v",
+    "o_proj": "o", "out_proj": "o", "dense": "o",
+    "q": "q", "k": "k", "v": "v", "o": "o",
+    "fc_in": "fc_in", "fc_out": "fc_out", "fc_gate": "fc_gate",
+    "gate_proj": "fc_gate", "up_proj": "fc_in", "down_proj": "fc_out",
+}
+_OUR_PATH = {
+    "q": "blocks/attn/q/kernel", "k": "blocks/attn/k/kernel",
+    "v": "blocks/attn/v/kernel", "o": "blocks/attn/o/kernel",
+    "fc_in": "blocks/mlp/fc_in/kernel", "fc_gate": "blocks/mlp/fc_gate/kernel",
+    "fc_out": "blocks/mlp/fc_out/kernel",
+}
+
+
+def save_peft_adapter(
+    directory: str,
+    adapter_params: Dict[str, Any],  # {"lora": ...} | {"prompt": ...} | {"prefix": ...}
+    peft_cfg: Dict[str, Any],  # normalize_peft_config output
+    cfg,  # TransformerConfig (layer count / head geometry)
+    model_type: Optional[str] = None,
+) -> None:
+    """Write an HF-peft-format adapter checkpoint: adapter_config.json
+    + adapter_model.safetensors (torch tensors, per-layer names)."""
+    import json
+    import os
+
+    import numpy as np
+    import torch
+    from safetensors.torch import save_file
+
+    os.makedirs(directory, exist_ok=True)
+    tensors: Dict[str, torch.Tensor] = {}
+    adapter_config: Dict[str, Any] = {
+        "peft_type": peft_cfg["peft_type"],
+        "task_type": "CAUSAL_LM",
+        "base_model_name_or_path": model_type or "",
+    }
+
+    if peft_cfg["peft_type"] == "LORA":
+        adapter_config.update(
+            r=peft_cfg["r"], lora_alpha=peft_cfg["alpha"], lora_dropout=0.0,
+        )
+        prefix_fmt, name_map = _HF_LORA_MODULES.get(
+            model_type or "", (None, None)
+        )
+        target_modules = set()
+        for path, ab in adapter_params["lora"].items():
+            mod = path.split("/")[-2]  # q | k | v | o | fc_in | ...
+            a = np.asarray(ab["a"], np.float32)  # [L?, in, r]
+            b = np.asarray(ab["b"], np.float32)  # [L?, r, out]
+            if a.ndim == 2:  # unstacked (lm_head): single module
+                a, b = a[None], b[None]
+                layers = [None]
+            else:
+                layers = range(a.shape[0])
+            for li in layers:
+                i = 0 if li is None else li
+                if li is None:
+                    module = "lm_head"
+                elif prefix_fmt is not None and mod in name_map:
+                    module = prefix_fmt.format(i=i) + name_map[mod]
+                else:
+                    module = _LOGICAL_MODULE.format(i=i, mod=mod)
+                target_modules.add(module.rsplit(".", 1)[-1])
+                base = f"base_model.model.{module}"
+                # torch Linear convention: lora_A.weight [r, in],
+                # lora_B.weight [out, r]
+                tensors[f"{base}.lora_A.weight"] = torch.from_numpy(
+                    np.ascontiguousarray(a[i].T)
+                )
+                tensors[f"{base}.lora_B.weight"] = torch.from_numpy(
+                    np.ascontiguousarray(b[i].T)
+                )
+        adapter_config["target_modules"] = sorted(target_modules)
+    elif peft_cfg["peft_type"] in ("PROMPT_TUNING", "PREFIX_TUNING"):
+        adapter_config["num_virtual_tokens"] = peft_cfg["num_virtual_tokens"]
+        if peft_cfg["peft_type"] == "PROMPT_TUNING":
+            emb = np.asarray(adapter_params["prompt"]["embedding"], np.float32)
+        else:
+            # peft prefix layout: [n_virtual, L*2*Hkv*D] with per-layer
+            # (key, value) pairs consecutive on the middle axis
+            k = np.asarray(adapter_params["prefix"]["k"], np.float32)
+            v = np.asarray(adapter_params["prefix"]["v"], np.float32)
+            L, n, Hkv, D = k.shape
+            kv = np.stack([k, v], axis=1)  # [L, 2, n, Hkv, D]
+            emb = kv.transpose(2, 0, 1, 3, 4).reshape(n, L * 2 * Hkv * D)
+        tensors["prompt_embeddings"] = torch.from_numpy(emb)
+    else:
+        raise ValueError(f"cannot export peft_type {peft_cfg['peft_type']!r}")
+
+    save_file(tensors, os.path.join(directory, "adapter_model.safetensors"))
+    with open(os.path.join(directory, "adapter_config.json"), "w") as f:
+        json.dump(adapter_config, f, indent=2)
+
+
+def is_peft_checkpoint(path: Any) -> bool:
+    import os
+
+    return isinstance(path, str) and os.path.isfile(
+        os.path.join(path, "adapter_config.json")
+    )
+
+
+def _layer_index(name: str) -> Optional[int]:
+    """First integer path segment in an HF module name ('...h.3.attn...'
+    -> 3); None for layer-less modules (lm_head)."""
+    for seg in name.split("."):
+        if seg.isdigit():
+            return int(seg)
+    return None
+
+
+def load_peft_adapter(path: str, cfg) -> (dict, dict):
+    """Read a trained HF-peft adapter checkpoint into the stacked
+    in-memory layout. Returns (normalized peft cfg, adapter params to
+    merge into the trainer tree, e.g. {"lora": {...}}).
+
+    Handles separate-projection LoRA names (q_proj/k_proj/v_proj/
+    o_proj/out_proj, plus our logical export names) and FUSED attention
+    (c_attn / query_key_value): a fused module's shared lora_A feeds
+    q/k/v adapters whose lora_B is the corresponding column block —
+    mathematically exact, since the fused delta splits by columns.
+    """
+    import json
+    import os
+
+    import numpy as np
+
+    with open(os.path.join(path, "adapter_config.json")) as f:
+        raw_cfg = json.load(f)
+    pc = normalize_peft_config(raw_cfg)
+
+    st = os.path.join(path, "adapter_model.safetensors")
+    if os.path.exists(st):
+        from safetensors.numpy import load_file
+
+        sd = {k: np.asarray(v) for k, v in load_file(st).items()}
+    else:
+        import torch
+
+        sd = {
+            k: t.detach().cpu().float().numpy()
+            for k, t in torch.load(
+                os.path.join(path, "adapter_model.bin"), map_location="cpu",
+                weights_only=True,
+            ).items()
+        }
+
+    if pc["peft_type"] == "PROMPT_TUNING":
+        return pc, {"prompt": {"embedding": jnp.asarray(sd["prompt_embeddings"])}}
+    if pc["peft_type"] == "PREFIX_TUNING":
+        emb = np.asarray(sd["prompt_embeddings"], np.float32)
+        n = emb.shape[0]
+        Hkv = cfg.n_kv_head or cfg.n_head
+        D = cfg.head_dim or cfg.hidden_size // cfg.n_head
+        L = emb.shape[1] // (2 * Hkv * D)
+        kv = emb.reshape(n, L, 2, Hkv, D).transpose(1, 2, 0, 3, 4)
+        return pc, {"prefix": {"k": jnp.asarray(kv[:, 0]),
+                               "v": jnp.asarray(kv[:, 1])}}
+
+    # LORA: group (module, layer) -> {lora_A, lora_B}
+    per_mod: Dict[str, Dict[int, Dict[str, np.ndarray]]] = {}
+    for name, w in sd.items():
+        if ".lora_A." not in name and ".lora_B." not in name:
+            continue
+        side = "a" if ".lora_A." in name else "b"
+        module = name.split(".lora_")[0].split(".")[-1]
+        li = _layer_index(name)
+        w = np.asarray(w, np.float32).T  # a: [in, r]; b: [r, out]
+        if module in ("c_attn", "query_key_value"):
+            # fused qkv: shared A; B splits into equal q/k/v column
+            # blocks (gpt2-style full fusion; kv-shared bigcode c_attn
+            # is NOT supported here)
+            if side == "a":
+                for m in ("q", "k", "v"):
+                    per_mod.setdefault(m, {}).setdefault(li, {})["a"] = w
+            else:
+                out = w.shape[1] // 3
+                for j, m in enumerate(("q", "k", "v")):
+                    per_mod.setdefault(m, {}).setdefault(li, {})["b"] = (
+                        w[:, j * out : (j + 1) * out]
+                    )
+            continue
+        ours = _HF_TO_OURS.get(module)
+        if ours is None and module == "lm_head":
+            ours = "lm_head"
+        if ours is None:
+            raise ValueError(
+                f"cannot map adapter module {module!r} (from {name!r}) "
+                "onto the transformer layout"
+            )
+        per_mod.setdefault(ours, {}).setdefault(li, {})[side] = w
+
+    lora: Dict[str, Dict[str, jnp.ndarray]] = {}
+    for mod, by_layer in per_mod.items():
+        if mod == "lm_head":
+            ab = by_layer[None]
+            lora["lm_head/kernel"] = {
+                "a": jnp.asarray(ab["a"]), "b": jnp.asarray(ab["b"]),
+            }
+            continue
+        layers = sorted(by_layer)
+        if layers != list(range(cfg.n_layer)):
+            raise ValueError(
+                f"adapter for {mod!r} covers layers {layers}, expected "
+                f"all {cfg.n_layer} (partial-layer adapters aren't "
+                "representable in the stacked layout)"
+            )
+        lora[_OUR_PATH[mod]] = {
+            "a": jnp.asarray(np.stack([by_layer[i]["a"] for i in layers])),
+            "b": jnp.asarray(np.stack([by_layer[i]["b"] for i in layers])),
+        }
+    return pc, {"lora": lora}

@@ -627,8 +627,8 @@ def quantize_kv_cache(cache: Dict) -> Dict:
     """
     k = cache["k"].astype(jnp.float32).transpose(0, 1, 3, 2, 4)
     v = cache["v"].astype(jnp.float32).transpose(0, 1, 3, 2, 4)
-    ks = jnp.max(jnp.abs(k), axis=-1) / 127.0  # [L, B, Hkv, S]
-    kq = jnp.round(k / jnp.maximum(ks, 1e-12)[..., None]).astype(jnp.int8)
+    kq, ks = _quantize_kv(k)  # per (L, B, Hkv, S) over D — the same
+    # formula Attention's decode write path applies to new columns
     vs = jnp.max(jnp.abs(v), axis=3) * (1.25 / 127.0)  # [L, B, Hkv, D]
     vq = jnp.clip(
         jnp.round(v / jnp.maximum(vs, 1e-12)[:, :, :, None]), -127.0, 127.0

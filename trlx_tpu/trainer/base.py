@@ -359,15 +359,42 @@ class TPUBaseTrainer(BaseRLTrainer):
 
     def attach_peft(self, params: Dict) -> Dict:
         """Add the configured adapter (LoRA overlay / prompt soft tokens /
-        per-layer kv prefixes) to a {"base": ...} params tree."""
+        per-layer kv prefixes) to a {"base": ...} params tree.
+
+        `ModelConfig.peft_config` is either an HF-peft-style config dict
+        (fresh adapter) or a PATH to a trained HF-peft adapter checkpoint
+        (adapter_config.json + adapter_model.safetensors) — both shapes
+        the reference accepts (ref modeling_base.py:124-326)."""
         from trlx_tpu.models.peft import (
             init_lora_params,
             init_prefix_params,
             init_prompt_params,
+            is_peft_checkpoint,
+            load_peft_adapter,
             normalize_peft_config,
         )
 
+        if isinstance(self.config.model.peft_config, str) and not (
+            is_peft_checkpoint(self.config.model.peft_config)
+        ):
+            raise ValueError(
+                f"peft_config {self.config.model.peft_config!r} is a "
+                "string but not an adapter checkpoint directory (no "
+                "adapter_config.json inside); pass either a trained "
+                "HF-peft adapter dir or a config dict like "
+                '{"peft_type": "LORA", "r": 8}'
+            )
+        if is_peft_checkpoint(self.config.model.peft_config):
+            pc, adapter = load_peft_adapter(
+                self.config.model.peft_config, self.model.cfg
+            )
+            params.update(adapter)
+            if pc["peft_type"] == "LORA":
+                self.model.lora_scaling = pc["alpha"] / pc["r"]
+            self._peft_cfg = pc
+            return params
         pc = normalize_peft_config(self.config.model.peft_config)
+        self._peft_cfg = pc
         if pc is None:
             return params
         self.rng, key = jax.random.split(self.rng)
@@ -1284,6 +1311,21 @@ class TPUBaseTrainer(BaseRLTrainer):
             ocp.PyTreeCheckpointer().save(
                 os.path.join(directory, "aux"), aux, force=True
             )
+            # trained adapters ALSO export in the HF-peft layout
+            # (adapter_config.json + adapter_model.safetensors), so a
+            # LoRA trained here serves through HF peft and reloads via
+            # ModelConfig.peft_config=<dir> (ref modeling_base.py:347-353)
+            from trlx_tpu.models.peft import ADAPTER_KEYS, save_peft_adapter
+
+            adapters = {k: aux[k] for k in ADAPTER_KEYS if k in aux}
+            if adapters and getattr(self, "_peft_cfg", None) and mh.is_main():
+                try:
+                    save_peft_adapter(
+                        directory, adapters, self._peft_cfg, self.model.cfg,
+                        getattr(self, "model_type", None),
+                    )
+                except Exception as e:  # keep the orbax artifact authoritative
+                    logger.warning("HF-peft adapter export failed: %s", e)
         model_type = getattr(self, "model_type", None)
         exported = False
         if (
