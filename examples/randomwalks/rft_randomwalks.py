@@ -52,7 +52,17 @@ default_config = TRLConfig(
 
 def main(hparams={}):
     config = TRLConfig.update(default_config.to_dict(), hparams)
-    metric_fn, prompts, *_ = generate_random_walks(seed=config.train.seed)
+    metric_fn, prompts, walks, _ = generate_random_walks(seed=config.train.seed)
+
+    if config.model.model_path == "random":
+        # the reference starts from the pretrained CarperAI/randomwalks
+        # checkpoint; zero-egress reproduces it with the same local BC
+        # warmup the PPO example uses — RFT from a cold random model
+        # never samples a single valid walk, so selection has nothing to
+        # climb on (measured: optimality flat at 0 for 200 steps)
+        from examples.randomwalks.ppo_randomwalks import bc_warmup
+
+        config.model.model_path = bc_warmup(config, walks)
 
     return trlx_tpu.train(
         reward_fn=lambda samples, **kwargs: metric_fn(samples)["optimality"],

@@ -1,0 +1,117 @@
+"""SFT on the randomwalks shortest-path task: supervised on OPTIMAL
+walks (BFS gold paths), evaluated by the same optimality metric the
+PPO/ILQL examples use.
+
+The reference's benchmark matrix records a learning curve per
+example/algorithm (ref scripts/benchmark.sh:44-70); randomwalks is its
+zero-egress task, so this is the SFT row of that matrix. Training on
+gold shortest paths (rather than the random-walk corpus the PPO BC
+warmup uses) gives SFT a real learning signal: eval optimality climbs
+toward the supervised ceiling instead of the corpus average.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.method_configs import SFTConfig
+
+from examples.randomwalks import generate_random_walks
+
+default_config = TRLConfig(
+    train=TrainConfig(
+        seq_length=11,
+        epochs=100,
+        total_steps=200,
+        batch_size=96,
+        checkpoint_interval=100000,
+        eval_interval=16,
+        pipeline="PromptPipeline",
+        trainer="TPUSFTTrainer",
+        tracker=None,
+        checkpoint_dir="ckpts/sft_randomwalks",
+    ),
+    model=ModelConfig(
+        model_path="random",
+        num_layers_unfrozen=-1,
+        model_extra_configs={
+            "transformer": dict(hidden_size=144, n_layer=4, n_head=6, n_positions=32)
+        },
+    ),
+    tokenizer=TokenizerConfig(tokenizer_path="byte", truncation_side="right"),
+    optimizer=OptimizerConfig(
+        name="adamw", kwargs=dict(lr=3.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6)
+    ),
+    scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=1000, eta_min=3.0e-4)),
+    method=SFTConfig(
+        name="sftconfig",
+        gen_kwargs=dict(max_new_tokens=9, do_sample=False),
+    ),
+)
+
+
+def optimal_walks(adj: np.ndarray, max_length: int = 10) -> List[str]:
+    """One BFS-shortest path from every non-goal start node to the goal
+    (node 0), as letter strings — the SFT gold corpus."""
+    n = adj.shape[0]
+    goal = 0
+    walks = []
+    for start in range(1, n):
+        # BFS with parent pointers
+        parent = {start: None}
+        frontier = [start]
+        found = False
+        while frontier and not found:
+            nxt = []
+            for u in frontier:
+                for v in np.nonzero(adj[u])[0].tolist():
+                    if v not in parent:
+                        parent[v] = u
+                        if v == goal:
+                            found = True
+                        nxt.append(v)
+            frontier = nxt
+        if not found:
+            continue
+        path = [goal]
+        while parent[path[-1]] is not None:
+            path.append(parent[path[-1]])
+        path = path[::-1][:max_length]
+        if path[-1] != goal:
+            continue
+        walks.append("".join(chr(ix + ord("a")) for ix in path))
+    return walks
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+    metric_fn, eval_prompts, _walks, adj = generate_random_walks(
+        seed=config.train.seed
+    )
+    gold = optimal_walks(adj)
+
+    return trlx_tpu.train(
+        samples=[(w[0], w[1:]) for w in gold] * 8,
+        eval_prompts=eval_prompts,
+        metric_fn=lambda samples, **kwargs: metric_fn(samples),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

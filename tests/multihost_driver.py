@@ -65,6 +65,13 @@ def reward_fn(samples, prompts, outputs, **kw):
 
 
 prompts = ["hello world", "the cat", "a b c", "xyz w", "what is", "I am", "go on", "ok then"]
+if mode == "ragged":
+    # 6 prompts over 2 data groups = 3 LOCAL rows per group, which does
+    # not divide the 4 local data ways: every rollout chunk AND every
+    # eval generation batch exercises the ragged per-group pad+trim path
+    # (generate real_rows, allgather_group_rows moments/store handling)
+    prompts = prompts[:6]
+    config = config.evolve(method=dict(num_rollouts=12, chunk_size=8))
 trainer = trlx_tpu.train(reward_fn=reward_fn, prompts=prompts, config=config)
 
 if mode == "pp":

@@ -130,3 +130,43 @@ def test_ppo_learn_two_processes_pp_stages(tmp_path):
         if "MULTIHOST_OK" in line
     )
     assert sums[0] == sums[-1], sums
+
+
+def test_ppo_ragged_two_processes(tmp_path):
+    """Ragged per-group shapes on multi-host: 3 local rows over 4 local
+    data ways on every rollout chunk and eval batch. Both processes must
+    finish training (no divisibility ValueError), agree on params, and
+    record a real reward/mean — parity with the reference's
+    pad_across_processes handling of ragged ends
+    (accelerate_ppo_trainer.py:292-300)."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, DRIVER, str(pid), "2", str(port), str(tmp_path),
+             "ragged"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=560)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+        assert f"MULTIHOST_OK pid={pid}" in out, out[-2000:]
+    sums = sorted(
+        line.split("paramsum=")[1]
+        for out in outs
+        for line in out.splitlines()
+        if "MULTIHOST_OK" in line
+    )
+    assert sums[0] == sums[-1], sums
+    metrics_fp = os.path.join(str(tmp_path), "ckpts", "logs", "metrics.jsonl")
+    recs = [json.loads(l) for l in open(metrics_fp)]
+    assert any("reward/mean" in r for r in recs)

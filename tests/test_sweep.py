@@ -140,3 +140,82 @@ def test_run_sweep_hyperband(objective_script, tmp_path):
     # every rung's metrics landed; trial dirs distinct
     recs = [json.loads(open(os.path.join(out, "report.json")).read())]
     assert recs[0]["best"] is not None
+
+
+@pytest.fixture()
+def concurrent_script(tmp_path):
+    """A main(hparams) target that trains a REAL tiny model on a 4-device
+    CPU mesh and records its own wall-clock window, so the test can
+    assert two trials genuinely overlapped."""
+    fp = tmp_path / "target_concurrent.py"
+    fp.write_text(
+        """
+import json, os, time
+
+def main(hparams):
+    t0 = time.time()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_sft_config
+
+    config = default_sft_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=10,
+            checkpoint_interval=10, seq_length=12, epochs=2, tracker=None,
+            checkpoint_dir=hparams["train.checkpoint_dir"],
+        ),
+        model=dict(model_path="random", model_extra_configs={
+            "transformer": dict(hidden_size=16, n_layer=2, n_head=2, n_positions=64)
+        }),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=False)),
+    )
+    config = trlx_tpu.data.configs.TRLConfig.update(
+        config.to_dict(), {k: v for k, v in hparams.items()
+                           if k.startswith("optimizer.")}
+    )
+    trlx_tpu.train(samples=[("q", "a"), ("x", "y")] * 8, config=config)
+    logdir = hparams["train.logging_dir"]
+    os.makedirs(logdir, exist_ok=True)
+    with open(os.path.join(logdir, "metrics.jsonl"), "a") as f:
+        f.write(json.dumps({"reward/mean": 1.0, "_step": 2,
+                            "t_start": t0, "t_end": time.time()}) + "\\n")
+"""
+    )
+    return str(fp)
+
+
+def test_run_sweep_concurrent_trials(concurrent_script, tmp_path):
+    """max_concurrent=2: two REAL training trials run in their own
+    subprocess slots, each pinned to a 4-device CPU sub-mesh via
+    slot_env, and their wall-clock windows overlap (the reference fans
+    trials over Ray workers, trlx/sweep.py:233-266)."""
+    out = str(tmp_path / "conc")
+    slot = {"JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    report = run_sweep(
+        concurrent_script,
+        {
+            "optimizer.kwargs.lr": {
+                "strategy": "choice", "values": [1e-4, 3e-4]
+            },
+            "tune_config": {
+                "metric": "reward/mean", "mode": "max", "num_samples": 2,
+                "max_concurrent": 2, "slot_env": [slot, slot],
+            },
+        },
+        out,
+    )
+    assert len(report["trials"]) == 2
+    assert all(r["status"] == "ok" for r in report["trials"]), report["trials"]
+    assert all(r["reward/mean"] == 1.0 for r in report["trials"])
+    windows = []
+    for i in range(2):
+        fp = os.path.join(out, f"trial_{i:03d}", "logs", "metrics.jsonl")
+        rec = [json.loads(l) for l in open(fp) if "t_start" in l][-1]
+        windows.append((rec["t_start"], rec["t_end"]))
+    (s0, e0), (s1, e1) = windows
+    assert max(s0, s1) < min(e0, e1), (
+        f"trials did not overlap: {windows}"
+    )

@@ -269,6 +269,23 @@ def allgather(x) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
 
 
+def allgather_group_rows(x, mesh=None) -> np.ndarray:
+    """Host-side per-DATA-GROUP row blocks -> the full global rows (in
+    group order), on every process. Unlike `allgather`, which
+    concatenates per-PROCESS contributions, this keeps one block per
+    group: with pp>1 the same group's stages hold identical rows and a
+    per-process concat would duplicate them. Every group must
+    contribute the same row count (shard_list guarantees that for
+    prompt/eval distribution)."""
+    if not is_multihost():
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    blocks = np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+    reps = group_representatives(mesh)
+    return np.concatenate([blocks[r] for r in reps], axis=0)
+
+
 def broadcast_flag(value: bool) -> bool:
     """Process 0's bool, agreed on every process (keeps data-dependent
     control flow deterministic across hosts)."""

@@ -4,9 +4,15 @@ Parity: /root/reference/trlx/sweep.py:17-348 — same YAML schema (per-param
 `strategy` + `values`, `tune_config` with metric/mode/search_alg/
 scheduler/num_samples) and the same contract with examples
 (`main(hparams)` with dotted-path overrides). The Ray Tune backend is
-replaced by a first-party sequential runner: a TPU slice is one shared
-resource, so trials run one after another on the full mesh instead of
-fighting over device shards.
+replaced by a first-party runner. By default trials run one after
+another on the full mesh (a TPU slice is one shared resource); on
+hardware that subdivides — a pod whose hosts can run independent
+slices, or a CPU dev box — `tune_config.max_concurrent: N` fans trials
+out over N subprocess slots (the reference fans out over Ray workers,
+trlx/sweep.py:233-266). Each slot can pin its own device subset via
+`tune_config.slot_env` (a list of env-var dicts, e.g. per-slot
+TPU_VISIBLE_CHIPS or XLA_FLAGS), since one jax process must own its
+devices exclusively.
 
 Search algorithms (reference get_search_alg :102-134):
   random / grid   built-in sampling
@@ -309,12 +315,20 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
             for combo in itertools.product(*(grid_axes[k] for k in keys))
         ]
 
-    main = _load_main(script_path)
+    max_concurrent = int(tune_config.get("max_concurrent", 1))
+    slot_envs = tune_config.get("slot_env") or [{}] * max_concurrent
+    if len(slot_envs) < max_concurrent:
+        raise ValueError(
+            f"tune_config.slot_env has {len(slot_envs)} entries for "
+            f"max_concurrent={max_concurrent}"
+        )
+    main = None if max_concurrent > 1 else _load_main(script_path)
     os.makedirs(output_dir, exist_ok=True)
     results: List[Dict[str, Any]] = []
+    trial_counter = itertools.count()
 
-    def run_trial(hparams: Dict[str, Any], budget: Optional[int] = None):
-        i = len(results)
+    def _prepare(hparams: Dict[str, Any], budget: Optional[int]):
+        i = next(trial_counter)
         trial_dir = os.path.join(output_dir, f"trial_{i:03d}")
         full = dict(
             hparams, **{
@@ -324,15 +338,9 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
         )
         if budget is not None:
             full[budget_key] = int(budget)
-        logger.info("trial %d: %s", i, full)
-        t0 = time.time()
-        status = "ok"
-        try:
-            main(full)
-        except Exception as e:  # a failed trial shouldn't kill the sweep
-            logger.warning("trial %d failed: %s", i, e)
-            status = f"error: {e}"
-        score = None
+        return i, trial_dir, full
+
+    def _score_of(trial_dir: str):
         metrics_fp = os.path.join(trial_dir, "logs", "metrics.jsonl")
         if os.path.exists(metrics_fp):
             values = [
@@ -341,13 +349,87 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
                 if metric in rec
             ]
             if values:
-                score = max(values) if mode == "max" else min(values)
+                return max(values) if mode == "max" else min(values)
+        return None
+
+    def _record(i, hparams, full, budget, status, score, t0):
         results.append(
             {"trial": i, "hparams": full, metric: score,
              "status": status, "budget": budget, "time": time.time() - t0}
         )
         alg.tell(hparams, score)
+
+    def run_trial(hparams: Dict[str, Any], budget: Optional[int] = None):
+        i, trial_dir, full = _prepare(hparams, budget)
+        logger.info("trial %d: %s", i, full)
+        t0 = time.time()
+        status = "ok"
+        try:
+            main(full)
+        except Exception as e:  # a failed trial shouldn't kill the sweep
+            logger.warning("trial %d failed: %s", i, e)
+            status = f"error: {e}"
+        score = _score_of(trial_dir)
+        _record(i, hparams, full, budget, status, score, t0)
         return score
+
+    def run_batch(specs: List[Tuple[Dict[str, Any], Optional[int]]]):
+        """Run (hparams, budget) specs; returns their scores in order.
+        Sequential on the full mesh by default; with max_concurrent > 1
+        each trial runs in its own subprocess slot with that slot's env
+        overlay (device pinning is the operator's slot_env contract)."""
+        if max_concurrent == 1:
+            return [run_trial(hp, b) for hp, b in specs]
+        import subprocess
+
+        scores: List[Any] = [None] * len(specs)
+        pending = list(enumerate(specs))
+        active: Dict[int, Tuple] = {}  # slot -> (j, i, proc, t0, hp, full, budget, dir)
+        while pending or active:
+            while pending and len(active) < max_concurrent:
+                slot = next(
+                    s for s in range(max_concurrent) if s not in active
+                )
+                j, (hp, budget) = pending.pop(0)
+                i, trial_dir, full = _prepare(hp, budget)
+                logger.info("trial %d (slot %d): %s", i, slot, full)
+                os.makedirs(trial_dir, exist_ok=True)
+                # stderr goes to a FILE, not a pipe: training children
+                # write far more than a pipe buffer (absl/jax logging),
+                # and an undrained pipe would block the child forever
+                errf = open(os.path.join(trial_dir, "stderr.log"), "w")
+                proc = subprocess.Popen(
+                    [
+                        sys.executable, "-m", "trlx_tpu.sweep",
+                        "--run-trial", script_path, json.dumps(full),
+                    ],
+                    env={**os.environ, **slot_envs[slot]},
+                    stdout=subprocess.DEVNULL,
+                    stderr=errf,
+                    text=True,
+                )
+                errf.close()
+                active[slot] = (j, i, proc, time.time(), hp, full, budget, trial_dir)
+            done = [s for s, a in active.items() if a[2].poll() is not None]
+            if not done:
+                time.sleep(0.2)
+                continue
+            for slot in done:
+                j, i, proc, t0, hp, full, budget, trial_dir = active.pop(slot)
+                status = "ok"
+                if proc.returncode != 0:
+                    err = ""
+                    try:
+                        with open(os.path.join(trial_dir, "stderr.log")) as f:
+                            err = f.read().strip()[-300:]
+                    except OSError:
+                        pass
+                    logger.warning("trial %d failed: %s", i, err)
+                    status = f"error: rc={proc.returncode} {err}"
+                score = _score_of(trial_dir)
+                _record(i, hp, full, budget, status, score, t0)
+                scores[j] = score
+        return scores
 
     if tune_config.get("scheduler") == "hyperband":
         max_budget = int(tune_config.get("max_budget", 0))
@@ -365,7 +447,9 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
                     "hyperband rung %d: %d configs at %s=%d",
                     rung, len(configs), budget_key, budget,
                 )
-                scored = [(hp, run_trial(hp, budget)) for hp in configs]
+                scored = list(zip(
+                    configs, run_batch([(hp, budget) for hp in configs])
+                ))
                 if rung == len(budgets) - 1:
                     break
                 ok = [(hp, s) for hp, s in scored if s is not None]
@@ -377,8 +461,22 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
     else:
         for point in grid_points:
             n = num_samples if alg.space or not grid_axes else 1
-            for _ in range(n):
-                run_trial(dict(point, **alg.ask()))
+            if max_concurrent == 1:
+                # sequential keeps the strict ask/tell interleave (TPE
+                # conditions each ask on every previous result)
+                for _ in range(n):
+                    run_trial(dict(point, **alg.ask()))
+            else:
+                # concurrent slots ask in waves of max_concurrent: the
+                # usual async-search tradeoff (a wave's asks don't see
+                # each other's results)
+                remaining = n
+                while remaining:
+                    wave = min(remaining, max_concurrent)
+                    run_batch(
+                        [(dict(point, **alg.ask()), None) for _ in range(wave)]
+                    )
+                    remaining -= wave
 
     scored = [r for r in results if r[metric] is not None]
     best = (max if mode == "max" else min)(
@@ -418,6 +516,13 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
 
 
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--run-trial":
+        # concurrent-slot child: run ONE trial in this process (its env
+        # carries the slot's device pinning); the parent reads the score
+        # from the trial's metrics.jsonl
+        script_path, full = sys.argv[2], json.loads(sys.argv[3])
+        _load_main(script_path)(full)
+        return
     parser = argparse.ArgumentParser()
     parser.add_argument("script", help="path to an example with main(hparams)")
     parser.add_argument("--config", required=True, help="sweep YAML")

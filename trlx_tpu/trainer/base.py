@@ -640,14 +640,6 @@ class TPUBaseTrainer(BaseRLTrainer):
         ]
         if compiled:
             target = min(compiled)
-        if target != B and mh.is_multihost():
-            # a per-process pad would sit INSIDE the global batch (each
-            # host owns a contiguous row block), so the [:B] trim below
-            # can't remove it — demand clean shapes instead
-            raise ValueError(
-                f"multi-host generation needs batch rows ({B} per process) "
-                f"divisible by local data ways ({self.local_ways()})"
-            )
         if target != B:
             input_ids = self.pad_rows(input_ids, target)
             attention_mask = self.pad_rows(attention_mask, target)
@@ -670,7 +662,17 @@ class TPUBaseTrainer(BaseRLTrainer):
             # straight from here, skipping a host round-trip per chunk
             out = dict(out, prompt_mask=device_mask)
         if target != B:
-            out = jax.tree_util.tree_map(lambda x: x[:B], out)
+            if mh.is_multihost():
+                # each data group's pad rows sit at the END of its own
+                # block INSIDE the global batch (every group padded the
+                # same B -> target, shard_list keeps groups equal-sized),
+                # so a flat [:B] can't drop them — consumers trim their
+                # own group's rows via `real_rows` after mh.local_rows
+                # (parity: the reference pads across processes and trims
+                # after gather, accelerate_ppo_trainer.py:292-300)
+                out = dict(out, real_rows=B)
+            else:
+                out = jax.tree_util.tree_map(lambda x: x[:B], out)
         return out
 
     def generate_eval(self, input_ids, attention_mask=None, **kwargs):
@@ -753,8 +755,11 @@ class TPUBaseTrainer(BaseRLTrainer):
                 kwargs = {sweep_arg: sweep_value} if sweep_value is not None else {}
                 out = self.generate_eval(batch.input_ids, batch.attention_mask, **kwargs)
                 # multi-host: decode/score only this host's rows; scalar
-                # stats are all-gathered below
+                # stats are all-gathered below. A ragged final batch
+                # comes back padded with `real_rows` marking this
+                # group's real count — trim after the local extraction.
                 sequences = mh.local_rows(out["sequences"])
+                sequences = sequences[: out.get("real_rows", len(sequences))]
                 all_samples.extend(sequences)
                 all_prompts.extend(np.asarray(batch.input_ids))
                 all_sizes.extend([np.shape(batch.input_ids)[1]] * len(sequences))

@@ -463,7 +463,16 @@ class TPUPPOTrainer(TPUBaseTrainer):
             seq_w = gen_out["sequences"].shape[1]
             N = gen_out["response_ids"].shape[1]
             P_width = prompt_tensors.shape[1]
-            B_local = gen_out["sequences"].shape[0] // mh.data_group_count(self.mesh)
+            # a ragged multi-host chunk comes back PADDED per data group
+            # with real_rows marking the group's real count — all row
+            # bookkeeping below runs on real rows; the pad rows only
+            # exist inside device arrays until the local slice
+            real_local = gen_out.get("real_rows")
+            B_local = (
+                real_local
+                if real_local is not None
+                else gen_out["sequences"].shape[0] // mh.data_group_count(self.mesh)
+            )
 
             # ONE packed device->host transfer for the three generation
             # outputs (a remote-tunneled chip pays ~100ms latency PER
@@ -497,6 +506,13 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 not self.seq2seq
                 and not self.stop_sequences
                 and B_local % self.local_ways() == 0
+                # a padded multihost chunk (real_rows set — including the
+                # divisible-but-widened case, where generate() padded up
+                # to an already-compiled wider shape) must take the
+                # host-scored path: the device fast path would build
+                # pre_batch over the pad rows and mismatch the real-row
+                # scores at injection
+                and real_local is None
             )
             pre_batch = pre_kl_stats = None
             if device_gen:
@@ -518,7 +534,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
                         jnp.float32(gen_out["sequences"].shape[0]),
                     )
 
-            packed = packed_dev
+            packed = packed_dev[:B_local]  # drop per-group pad rows
             sequences = packed[:, :seq_w]
             response_ids = packed[:, seq_w : seq_w + N]
             response_mask = packed[:, seq_w + N :]
@@ -574,24 +590,30 @@ class TPUPPOTrainer(TPUBaseTrainer):
             # final chunk (prompt dataset smaller than chunk_size) may not
             # divide dp*fsdp — keep the tiny vector replicated then
             # (padding would bias the running reward moments). Multi-host
-            # can't replicate per-group-different rows: reject the short
-            # chunk HERE, before any moment update could consume
-            # cross-host-inconsistent values (the later pad-row check
-            # would raise anyway, but only after poisoning the moments)
+            # replication of per-group-DIFFERENT rows needs a host-side
+            # allgather first, so every process places the same full
+            # vector (parity: the reference pads across processes,
+            # accelerate_ppo_trainer.py:292-300).
             local_sums = (scores * scores_mask).sum(axis=1)
             rows = len(local_sums) * mh.data_group_count(self.mesh)
-            if rows % self.data_ways() and mh.is_multihost():
-                raise ValueError(
-                    f"multi-host rollout chunk of {len(local_sums)} rows per "
-                    f"data group does not divide dp*fsdp={self.data_ways()}; "
-                    "size the prompt dataset / chunk_size for clean shards"
+            if rows % self.data_ways() == 0:
+                score_sums = mh.global_from_local(
+                    local_sums, vector_sharding(self.mesh)
                 )
-            score_sums = mh.global_from_local(
-                local_sums,
-                vector_sharding(self.mesh)
-                if rows % self.data_ways() == 0
-                else replicated_sharding(self.mesh),
-            )
+            elif mh.is_multihost():
+                score_sums = jax.device_put(
+                    np.asarray(
+                        mh.allgather_group_rows(
+                            local_sums.astype(np.float32), self.mesh
+                        ),
+                        np.float32,
+                    ),
+                    replicated_sharding(self.mesh),
+                )
+            else:
+                score_sums = mh.global_from_local(
+                    local_sums, replicated_sharding(self.mesh)
+                )
             if self.ref_mean is None:
                 self.ref_mean = float(score_sums.mean())
                 self.ref_std = float(score_sums.std())
@@ -617,17 +639,12 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 scale_div = jnp.float32(1.0)
 
             # pad rows to the data-parallel multiple for sharding; the
-            # extra rows are trimmed off the rollout batch afterwards.
-            # multi-host: B counts LOCAL rows; padding would land inside
-            # the global batch, so clean divisibility is required (the
-            # generate() call above already enforced it)
+            # extra rows are trimmed off the rollout batch afterwards
+            # (multi-host: every group pads the same B -> target, so the
+            # global batch stays rectangular; the pad rows carry
+            # scores_mask 0 and are dropped before the store push)
             B = len(sequences)
             target = B + (-B) % self.local_ways()
-            if mh.is_multihost() and target != B:
-                raise ValueError(
-                    f"multi-host rollout rows ({B} per process) must divide "
-                    f"local data ways ({self.local_ways()})"
-                )
 
             def rpad(x):
                 return self.pad_rows(x, target)
@@ -674,7 +691,24 @@ class TPUPPOTrainer(TPUBaseTrainer):
                         jnp.float32(B * mh.data_group_count(self.mesh)),
                         scale_div,
                     )
-            if target != B:
+            if target != B and mh.is_multihost():
+                # each group's pad rows sit inside the global batch; a
+                # flat [:B] can't drop them. The chunk is tiny (only a
+                # short FINAL chunk is ragged), so take the host
+                # round-trip: local real rows -> allgather -> one
+                # replicated, consistent global batch for the store
+                rollout_batch = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(
+                        np.asarray(
+                            mh.allgather_group_rows(
+                                mh.local_rows(x)[:B], self.mesh
+                            )
+                        ),
+                        replicated_sharding(self.mesh),
+                    ),
+                    rollout_batch,
+                )
+            elif target != B:
                 # trim the sharding-pad rows ON DEVICE (the store keeps
                 # device-resident rollouts; no host round-trip here)
                 rollout_batch = jax.tree_util.tree_map(

@@ -111,6 +111,9 @@ class TPURFTTrainer(TPUBaseTrainer):
                 for _ in range(method.n_generations_per_prompt):
                     out = self.generate(batch.input_ids, batch.attention_mask)
                     sequences = mh.local_rows(out["sequences"])
+                    # ragged multi-host batches come back padded with
+                    # real_rows marking this group's real count
+                    sequences = sequences[: out.get("real_rows", len(sequences))]
                     _, str_prompts, str_outputs = self.decode(
                         np.asarray(batch.input_ids), sequences,
                         [np.shape(batch.input_ids)[1]] * len(sequences),
