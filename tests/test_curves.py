@@ -54,3 +54,24 @@ def test_bench_reads_recorded_finals():
         with open(fp) as f:
             meta = json.loads(f.readline())["meta"]
         assert meta_key in meta, f"{fname}: bench echo key {meta_key!r} missing"
+
+
+def test_curve_final_thresholds():
+    """Recorded finals must clear their learning thresholds — a
+    re-recorded artifact that regressed below them fails here instead of
+    silently shipping (the reference's acceptance surface is curve
+    parity across the example matrix, ref scripts/benchmark.sh:44-70)."""
+    thresholds = {
+        "randomwalks_ppo.jsonl": ("final_optimality", 0.9),
+        "randomwalks_ilql.jsonl": ("final_optimality@beta=100", 0.9),
+        "randomwalks_sft.jsonl": ("final_optimality", 0.95),
+        "randomwalks_rft.jsonl": ("final_optimality", 0.85),
+        # unigram-F1 ROUGE proxy; random letters score ~0.05, gold 1.0
+        "summarize_synthetic_t5_ilql.jsonl": ("final_rouge1_proxy@beta=0", 0.4),
+    }
+    for fname, (key, minimum) in thresholds.items():
+        fp = os.path.join(REPO, "docs", "curves", fname)
+        assert os.path.exists(fp), f"missing curve artifact {fname}"
+        with open(fp) as f:
+            meta = json.loads(f.readline())["meta"]
+        assert meta[key] >= minimum, f"{fname}: {key}={meta[key]} < {minimum}"

@@ -484,3 +484,31 @@ def test_runtime_extra_keys_do_not_reroute_to_random(tmp_path):
         jax.tree_util.tree_leaves(trainer2.params["base"])[0]
     )
     np.testing.assert_array_equal(saved_leaf, loaded_leaf)
+
+
+def test_ilql_seq2seq_decoder_rows_start_with_start_token():
+    """Offline seq2seq ILQL decoder rows must begin with the decoder
+    start token: the loss reads actions from decoder_input_ids[:, 1:]
+    (position 0 is conditioning), and generation begins every rollout
+    from the start token — without the prepend the start->first-token
+    transition is never trained and rollouts emit EOS immediately
+    (regression: caught recording the summarize-shape curve, where a
+    perfectly-fit BC run generated only empty summaries)."""
+    from trlx_tpu.trainer.ilql import make_experience_seq2seq
+    from trlx_tpu.utils.tokenizers import ByteTokenizer
+
+    tok = ByteTokenizer()
+    store = make_experience_seq2seq(
+        [("doc one", "ab"), ("doc two", "cd")], [1.0, -1.0],
+        tokenizer=tok, verbose=False, decoder_start_token_id=257,
+    )
+    batch = store.collate([store[0], store[1]])
+    # every decoder row starts with the start token...
+    assert (batch.decoder_input_ids[:, 0] == 257).all()
+    # ...and the action labels (decoder_input_ids[:, 1:] at actions_ixs)
+    # start with the FIRST real output token, so that transition trains
+    first_labels = batch.decoder_input_ids[
+        np.arange(2), batch.actions_ixs[:, 0] + 1
+    ]
+    assert first_labels[0] == tok("ab")["input_ids"][0]
+    assert first_labels[1] == tok("cd")["input_ids"][0]

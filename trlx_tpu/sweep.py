@@ -380,11 +380,21 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
         overlay (device pinning is the operator's slot_env contract)."""
         if max_concurrent == 1:
             return [run_trial(hp, b) for hp, b in specs]
-        import subprocess
-
         scores: List[Any] = [None] * len(specs)
         pending = list(enumerate(specs))
         active: Dict[int, Tuple] = {}  # slot -> (j, i, proc, t0, hp, full, budget, dir)
+        try:
+            _drain(pending, active, scores)
+        finally:
+            # an exception (or Ctrl-C) must not orphan training children:
+            # they would keep holding the slots' pinned devices
+            for _, _, proc, *_ in active.values():
+                proc.terminate()
+        return scores
+
+    def _drain(pending, active, scores):
+        import subprocess
+
         while pending or active:
             while pending and len(active) < max_concurrent:
                 slot = next(
@@ -459,24 +469,22 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
                 if not configs:
                     break
     else:
-        for point in grid_points:
-            n = num_samples if alg.space or not grid_axes else 1
-            if max_concurrent == 1:
-                # sequential keeps the strict ask/tell interleave (TPE
-                # conditions each ask on every previous result)
+        n = num_samples if alg.space or not grid_axes else 1
+        if max_concurrent == 1:
+            # sequential keeps the strict ask/tell interleave (TPE
+            # conditions each ask on every previous result)
+            for point in grid_points:
                 for _ in range(n):
                     run_trial(dict(point, **alg.ask()))
-            else:
-                # concurrent slots ask in waves of max_concurrent: the
-                # usual async-search tradeoff (a wave's asks don't see
-                # each other's results)
-                remaining = n
-                while remaining:
-                    wave = min(remaining, max_concurrent)
-                    run_batch(
-                        [(dict(point, **alg.ask()), None) for _ in range(wave)]
-                    )
-                    remaining -= wave
+        else:
+            # concurrent slots: flatten grid points x samples into one
+            # stream so pure-grid sweeps parallelize too, asking in
+            # waves of max_concurrent (the usual async-search tradeoff:
+            # a wave's asks don't see each other's results)
+            stream = [point for point in grid_points for _ in range(n)]
+            while stream:
+                wave, stream = stream[:max_concurrent], stream[max_concurrent:]
+                run_batch([(dict(p, **alg.ask()), None) for p in wave])
 
     scored = [r for r in results if r[metric] is not None]
     best = (max if mode == "max" else min)(

@@ -80,11 +80,21 @@ def make_experience(
 
 
 def make_experience_seq2seq(
-    samples, rewards, tokenizer=None, max_length: int = 2048, verbose: bool = True
+    samples, rewards, tokenizer=None, max_length: int = 2048,
+    verbose: bool = True, decoder_start_token_id: int = 0,
 ):
     """Seq2seq variant: first phrase is the encoder prompt, second the
     decoder output; indices run over DECODER positions (parity: reference
-    accelerate_ilql_trainer.py:179-245)."""
+    accelerate_ilql_trainer.py:179-245).
+
+    The decoder rows are [decoder_start] ++ output tokens: the loss (and
+    the reference, modeling_ilql.py:102) reads actions from
+    decoder_input_ids[:, 1:], i.e. position 0 is pure conditioning.
+    Without the explicit start prepend the start->first-token transition
+    is never trained, and generation — which begins every rollout from
+    the start token — immediately emits EOS (caught recording the
+    summarize-shape curve: perfectly-fit BC runs generated only empty
+    summaries)."""
     from trlx_tpu.pipeline.offline_pipeline import ILQLSeq2SeqRolloutStorage
 
     if verbose:
@@ -100,10 +110,14 @@ def make_experience_seq2seq(
         if not outputs:
             raise ValueError("sample has no output tokens")
         all_input_ids.append([t for m in inputs for t in m.tokens])
-        out_tokens = [t for m in outputs for t in m.tokens]
+        out_tokens = [int(decoder_start_token_id)] + [
+            t for m in outputs for t in m.tokens
+        ]
         all_output_ids.append(out_tokens)
+        # length >= 2 always: the start token plus at least one output
+        # token (empty outputs raised above)
         length = len(out_tokens)
-        acts = list(range(length - 1)) or [0]
+        acts = list(range(length - 1))
         states = acts + [length - 1]
         all_actions_ixs.append(acts)
         all_states_ixs.append(states)
@@ -218,7 +232,8 @@ class TPUILQLTrainer(TPUBaseTrainer):
     def make_experience(self, samples, rewards, seq_length: int = 1024) -> None:
         if self.seq2seq:
             self.store = make_experience_seq2seq(
-                samples, rewards, self.tokenizer, seq_length
+                samples, rewards, self.tokenizer, seq_length,
+                decoder_start_token_id=self.model.cfg.decoder_start_token_id,
             )
         else:
             self.store = make_experience(samples, rewards, self.tokenizer, seq_length)

@@ -50,11 +50,15 @@ from trlx_tpu.ops.remat import resolve_remat
 logger = logging.get_logger(__name__)
 
 
-def _masked_kl_stats(kl, n_valid):
-    """(mean_kl, mean_kl_per_token) over the first n_valid rows only: rows
-    appended by pad_rows for dp-divisibility are excluded so they cannot
-    bias the adaptive KL controller."""
-    row_valid = (jnp.arange(kl.shape[0]) < n_valid).astype(jnp.float32)
+def _masked_kl_stats(kl, row_valid):
+    """(mean_kl, mean_kl_per_token) over the rows row_valid marks 1:
+    rows appended by pad_rows for dp-divisibility are excluded so they
+    cannot bias the adaptive KL controller. A VECTOR (not a prefix
+    count): on multi-host each data group's pad rows sit inside the
+    global batch, so "the first n rows" would keep some groups' pad
+    rows and drop other groups' real ones."""
+    row_valid = row_valid.astype(jnp.float32)
+    n_valid = jnp.maximum(row_valid.sum(), 1.0)
     mean_kl = (kl.sum(axis=1) * row_valid).sum() / n_valid
     mean_kl_per_token = (kl * row_valid[:, None]).sum() / (n_valid * kl.shape[1])
     return mean_kl, mean_kl_per_token
@@ -273,7 +277,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
 
         chunks = self.config.train.logit_chunks
 
-        def seq2seq_fn(params, ref_params, enc_ids, enc_mask, dec_ids, response_mask, scores, scores_mask, kl_coef, n_valid, scale_div):
+        def seq2seq_fn(params, ref_params, enc_ids, enc_mask, dec_ids, response_mask, scores, scores_mask, kl_coef, row_valid, scale_div):
             scores = scores / jnp.maximum(scale_div, 1e-8)
             mask = response_mask.astype(jnp.float32)
             dec_mask = jnp.concatenate(
@@ -299,7 +303,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 ref_logprobs = logprobs_of_labels(out["ref_logits"][:, :-1], dec_ids[:, 1:]) * mask
             log_ratio = logprobs - ref_logprobs
             kl = jnp.exp(log_ratio) - 1 - log_ratio
-            mean_kl, mean_kl_per_token = _masked_kl_stats(kl, n_valid)
+            mean_kl, mean_kl_per_token = _masked_kl_stats(kl, row_valid)
             values = out["values"][:, :-1] * mask
 
             rewards = -kl_coef * log_ratio
@@ -332,10 +336,10 @@ class TPUPPOTrainer(TPUBaseTrainer):
         fwd_fn = self._get_experience_fwd_fn(P, N)
         inject_fn = self._get_score_inject_fn(N, S)
 
-        def fn(params, ref_params, tokens, attention_mask, response_mask, scores, scores_mask, kl_coef, n_valid, scale_div):
+        def fn(params, ref_params, tokens, attention_mask, response_mask, scores, scores_mask, kl_coef, row_valid, scale_div):
             pre_batch, kl_stats = fwd_fn(
                 params, ref_params, tokens, attention_mask, response_mask,
-                kl_coef, n_valid,
+                kl_coef, row_valid,
             )
             return inject_fn(pre_batch, scores, scores_mask, scale_div), kl_stats
 
@@ -356,7 +360,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
 
         chunks = self.config.train.logit_chunks
 
-        def fn(params, ref_params, tokens, attention_mask, response_mask, kl_coef, n_valid):
+        def fn(params, ref_params, tokens, attention_mask, response_mask, kl_coef, row_valid):
             out = model.forward_train(
                 params, ref_params, tokens, attention_mask,
                 compute_logits=chunks == 0,
@@ -379,7 +383,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             full_mask = attention_mask[:, 1:].astype(jnp.float32)
             log_ratio_full = (logprobs_full - ref_logprobs_full) * full_mask
             kl = jnp.exp(log_ratio_full) - 1 - log_ratio_full
-            mean_kl, mean_kl_per_token = _masked_kl_stats(kl, n_valid)
+            mean_kl, mean_kl_per_token = _masked_kl_stats(kl, row_valid)
 
             mask = response_mask.astype(jnp.float32)
             sl = slice(P - 1, P + N - 1)
@@ -531,7 +535,9 @@ class TPUPPOTrainer(TPUBaseTrainer):
                         ),
                         gen_out["response_mask"].astype(jnp.int32),
                         jnp.float32(self.kl_ctl.value),
-                        jnp.float32(gen_out["sequences"].shape[0]),
+                        # device_gen only runs on unpadded batches: every
+                        # row is valid
+                        jnp.ones((gen_out["sequences"].shape[0],), jnp.float32),
                     )
 
             packed = packed_dev[:B_local]  # drop per-group pad rows
@@ -641,8 +647,9 @@ class TPUPPOTrainer(TPUBaseTrainer):
             # pad rows to the data-parallel multiple for sharding; the
             # extra rows are trimmed off the rollout batch afterwards
             # (multi-host: every group pads the same B -> target, so the
-            # global batch stays rectangular; the pad rows carry
-            # scores_mask 0 and are dropped before the store push)
+            # global batch stays rectangular; pad rows repeat the last
+            # real row, are excluded from KL stats via the row-validity
+            # vector below, and are dropped before the store push)
             B = len(sequences)
             target = B + (-B) % self.local_ways()
 
@@ -688,7 +695,16 @@ class TPUPPOTrainer(TPUBaseTrainer):
                         mh.global_from_local(rpad(scores), sharding),
                         mh.global_from_local(rpad(scores_mask), sharding),
                         jnp.float32(self.kl_ctl.value),
-                        jnp.float32(B * mh.data_group_count(self.mesh)),
+                        # per-ROW validity (pad rows sit inside each data
+                        # group's block of the global batch, so a prefix
+                        # count can't mark them)
+                        mh.global_from_local(
+                            np.concatenate(
+                                [np.ones(B, np.float32),
+                                 np.zeros(target - B, np.float32)]
+                            ),
+                            vector_sharding(self.mesh),
+                        ),
                         scale_div,
                     )
             if target != B and mh.is_multihost():
