@@ -693,6 +693,23 @@ def bench_longctx() -> dict:
     return out
 
 
+# (artifact, meta final key, bench echo key) — the single source for
+# bench_randomwalks' recorded-curve echoes; tests/test_curves.py guards
+# that every meta key here resolves in the committed artifacts
+RECORDED_CURVE_ECHOES = [
+    ("randomwalks_ppo.jsonl", "final_optimality",
+     "randomwalks_recorded_final_optimality"),
+    ("randomwalks_ilql.jsonl", "final_optimality@beta=100",
+     "randomwalks_ilql_recorded_final_optimality"),
+    ("randomwalks_sft.jsonl", "final_optimality",
+     "randomwalks_sft_recorded_final_optimality"),
+    ("randomwalks_rft.jsonl", "final_optimality",
+     "randomwalks_rft_recorded_final_optimality"),
+    ("summarize_synthetic_t5_ilql.jsonl", "final_rouge1_proxy@beta=0",
+     "summarize_t5_ilql_recorded_final_rouge1_proxy"),
+]
+
+
 def bench_randomwalks() -> dict:
     """Learning-quality evidence on a REAL task (zero egress): PPO on the
     randomwalks shortest-path task (examples/randomwalks/) — BC warmup
@@ -733,12 +750,7 @@ def bench_randomwalks() -> dict:
     # final optimality alongside, so regressions against the in-repo
     # curves are visible in one JSON line. ILQL is echo-only (no fresh
     # ILQL run here); the fresh measurement above is PPO.
-    for fname, meta_key, out_key in [
-        ("randomwalks_ppo.jsonl", "final_optimality",
-         "randomwalks_recorded_final_optimality"),
-        ("randomwalks_ilql.jsonl", "final_optimality@beta=100",
-         "randomwalks_ilql_recorded_final_optimality"),
-    ]:
+    for fname, meta_key, out_key in RECORDED_CURVE_ECHOES:
         fp = os.path.join(REPO, "docs", "curves", fname)
         if os.path.exists(fp):
             with open(fp) as f:

@@ -212,7 +212,7 @@ def test_ppo_experience_fwd_chunked_matches_full():
         fn = trainer._get_experience_fwd_fn(P, N)
         batch, kl = fn(
             trainer.params, trainer.ref_params, tokens, mask, rmask,
-            jnp.float32(0.1), jnp.float32(8.0),
+            jnp.float32(0.1), jnp.ones((8,), jnp.float32),
         )
         outs[chunks] = (batch, kl)
     b0, kl0 = outs[0]

@@ -44,13 +44,18 @@ def test_curve_contract(path):
 
 def test_bench_reads_recorded_finals():
     """The exact meta keys bench.bench_randomwalks echoes must resolve
-    in the committed artifacts (guards the KeyError class of regression
-    when a curve is re-recorded with a different sweep)."""
-    for fname, meta_key in [
-        ("randomwalks_ppo.jsonl", "final_optimality"),
-        ("randomwalks_ilql.jsonl", "final_optimality@beta=100"),
-    ]:
+    in the committed artifacts (guards the silent-drop regression when
+    a curve is re-recorded with a different sweep). Derived from
+    bench.RECORDED_CURVE_ECHOES so the guard can't drift from the
+    echo list."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    for fname, meta_key, _out_key in bench.RECORDED_CURVE_ECHOES:
         fp = os.path.join(REPO, "docs", "curves", fname)
+        assert os.path.exists(fp), f"missing curve artifact {fname}"
         with open(fp) as f:
             meta = json.loads(f.readline())["meta"]
         assert meta_key in meta, f"{fname}: bench echo key {meta_key!r} missing"

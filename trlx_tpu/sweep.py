@@ -387,9 +387,17 @@ def run_sweep(script_path: str, config: Dict[str, Any], output_dir: str) -> Dict
             _drain(pending, active, scores)
         finally:
             # an exception (or Ctrl-C) must not orphan training children:
-            # they would keep holding the slots' pinned devices
+            # they would keep holding the slots' pinned devices — and a
+            # retried sweep would collide with them, so WAIT for each to
+            # actually exit (kill after a grace period)
             for _, _, proc, *_ in active.values():
                 proc.terminate()
+            for _, _, proc, *_ in active.values():
+                try:
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+                    proc.wait()
         return scores
 
     def _drain(pending, active, scores):
