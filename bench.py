@@ -748,8 +748,9 @@ def bench_randomwalks() -> dict:
     # diff against the committed full-curve artifacts (the reference's
     # curve-parity protocol, ref trlx/reference.py): report the recorded
     # final optimality alongside, so regressions against the in-repo
-    # curves are visible in one JSON line. ILQL is echo-only (no fresh
-    # ILQL run here); the fresh measurement above is PPO.
+    # curves are visible in one JSON line. Only the PPO row above is
+    # measured fresh; the ILQL/SFT/RFT/T5-ILQL entries are recorded-
+    # artifact echoes.
     for fname, meta_key, out_key in RECORDED_CURVE_ECHOES:
         fp = os.path.join(REPO, "docs", "curves", fname)
         if os.path.exists(fp):

@@ -348,3 +348,38 @@ def test_t5_pallas_parity_rectangular():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-4, err_msg=str(pa)
         )
+
+
+def test_seq2seq_int8_decode_weights_track_full_precision():
+    """decode_weights_quant="int8" on the seq2seq path: greedy decode
+    through int8 decoder kernels must track the full-precision decode
+    on a tiny model, and only the DECODER subtree is rewritten (the
+    encoder runs once at full precision)."""
+    import dataclasses
+
+    from trlx_tpu.models.generation import SamplerSettings
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig, T5LM, generate_seq2seq
+    from trlx_tpu.models.transformer import quantize_decode_weights
+
+    cfg = Seq2SeqConfig(
+        vocab_size=64, d_model=16, n_layer=2, n_head=2, d_kv=8, d_ff=32,
+        relative_attention_num_buckets=8, dtype=jnp.float32,
+    )
+    t5 = T5LM(cfg)
+    params = t5.init(jax.random.PRNGKey(0))
+    qt5 = T5LM(dataclasses.replace(cfg, decode_weights_quant="int8"))
+    B, P, N = 2, 6, 6
+    ids = jnp.ones((B, P), jnp.int32) * 5
+    mask = jnp.ones((B, P), jnp.int32)
+    settings = SamplerSettings(max_new_tokens=N, do_sample=False)
+    out_fp = generate_seq2seq(t5, params, ids, mask, jax.random.PRNGKey(1), settings)
+    out_q = generate_seq2seq(qt5, params, ids, mask, jax.random.PRNGKey(1), settings)
+    agree = (
+        np.asarray(out_fp["response_ids"]) == np.asarray(out_q["response_ids"])
+    ).mean()
+    assert agree >= 0.9, f"only {agree:.2%} greedy agreement"
+
+    qdec = quantize_decode_weights(params["decoder"])
+    assert qdec["blocks"]["self_attn"]["q"]["kernel"].dtype == jnp.int8
+    assert qdec["blocks"]["cross_attn"]["v"]["kernel"].dtype == jnp.int8
+    assert "kernel_scale" in qdec["blocks"]["mlp"]["fc_out"]

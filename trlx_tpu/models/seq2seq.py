@@ -25,7 +25,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from trlx_tpu.models.transformer import NEG_INF
+from trlx_tpu.models.transformer import NEG_INF, QDense
 
 Array = jnp.ndarray
 
@@ -55,6 +55,12 @@ class Seq2SeqConfig:
     # to XLA. The per-layer [B, H, T, S] score tensor never materializes
     # on this path — long-context summarization training's memory win.
     attention_impl: str = "xla"
+    # None | "int8": generate_seq2seq rewrites the DECODER block kernels
+    # to int8 + per-output-channel scales (QDense) for the decode loop —
+    # the decoder weights are the stream every step re-reads, while the
+    # encoder runs once per sample at full precision. Same contract as
+    # TransformerConfig.decode_weights_quant.
+    decode_weights_quant: Optional[str] = None
     # pipeline parallelism: microbatches per pipelined stack when the
     # mesh has a pp axis > 1 (0 = one per stage); raise to shrink the
     # (pp-1)/(M+pp-1) bubble — mirrors TransformerConfig.pp_microbatches
@@ -183,7 +189,7 @@ class T5Attention(nn.Module):
         cfg = self.cfg
         H, Dk = cfg.n_head, cfg.d_kv
         dense = partial(
-            nn.DenseGeneral,
+            QDense,
             axis=-1,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
@@ -243,7 +249,7 @@ class T5Attention(nn.Module):
             scores = scores + bias
             probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
             out = jnp.einsum("bhts,bshd->bthd", probs, v)
-        proj = nn.DenseGeneral(
+        proj = QDense(
             features=cfg.d_model,
             axis=(-2, -1),
             dtype=cfg.dtype,
@@ -262,7 +268,7 @@ class T5MLP(nn.Module):
     def __call__(self, x: Array) -> Array:
         cfg = self.cfg
         dense = partial(
-            nn.DenseGeneral,
+            QDense,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             use_bias=False,
@@ -781,6 +787,12 @@ def generate_seq2seq(
 
     mesh = getattr(model, "mesh", None)
     params = dict(params, decoder=unshard_for_decode(params["decoder"], mesh))
+    if cfg.decode_weights_quant == "int8":
+        # decoder-only weight quantization: the decode loop re-reads the
+        # decoder stack every step (the encoder ran once, full precision)
+        from trlx_tpu.models.transformer import quantize_decode_weights
+
+        params = dict(params, decoder=quantize_decode_weights(params["decoder"]))
     enc = model.encode(params, input_ids, attention_mask)
     cache = model.init_cache(B, N + 1)
     start = jnp.full((B, 1), cfg.decoder_start_token_id, jnp.int32)

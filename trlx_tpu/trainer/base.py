@@ -71,7 +71,8 @@ _RUNTIME_TRANSFORMER_KEYS = frozenset({
 def _apply_runtime_overrides(cfg, extra_dict):
     """Apply _RUNTIME_TRANSFORMER_KEYS present in a model_extra_configs
     sub-dict onto a loaded model config (only the fields the config
-    actually has — seq2seq lacks the quant knobs, for instance)."""
+    actually has — seq2seq has decode_weights_quant but not
+    kv_cache_quant, for instance)."""
     names = {f.name for f in dataclasses.fields(cfg)}
     ov = {
         k: v
