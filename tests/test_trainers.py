@@ -33,24 +33,41 @@ def word_count_reward(samples, prompts, outputs, **kwargs):
     return [float(len(o.split())) for o in outputs]
 
 
+PPO_PROMPTS = ["hello world", "the cat", "a b", "xyz", "what is", "I am", "go", "ok"]
+
+
+def ppo_tiny_config(ckpt_dir, *, train=None, model=None, method=None):
+    """The shared tiny-PPO learn() recipe (one source for the several
+    integration tests that run it with small variations)."""
+    return default_ppo_config().evolve(
+        train=dict(
+            dict(batch_size=8, total_steps=2, eval_interval=2,
+                 checkpoint_interval=2, seq_length=12, epochs=2,
+                 tracker=None, checkpoint_dir=str(ckpt_dir)),
+            **(train or {}),
+        ),
+        model=model or tiny_model_cfg(num_layers_unfrozen=1),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            dict(num_rollouts=8, chunk_size=8, ppo_epochs=1,
+                 gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0,
+                                 do_sample=True)),
+            **(method or {}),
+        ),
+    )
+
+
+def read_metrics(ckpt_dir):
+    fp = os.path.join(str(ckpt_dir), "logs", "metrics.jsonl")
+    return [json.loads(line) for line in open(fp)]
+
+
 @pytest.mark.slow
 def test_ppo_learn_and_checkpoint_layout(tmp_path):
     ckpt_dir = str(tmp_path / "ckpts")
-    config = default_ppo_config().evolve(
-        train=dict(
-            batch_size=8, total_steps=2, eval_interval=2, checkpoint_interval=2,
-            seq_length=12, epochs=2, tracker=None, checkpoint_dir=ckpt_dir,
-        ),
-        model=tiny_model_cfg(num_layers_unfrozen=1),
-        tokenizer=dict(tokenizer_path="byte"),
-        method=dict(
-            num_rollouts=8, chunk_size=8, ppo_epochs=1,
-            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
-        ),
-    )
-    prompts = ["hello world", "the cat", "a b", "xyz", "what is", "I am", "go", "ok"]
+    config = ppo_tiny_config(ckpt_dir)
     trainer = trlx_tpu.train(
-        reward_fn=word_count_reward, prompts=prompts, config=config
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS, config=config
     )
     assert trainer.iter_count == 2
 
@@ -64,8 +81,7 @@ def test_ppo_learn_and_checkpoint_layout(tmp_path):
         assert json.load(f)["iter_count"] == 2
 
     # metrics jsonl got reward/mean
-    metrics_fp = os.path.join(ckpt_dir, "logs", "metrics.jsonl")
-    recs = [json.loads(line) for line in open(metrics_fp)]
+    recs = read_metrics(ckpt_dir)
     assert any("reward/mean" in r for r in recs)
     assert any("policy/sqrt_kl" in r for r in recs)
 
@@ -235,28 +251,18 @@ def test_ppo_fused_inner_loop(tmp_path):
     # jitted scan; learn() must still checkpoint, eval and converge on
     # finite losses
     ckpt_dir = str(tmp_path / "ckpts")
-    config = default_ppo_config().evolve(
-        train=dict(
-            batch_size=8, total_steps=4, eval_interval=2, checkpoint_interval=2,
-            seq_length=12, epochs=4, tracker=None, checkpoint_dir=ckpt_dir,
-            fused_inner_loop=True,
-        ),
-        model=tiny_model_cfg(num_layers_unfrozen=1),
-        tokenizer=dict(tokenizer_path="byte"),
-        method=dict(
-            num_rollouts=16, chunk_size=8, ppo_epochs=2,
-            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
-        ),
+    config = ppo_tiny_config(
+        ckpt_dir,
+        train=dict(total_steps=4, epochs=4, fused_inner_loop=True),
+        method=dict(num_rollouts=16, ppo_epochs=2),
     )
-    prompts = ["hello world", "the cat", "a b", "xyz", "what is", "I am", "go", "ok"]
     trainer = trlx_tpu.train(
-        reward_fn=word_count_reward, prompts=prompts, config=config
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS, config=config
     )
     assert trainer.iter_count >= 4
     names = sorted(os.listdir(ckpt_dir))
     assert "best_checkpoint" in names
-    metrics_fp = os.path.join(ckpt_dir, "logs", "metrics.jsonl")
-    recs = [json.loads(line) for line in open(metrics_fp)]
+    recs = read_metrics(ckpt_dir)
     losses = [r["losses/total_loss"] for r in recs if "losses/total_loss" in r]
     assert losses and all(np.isfinite(l) for l in losses)
 
@@ -512,3 +518,30 @@ def test_ilql_seq2seq_decoder_rows_start_with_start_token():
     ]
     assert first_labels[0] == tok("ab")["input_ids"][0]
     assert first_labels[1] == tok("cd")["input_ids"][0]
+
+
+@pytest.mark.slow
+def test_ppo_learn_int8_rollout_streams(tmp_path):
+    """PPO learn() with the 1.3B preset's rollout quantization
+    (kv_cache_quant + decode_weights_quant = int8) on the 8-device CPU
+    mesh: rollouts sample through int8 weight/KV streams while the
+    experience and train passes stay full precision — losses finite,
+    reward metrics emitted."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    config = ppo_tiny_config(
+        ckpt_dir,
+        train=dict(checkpoint_interval=10),
+        model=tiny_model_cfg(
+            num_layers_unfrozen=1,
+            kv_cache_quant="int8", decode_weights_quant="int8",
+        ),
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS, config=config
+    )
+    assert trainer.iter_count == 2
+    assert trainer.model.cfg.kv_cache_quant == "int8"
+    recs = read_metrics(ckpt_dir)
+    losses = [r["losses/total_loss"] for r in recs if "losses/total_loss" in r]
+    assert losses and all(np.isfinite(l) for l in losses)
+    assert any("reward/mean" in r for r in recs)
