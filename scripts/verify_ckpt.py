@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Offline checkpoint validator.
+
+Validates a checkpoint directory (or a whole checkpoint root) without
+touching accelerators: commit marker present and well-formed, orbax
+`state/` tree present, `state.json` parses and carries a step counter,
+`hf_model/` deploy export present. With `--deep` the orbax tree is
+actually restored (CPU) and every array leaf is checked finite.
+
+Usage:
+    python scripts/verify_ckpt.py ckpts/checkpoint_0042 [--deep]
+    python scripts/verify_ckpt.py ckpts            # scan every checkpoint_*/best_checkpoint
+Exit code 0 = everything checked out; 1 = at least one problem.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# validator must run on build/login nodes with no TPU attached
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.utils.checkpointing import COMMIT_MARKER, is_committed  # noqa: E402
+
+
+def check_one(directory: str, deep: bool = False) -> list:
+    """Return a list of problem strings (empty = valid)."""
+    problems = []
+    if not os.path.isdir(directory):
+        return [f"{directory}: not a directory"]
+
+    marker = os.path.join(directory, COMMIT_MARKER)
+    if not is_committed(directory):
+        problems.append(
+            f"{directory}: no {COMMIT_MARKER} marker (torn write from a "
+            "mid-save preemption, or a pre-fault-tolerance checkpoint) — "
+            "auto-resume will skip it"
+        )
+    else:
+        try:
+            with open(marker) as f:
+                json.load(f)
+        except Exception as e:
+            problems.append(f"{marker}: marker unreadable ({e})")
+
+    state_dir = os.path.join(directory, "state")
+    if not os.path.isdir(state_dir):
+        problems.append(
+            f"{directory}: no state/ tree (saved with save_optimizer=false? "
+            "resume would restore params only via hf_model)"
+        )
+
+    state_fp = os.path.join(directory, "state.json")
+    if not os.path.isfile(state_fp):
+        problems.append(
+            f"{directory}: no state.json — a resume cannot recover "
+            "iter_count/best_reward/PRNG and restarts counters from 0"
+        )
+    else:
+        try:
+            with open(state_fp) as f:
+                state = json.load(f)
+            if "iter_count" not in state:
+                problems.append(f"{state_fp}: missing iter_count")
+        except Exception as e:
+            problems.append(f"{state_fp}: unparseable ({e})")
+
+    if not os.path.isdir(os.path.join(directory, "hf_model")):
+        problems.append(f"{directory}: no hf_model/ deploy export")
+
+    if deep and os.path.isdir(state_dir):
+        try:
+            import numpy as np
+            import orbax.checkpoint as ocp
+
+            tree = ocp.PyTreeCheckpointer().restore(os.path.abspath(state_dir))
+            import jax
+
+            bad = [
+                path
+                for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+                if np.issubdtype(np.asarray(leaf).dtype, np.floating)
+                and not np.all(np.isfinite(np.asarray(leaf)))
+            ]
+            if bad:
+                problems.append(
+                    f"{state_dir}: non-finite values in {len(bad)} leaves "
+                    f"(first: {bad[0]})"
+                )
+        except Exception as e:
+            problems.append(f"{state_dir}: orbax restore failed ({e})")
+
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("path", help="checkpoint dir or checkpoint root")
+    parser.add_argument(
+        "--deep", action="store_true",
+        help="restore the orbax state tree and check every leaf finite",
+    )
+    args = parser.parse_args(argv)
+
+    path = os.path.abspath(args.path)
+    # a root is a directory that itself holds checkpoint_*/best_checkpoint
+    entries = sorted(os.listdir(path)) if os.path.isdir(path) else []
+    children = [
+        os.path.join(path, e)
+        for e in entries
+        if e.startswith("checkpoint_") or e == "best_checkpoint"
+    ]
+    if children:
+        targets = children
+    elif any(
+        os.path.exists(os.path.join(path, p))
+        for p in (COMMIT_MARKER, "state", "state.json", "hf_model")
+    ):
+        targets = [path]  # a single checkpoint directory
+    else:
+        # a checkpoint ROOT with nothing committed yet (young run, or
+        # only tmp_/logs entries): that's a clean fresh-start state,
+        # not corruption — don't validate the root as if it were a
+        # checkpoint
+        print(f"OK    {path}: no checkpoints yet (fresh start)")
+        return 0
+
+    rc = 0
+    for entry in entries:
+        if entry.startswith("tmp_old_"):
+            print(
+                f"NOTE  {os.path.join(path, entry)}: aside copy from an "
+                "interrupted re-commit — the previous good version of "
+                f"'{entry[len('tmp_old_'):].rsplit('.', 1)[0]}'; restore "
+                "it by renaming if the final copy is missing/torn"
+            )
+    for target in targets:
+        problems = check_one(target, deep=args.deep)
+        if problems:
+            rc = 1
+            for p in problems:
+                print(f"FAIL  {p}")
+        else:
+            step = "?"
+            try:
+                with open(os.path.join(target, "state.json")) as f:
+                    step = json.load(f).get("iter_count", "?")
+            except Exception:
+                pass
+            print(f"OK    {target} (iter_count={step})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,596 @@
+"""Fault-tolerance subsystem tests: atomic checkpoint commits, auto-resume
+discovery, retention, preemption, retry/backoff and the NaN-loss guard —
+including a fault-injection harness that kills a tiny-PPO run
+mid-training, corrupts checkpoints, and injects a flaky tracker and a NaN
+reward (ISSUE 1 acceptance scenario). Runs under tier-1 (CPU, not slow)."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import trlx_tpu
+from trlx_tpu.utils.checkpointing import (
+    COMMIT_MARKER,
+    CheckpointManager,
+    PreemptionHandler,
+    is_committed,
+    retry_call,
+)
+
+from tests.test_trainers import (
+    PPO_PROMPTS,
+    ppo_tiny_config,
+    read_metrics,
+    tiny_model_cfg,
+    word_count_reward,
+)
+
+FAST_RETRY = dict(external_retries=2, retry_base_delay=0.01)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager unit tests
+# ---------------------------------------------------------------------------
+
+
+def _commit_dummy(mgr, name, step=0):
+    def write(tmp):
+        with open(os.path.join(tmp, "state.json"), "w") as f:
+            json.dump({"iter_count": step}, f)
+
+    return mgr.commit(name, write)
+
+
+def test_atomic_commit_and_discovery(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = CheckpointManager(root)
+    assert mgr.latest_committed() is None
+
+    path = _commit_dummy(mgr, "checkpoint_2", step=2)
+    assert is_committed(path)
+    assert mgr.latest_committed() == path
+
+    # a writer crash mid-save leaves only an ignorable tmp_ dir: nothing
+    # discoverable changes and a later commit of the same name succeeds
+    with pytest.raises(RuntimeError, match="boom"):
+        mgr.commit("checkpoint_4", lambda tmp: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert mgr.latest_committed() == path
+    assert not os.path.exists(os.path.join(root, "checkpoint_4"))
+    path4 = _commit_dummy(mgr, "checkpoint_4", step=4)
+    assert mgr.latest_committed() == path4
+
+    # a torn directory WITHOUT a marker (preemption between rename and
+    # marker write) is skipped by discovery, even when its step is newest
+    os.makedirs(os.path.join(root, "checkpoint_9"))
+    assert mgr.latest_committed() == path4
+    # zero-padded step names sort numerically, not lexically
+    path10 = _commit_dummy(mgr, "checkpoint_10", step=10)
+    assert mgr.latest_committed() == path10
+    # any successful commit sweeps stale tmp_ dirs from crashed commits
+    # of OTHER names (step names are never reused, so nothing else would)
+    assert not [
+        e for e in os.listdir(root)
+        if e.startswith("tmp_") and not e.startswith("tmp_old_")
+    ]
+
+
+def test_recommit_same_name_replaces(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+
+    def write_v(version):
+        def write(tmp):
+            with open(os.path.join(tmp, "v.txt"), "w") as f:
+                f.write(version)
+
+        return write
+
+    mgr.commit("best_checkpoint", write_v("one"))
+    path = mgr.commit("best_checkpoint", write_v("two"))
+    assert open(os.path.join(path, "v.txt")).read() == "two"
+    assert is_committed(path)
+
+
+def test_latest_resumable_skips_deploy_only(tmp_path):
+    """save_optimizer=false runs commit deploy-only checkpoints (no
+    state/ tree); auto-resume must fall back past them instead of
+    handing trainer.load() a directory it will crash on."""
+    root = str(tmp_path / "ckpts")
+    mgr = CheckpointManager(root)
+
+    def write_full(tmp):
+        os.makedirs(os.path.join(tmp, "state"))
+        with open(os.path.join(tmp, "state.json"), "w") as f:
+            json.dump({"iter_count": 2}, f)
+
+    full = mgr.commit("checkpoint_2", write_full)
+    deploy_only = _commit_dummy(mgr, "checkpoint_4", step=4)  # no state/
+    assert mgr.latest_committed() == deploy_only
+    assert mgr.latest_resumable() == full
+
+
+def test_retention_keeps_last_n_and_best(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = CheckpointManager(root, keep_last_n=2)
+    _commit_dummy(mgr, "best_checkpoint")
+    for step in (1, 2, 3, 4):
+        _commit_dummy(mgr, f"checkpoint_{step}", step=step)
+    names = sorted(os.listdir(root))
+    assert "checkpoint_3" in names and "checkpoint_4" in names
+    assert "checkpoint_1" not in names and "checkpoint_2" not in names
+    assert "best_checkpoint" in names  # never reaped
+
+
+# ---------------------------------------------------------------------------
+# retry / preemption / any_flag units
+# ---------------------------------------------------------------------------
+
+
+def test_retry_call_flaky_then_success():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert retry_call(flaky, retries=3, base_delay=0.01) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_call_exhausts_and_raises():
+    calls = {"n": 0}
+
+    def dead():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        retry_call(dead, retries=2, base_delay=0.01)
+    assert calls["n"] == 3  # first attempt + 2 retries
+
+
+def test_preemption_handler_sigterm():
+    handler = PreemptionHandler().install()
+    try:
+        assert not handler.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert handler.requested()
+    finally:
+        handler.uninstall()
+    # handlers restored: a fresh handler starts clean
+    assert not PreemptionHandler().requested()
+    # re-install clears the stale flag: a follow-up learn() on the same
+    # trainer must train, not instantly exit
+    handler.install()
+    try:
+        assert not handler.requested()
+    finally:
+        handler.uninstall()
+
+
+def test_any_flag_single_host():
+    from trlx_tpu.parallel import multihost as mh
+
+    assert mh.any_flag(True) is True
+    assert mh.any_flag(False) is False
+
+
+# ---------------------------------------------------------------------------
+# NaN/inf loss guard
+# ---------------------------------------------------------------------------
+
+
+def _sft_config(ckpt_dir, **train):
+    from trlx_tpu.data.default_configs import default_sft_config
+
+    return default_sft_config().evolve(
+        train=dict(
+            dict(batch_size=8, total_steps=2, eval_interval=10,
+                 checkpoint_interval=10, seq_length=16, epochs=2,
+                 tracker=None, checkpoint_dir=str(ckpt_dir), **FAST_RETRY),
+            **train,
+        ),
+        model=tiny_model_cfg(),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=False)),
+    )
+
+
+def _tiny_sft_trainer(ckpt_dir, **train):
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = _sft_config(ckpt_dir, **train)
+    return get_trainer(config.train.trainer)(config=config), config
+
+
+def test_nan_guard_skips_update_keeps_params(tmp_path):
+    """A non-finite loss must commit the PRE-update params/opt_state (the
+    jitted step donates buffers, so the select lives inside the trace)."""
+    import jax
+
+    from trlx_tpu.data import SFTBatch
+
+    trainer, _ = _tiny_sft_trainer(tmp_path / "ckpts")
+    batch = trainer.place_batch(
+        SFTBatch(
+            input_ids=np.full((8, 8), 65, np.int32),
+            attention_mask=np.ones((8, 8), np.int32),
+            labels=np.full((8, 8), 66, np.int32),
+        )
+    )
+    before = [np.asarray(x) for x in jax.tree_util.tree_leaves(trainer.params)]
+
+    real_loss = trainer.loss
+    trainer.loss = lambda params, b: (
+        jax.numpy.float32(np.nan) * real_loss(params, b)[0],
+        real_loss(params, b)[1],
+    )
+    step = trainer.make_train_step()
+    with trainer.mesh:
+        trainer.params, trainer.opt_state, loss, _ = step(
+            trainer.params, trainer.opt_state, batch
+        )
+    assert not np.isfinite(float(np.asarray(loss)))
+    after = [np.asarray(x) for x in jax.tree_util.tree_leaves(trainer.params)]
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+    assert trainer._guard_bad_loss(float(np.asarray(loss))) is True
+
+    # a good step still updates params and resets the abort counter
+    trainer.loss = real_loss
+    trainer._train_step = None
+    step = trainer.make_train_step()
+    with trainer.mesh:
+        trainer.params, trainer.opt_state, loss, _ = step(
+            trainer.params, trainer.opt_state, batch
+        )
+    assert np.isfinite(float(np.asarray(loss)))
+    changed = any(
+        not np.array_equal(a, b)
+        for a, b in zip(
+            before, [np.asarray(x) for x in jax.tree_util.tree_leaves(trainer.params)]
+        )
+    )
+    assert changed
+    assert trainer._guard_bad_loss(float(np.asarray(loss))) is False
+    assert trainer._bad_steps == 0
+
+
+def test_nan_reward_aborts_after_max_bad_steps(tmp_path):
+    """A reward function stuck on NaN poisons every loss; the guard skips
+    each update and aborts the run after max_bad_steps consecutive bad
+    steps instead of burning the allocation forever."""
+    config = ppo_tiny_config(
+        str(tmp_path / "ckpts"),
+        train=dict(total_steps=8, epochs=8, checkpoint_interval=100,
+                   eval_interval=100, max_bad_steps=2, **FAST_RETRY),
+    )
+
+    def nan_reward(samples, prompts, outputs, **kw):
+        return [float("nan") for _ in outputs]
+
+    with pytest.raises(RuntimeError, match="consecutive non-finite"):
+        trlx_tpu.train(reward_fn=nan_reward, prompts=PPO_PROMPTS, config=config)
+
+
+# ---------------------------------------------------------------------------
+# fault-injection harness: kill a tiny-PPO run mid-training, corrupt a
+# checkpoint, inject a flaky tracker + flaky reward, auto-resume
+# ---------------------------------------------------------------------------
+
+
+def test_ppo_kill_resume_auto(tmp_path, monkeypatch):
+    ckpt_dir = str(tmp_path / "ckpts")
+
+    def cfg(**train):
+        return ppo_tiny_config(
+            ckpt_dir,
+            train=dict(
+                dict(total_steps=4, epochs=4, eval_interval=4,
+                     checkpoint_interval=1, save_best=False, **FAST_RETRY),
+                **train,
+            ),
+        )
+
+    # run 1: a flaky-once reward (retry must absorb it), then a SIGTERM
+    # mid-rollout — learn() must commit one final checkpoint and exit
+    calls = {"reward": 0, "flaked": False}
+
+    def reward_killer(samples, prompts, outputs, **kw):
+        calls["reward"] += 1
+        if calls["reward"] == 2 and not calls["flaked"]:
+            calls["flaked"] = True  # transient failure: succeeds on retry
+            raise ConnectionError("reward service hiccup")
+        if calls["reward"] == 4:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return word_count_reward(samples, prompts, outputs)
+
+    trainer = trlx_tpu.train(
+        reward_fn=reward_killer, prompts=PPO_PROMPTS, config=cfg()
+    )
+    killed_at = trainer.iter_count
+    assert 0 < killed_at < 4, "run should have been preempted mid-training"
+    assert calls["flaked"], "flaky reward path was exercised"
+    last = CheckpointManager(ckpt_dir).latest_committed()
+    assert last is not None and is_committed(last)
+    with open(os.path.join(last, "state.json")) as f:
+        state = json.load(f)
+    assert state["iter_count"] == killed_at
+    assert "rng_key" in state and "kl_ctl_value" in state
+
+    # corrupt the world a bit: a TORN newer checkpoint (no COMMIT — what
+    # a preemption mid-save leaves) must be skipped by auto-resume
+    torn = os.path.join(ckpt_dir, "checkpoint_9")
+    os.makedirs(os.path.join(torn, "state"))
+    with open(os.path.join(torn, "state.json"), "w") as f:
+        f.write('{"iter_count": 9')  # truncated json, no marker
+    assert CheckpointManager(ckpt_dir).latest_committed() == last
+
+    # run 2: auto-resume with a flaky tracker (every log call fails once;
+    # the retry wrapper must keep every record)
+    from trlx_tpu.utils.trackers import Tracker
+
+    real_log = Tracker.log
+    tracker_state = {"fail_next": True}
+
+    def flaky_log(self, stats, step):
+        if tracker_state["fail_next"]:
+            tracker_state["fail_next"] = False
+            raise ConnectionError("tracker outage")
+        tracker_state["fail_next"] = True
+        return real_log(self, stats, step)
+
+    monkeypatch.setattr(Tracker, "log", flaky_log)
+    resumed = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS,
+        config=cfg(resume_from_checkpoint="auto"),
+    )
+    monkeypatch.setattr(Tracker, "log", real_log)
+
+    assert resumed.iter_count == 4
+    # tracker steps stay monotonic across the restart, per-step loss
+    # records never repeat a step index, and every loss is finite
+    recs = read_metrics(ckpt_dir)
+    steps = [r["_step"] for r in recs]
+    assert steps == sorted(steps), f"non-monotonic tracker steps: {steps}"
+    loss_steps = [r["_step"] for r in recs if "losses/total_loss" in r]
+    assert len(loss_steps) == len(set(loss_steps)) == 4, loss_steps
+    losses = [r["losses/total_loss"] for r in recs if "losses/total_loss" in r]
+    assert losses and all(np.isfinite(l) for l in losses)
+    # every step checkpoint on disk is committed (atomic protocol)
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("checkpoint_") and name != "checkpoint_9":
+            assert is_committed(os.path.join(ckpt_dir, name)), name
+
+    # run 3: relaunching the COMPLETED job's command line must bail
+    # before paying a rollout (no reward_fn calls at all)
+    relaunch_calls = {"n": 0}
+
+    def counting_reward(samples, prompts, outputs, **kw):
+        relaunch_calls["n"] += 1
+        return word_count_reward(samples, prompts, outputs)
+
+    again = trlx_tpu.train(
+        reward_fn=counting_reward, prompts=PPO_PROMPTS,
+        config=cfg(resume_from_checkpoint="auto"),
+    )
+    assert again.iter_count == 4
+    assert relaunch_calls["n"] == 0, "completed relaunch paid a rollout"
+
+
+def test_ppo_preemption_abandons_rollout(tmp_path):
+    """A SIGTERM during rollout collection must abandon the remaining
+    chunks (collection dominates PPO wall-clock; the grace period would
+    expire waiting for them), checkpoint, and exit — and the checkpoint
+    must resume cleanly."""
+    ckpt_dir = str(tmp_path / "ckpts")
+
+    def cfg(**train):
+        return ppo_tiny_config(
+            ckpt_dir,
+            train=dict(
+                dict(total_steps=2, epochs=2, eval_interval=10,
+                     checkpoint_interval=1, save_best=False, **FAST_RETRY),
+                **train,
+            ),
+            # 2 chunks per rollout cycle: the kill lands in chunk 1's
+            # scoring, the abandonment check fires before chunk 2
+            method=dict(num_rollouts=16, chunk_size=8),
+        )
+
+    calls = {"n": 0}
+
+    def reward_kill_first(samples, prompts, outputs, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return word_count_reward(samples, prompts, outputs)
+
+    trainer = trlx_tpu.train(
+        reward_fn=reward_kill_first, prompts=PPO_PROMPTS, config=cfg()
+    )
+    # chunk 2 (and the initial evaluation) never ran: one reward call
+    assert calls["n"] == 1
+    assert trainer.iter_count == 0
+    last = CheckpointManager(ckpt_dir).latest_committed()
+    assert last is not None and os.path.basename(last) == "checkpoint_0"
+
+    resumed = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS,
+        config=cfg(resume_from_checkpoint="auto"),
+    )
+    assert resumed.iter_count == 2
+
+
+# ---------------------------------------------------------------------------
+# save -> reconstruct -> resume round-trips (SFT, ILQL; PPO above)
+# ---------------------------------------------------------------------------
+
+
+def test_sft_save_resume_roundtrip(tmp_path):
+    import jax
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    samples = [("question", "answer"), ("hi", "there")] * 8
+    config = _sft_config(
+        ckpt_dir, total_steps=2, checkpoint_interval=2,
+        resume_from_checkpoint="auto",  # empty dir: fresh start + warning
+    )
+    first = trlx_tpu.train(samples=samples, config=config)
+    assert first.iter_count == 2
+
+    config2 = config.evolve(train=dict(total_steps=4, resume_from_checkpoint="auto"))
+    resumed = trlx_tpu.train(samples=samples, config=config2)
+    assert resumed.iter_count == 4  # continued, not replayed from 0
+    assert all(
+        np.all(np.isfinite(np.asarray(x)))
+        for x in jax.tree_util.tree_leaves(resumed.params)
+    )
+    recs = read_metrics(ckpt_dir)
+    loss_steps = [r["_step"] for r in recs if "losses/loss" in r]
+    assert len(loss_steps) == len(set(loss_steps)) == 4, loss_steps
+
+
+def test_ilql_save_resume_roundtrip(tmp_path):
+    import jax
+
+    from trlx_tpu.data.default_configs import default_ilql_config
+
+    ckpt_dir = str(tmp_path / "ckpts")
+
+    def cfg(total_steps):
+        return default_ilql_config().evolve(
+            train=dict(
+                batch_size=8, total_steps=total_steps, eval_interval=10,
+                checkpoint_interval=2, seq_length=16, epochs=8, tracker=None,
+                checkpoint_dir=ckpt_dir, resume_from_checkpoint="auto",
+                **FAST_RETRY,
+            ),
+            model=tiny_model_cfg(),
+            tokenizer=dict(tokenizer_path="byte"),
+            method=dict(
+                steps_for_target_q_sync=1,
+                gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0),
+            ),
+        )
+
+    samples = [("q", "good"), ("q", "bad"), ("p", "fine"), ("p", "meh")] * 4
+    rewards = [1.0, -1.0, 0.5, -0.5] * 4
+    first = trlx_tpu.train(samples=samples, rewards=rewards, config=cfg(2))
+    assert first.iter_count == 2
+    resumed = trlx_tpu.train(samples=samples, rewards=rewards, config=cfg(4))
+    assert resumed.iter_count == 4
+    assert all(
+        np.all(np.isfinite(np.asarray(x)))
+        for x in jax.tree_util.tree_leaves(resumed.params)
+    )
+
+
+def test_load_missing_state_json_warns(tmp_path):
+    """A legacy/corrupt checkpoint without state.json restores params but
+    must WARN (naming the directory) instead of silently masquerading as
+    a fresh run at step 0."""
+    import logging as pylogging
+
+    trainer, _ = _tiny_sft_trainer(tmp_path / "ckpts")
+    trainer.iter_count = 7
+    ckpt = str(tmp_path / "manual_ckpt")
+    trainer.save(ckpt)
+    assert os.path.exists(os.path.join(ckpt, "state.json"))
+    assert not os.path.exists(os.path.join(ckpt, "state.json.tmp"))
+    os.unlink(os.path.join(ckpt, "state.json"))
+
+    # the project root logger has propagate=False, so capture directly
+    messages = []
+
+    class _Capture(pylogging.Handler):
+        def emit(self, record):
+            messages.append(record.getMessage())
+
+    capture = _Capture(level=pylogging.WARNING)
+    root = pylogging.getLogger("trlx_tpu")
+    root.addHandler(capture)
+    try:
+        fresh, _ = _tiny_sft_trainer(tmp_path / "ckpts2")
+        fresh.load(ckpt)
+    finally:
+        root.removeHandler(capture)
+    assert fresh.iter_count == 0
+    assert any("no state.json" in m and ckpt in m for m in messages), messages
+
+
+def test_save_state_json_contents(tmp_path):
+    """state.json carries the full resumable scalar state, and a reloaded
+    trainer restores it bitwise (incl. the PRNG key)."""
+    trainer, _ = _tiny_sft_trainer(tmp_path / "ckpts")
+    trainer.iter_count = 5
+    trainer.best_reward = 1.25
+    trainer.nth_evaluation = 3
+    ckpt = str(tmp_path / "ckpt")
+    trainer.save(ckpt)
+    with open(os.path.join(ckpt, "state.json")) as f:
+        state = json.load(f)
+    assert state["iter_count"] == 5
+    assert state["best_reward"] == 1.25
+    assert state["nth_evaluation"] == 3
+    assert isinstance(state["rng_key"], list) and len(state["rng_key"]) >= 2
+
+    fresh, _ = _tiny_sft_trainer(tmp_path / "ckpts2")
+    fresh.load(ckpt)
+    assert fresh.iter_count == 5
+    assert fresh.best_reward == 1.25
+    assert fresh.nth_evaluation == 3
+    np.testing.assert_array_equal(
+        np.asarray(fresh.rng), np.asarray(trainer.rng)
+    )
+
+
+# ---------------------------------------------------------------------------
+# offline validator (scripts/verify_ckpt.py)
+# ---------------------------------------------------------------------------
+
+
+def _load_verify_ckpt():
+    import importlib.util
+
+    fp = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "verify_ckpt.py",
+    )
+    spec = importlib.util.spec_from_file_location("verify_ckpt", fp)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_verify_ckpt_offline(tmp_path, capsys):
+    verify_ckpt = _load_verify_ckpt()
+    root = str(tmp_path / "ckpts")
+    mgr = CheckpointManager(root)
+
+    def write_good(tmp):
+        os.makedirs(os.path.join(tmp, "state"))
+        os.makedirs(os.path.join(tmp, "hf_model"))
+        with open(os.path.join(tmp, "state.json"), "w") as f:
+            json.dump({"iter_count": 3}, f)
+
+    good = mgr.commit("checkpoint_3", write_good)
+    assert verify_ckpt.check_one(good) == []
+    assert verify_ckpt.main([good]) == 0
+
+    # torn checkpoint: no marker, truncated state.json
+    torn = os.path.join(root, "checkpoint_5")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "state.json"), "w") as f:
+        f.write('{"iter_count"')
+    problems = verify_ckpt.check_one(torn)
+    assert any(COMMIT_MARKER in p for p in problems)
+    assert any("unparseable" in p for p in problems)
+    # root scan mode sees both and fails overall
+    assert verify_ckpt.main([root]) == 1
+    out = capsys.readouterr().out
+    assert "OK" in out and "FAIL" in out

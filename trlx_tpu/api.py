@@ -16,8 +16,10 @@ from trlx_tpu.data.default_configs import (
     default_ppo_config,
     default_sft_config,
 )
-from trlx_tpu.utils import set_seed
+from trlx_tpu.utils import logging, set_seed
 from trlx_tpu.utils.loading import get_pipeline, get_trainer
+
+logger = logging.get_logger(__name__)
 
 
 def train(
@@ -114,8 +116,29 @@ def train(
     )
     trainer.add_eval_pipeline(eval_pipeline)
 
-    if config.train.resume_from_checkpoint:
-        trainer.load(config.train.resume_from_checkpoint)
+    resume = config.train.resume_from_checkpoint
+    if resume == "auto":
+        # discover the newest COMMITted checkpoint under checkpoint_dir;
+        # torn directories (preemption mid-save) and deploy-only ones
+        # (save_optimizer=false) are skipped, and "nothing yet" is a
+        # fresh start — the standard relaunch loop on preemptible pods
+        # points every attempt at the same command line
+        resume = trainer.ckpt_manager.latest_resumable()
+        from trlx_tpu.parallel import multihost as mh
+
+        if mh.is_multihost():
+            # stale shared-filesystem metadata can show different hosts
+            # different listings; every process must load the SAME
+            # checkpoint (or none), so process 0's discovery wins
+            resume = mh.allgather_object(resume)[0]
+        if resume is None:
+            logger.warning(
+                "resume_from_checkpoint='auto': no committed checkpoint "
+                "under %s — starting fresh", config.train.checkpoint_dir,
+            )
+    if resume:
+        logger.info("Resuming from checkpoint %s", resume)
+        trainer.load(resume)
 
     trainer.learn()
     return trainer

@@ -134,7 +134,15 @@ class TrainConfig(_Section):
     rollout_logging_dir: Optional[str] = None
     save_best: bool = True
     save_optimizer: bool = True
+    # A checkpoint directory to restore full training state from, or
+    # "auto": discover the newest COMMITted checkpoint_* under
+    # checkpoint_dir and resume it (fresh start, with a logged warning,
+    # when none exists). Resume continues from the saved iter_count /
+    # best_reward / PRNG key / data cursor — it does not replay from 0.
     resume_from_checkpoint: Optional[str] = None
+    # Retention: keep only the newest N committed checkpoint_* dirs
+    # (best_checkpoint always survives). None keeps everything.
+    keep_last_n: Optional[int] = None
 
     tracker: Optional[str] = "tensorboard"
     logging_dir: Optional[str] = None
@@ -193,6 +201,24 @@ class TrainConfig(_Section):
     # and emits `time/forward` = that measurement and `time/backward` =
     # step - forward, matching the reference's metric keys.
     timing_split: bool = False
+    # --- fault tolerance ------------------------------------------------
+    # Non-finite (NaN/inf) loss or grads: commit the PRE-update
+    # params/opt_state instead of the poisoned update (a traced select
+    # inside the jitted step — the buffers are donated, so the host
+    # could not roll back). With the fused 8-bit optimizer the guard
+    # zeroes the gradients before the apply instead, so a poisoned step
+    # degrades to a weight-decay-only update (docs/api.md).
+    skip_nan_updates: bool = True
+    # Abort the run after this many CONSECUTIVE skipped (non-finite)
+    # steps: persistent NaN means diverged state, not a transient.
+    max_bad_steps: int = 3
+    # Retry budget (re-tries after the first attempt) for the two
+    # external calls in the loop — tracker.log and the reward function —
+    # with exponential backoff from retry_base_delay (doubling, capped,
+    # jittered). A tracker that stays down degrades to a logged error;
+    # a reward function that stays down fails the run.
+    external_retries: int = 3
+    retry_base_delay: float = 0.5
     # Run ALL inner-epoch optimizer steps as one jitted lax.scan over
     # minibatch permutations instead of one dispatch per minibatch
     # (trainers that hold the epoch's data as a rectangular batch — PPO's

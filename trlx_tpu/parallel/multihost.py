@@ -286,6 +286,24 @@ def allgather_group_rows(x, mesh=None) -> np.ndarray:
     return np.concatenate([blocks[r] for r in reps], axis=0)
 
 
+def any_flag(value: bool) -> bool:
+    """True on every process iff ANY process passed True. The preemption
+    path needs this rather than `broadcast_flag`: a SIGTERM lands on
+    whichever host the scheduler is reclaiming — not necessarily process
+    0 — and every host must agree to stop and join the final collective
+    checkpoint save, or the survivors deadlock in it."""
+    if not is_multihost():
+        return bool(value)
+    from jax.experimental import multihost_utils
+
+    flags = np.asarray(
+        multihost_utils.process_allgather(
+            np.asarray([1 if value else 0], np.int32)
+        )
+    )
+    return bool(flags.any())
+
+
 def broadcast_flag(value: bool) -> bool:
     """Process 0's bool, agreed on every process (keeps data-dependent
     control flow deterministic across hosts)."""

@@ -53,6 +53,11 @@ from trlx_tpu.parallel import (
 from trlx_tpu.parallel import multihost as mh
 from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import Clock, build_optimizer, logging, significant, to_scalar
+from trlx_tpu.utils.checkpointing import (
+    CheckpointManager,
+    PreemptionHandler,
+    retry_call,
+)
 from trlx_tpu.utils.tokenizers import load_tokenizer
 from trlx_tpu.utils.trackers import Tracker
 
@@ -167,7 +172,22 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.tracker = Tracker(config)
         self.iter_count = 0
         self.nth_evaluation = 0
+        self.best_reward = -float("inf")
         self.total_steps = train.total_steps
+        self.ckpt_manager = CheckpointManager(
+            train.checkpoint_dir, keep_last_n=train.keep_last_n
+        )
+        self.preemption = PreemptionHandler()
+        self._bad_steps = 0  # consecutive non-finite-loss steps
+        self._preempt_sync_counter = 0  # multihost any_flag cadence
+        self._tracker_failures = 0  # consecutive tracker outages (circuit)
+        self._rollout_abandoned = False  # preemption truncated the store
+        # run-derived step budget of a restored checkpoint (PPO lowers
+        # total_steps from the store size inside prepare_learning, so
+        # the config value alone can't tell a completed run from one
+        # with steps left)
+        self._restored_total_steps: Optional[int] = None
+        self._restored_config_total_steps: Optional[int] = None
 
         mb_size = train.minibatch_size or train.batch_size
         if train.batch_size % mb_size:
@@ -775,7 +795,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             columns_data = [str_prompts, str_outputs]
 
             if self.reward_fn:
-                rewards = self.reward_fn(
+                rewards = self._call_reward_fn(
                     samples=str_samples,
                     prompts=str_prompts,
                     outputs=str_outputs,
@@ -890,17 +910,57 @@ class TPUBaseTrainer(BaseRLTrainer):
             loss = l_sum / num_mb
             stats = jax.tree_util.tree_map(lambda x: x / num_mb, s_sum)
 
+        guard = self.config.train.skip_nan_updates
+        good = None
+        if guard:
+            # a poisoned update is detectable from the loss OR the grads:
+            # with grads_dtype="bfloat16" a backward-pass overflow can
+            # produce inf grads under a perfectly finite loss, and those
+            # must not reach params (a checkpoint of poisoned params
+            # would brick the relaunch loop)
+            good = jnp.isfinite(loss) & jax.tree_util.tree_reduce(
+                lambda a, g: a & jnp.all(jnp.isfinite(g)),
+                grads,
+                jnp.asarray(True),
+            )
         if hasattr(tx, "fused_apply"):
             # the freeze mask streams through the fused apply itself
             # (O(chunk) extra memory); blending frozen values back after
             # the apply would hold THREE fp32 param trees at peak —
-            # measured as the 0.5 GB that OOMed the 1.3B recipe
+            # measured as the 0.5 GB that OOMed the 1.3B recipe. The
+            # NaN guard must respect the same budget, so here it zeroes
+            # the gradients BEFORE the apply instead of selecting whole
+            # trees after it: a poisoned step degrades to a weight-decay
+            # -only update (no NaN ever reaches params/moments), and the
+            # host-side abort counter still trips on persistent NaN.
+            if guard:
+                # where, not multiply: NaN grads * 0 is still NaN
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(good, g, jnp.zeros_like(g)), grads
+                )
             new_params, new_opt_state = tx.fused_apply(
                 params, grads, opt_state, mask=self._update_mask
             )
         else:
             updates, new_opt_state = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if guard:
+                # NaN/inf guard must live INSIDE the trace: params and
+                # opt_state are donated, so by the time the host could
+                # inspect the loss the pre-update buffers are gone. The
+                # traced select commits the old state when the update is
+                # poisoned; the abort counter lives in the learn loop.
+                new_params = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(good, n, o), new_params, params
+                )
+                new_opt_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(good, n, o), new_opt_state, opt_state
+                )
+        if guard:
+            # fold the skip signal into the returned loss: the host's
+            # isfinite check then catches finite-loss/bad-grad skips too,
+            # with zero extra device->host transfers
+            loss = jnp.where(good, loss, jnp.float32(jnp.nan))
         return new_params, new_opt_state, loss, stats
 
     def _pinned_state_shardings(self):
@@ -962,12 +1022,14 @@ class TPUBaseTrainer(BaseRLTrainer):
         when the trainer cannot provide one (streaming pipelines)."""
         return None
 
-    def _learn_fused(self, fused_src, best_reward, results):
+    def _learn_fused(self, fused_src, results):
         """All inner epochs in one device call (see make_fused_train_steps).
 
         Checkpoint/eval interval checks fire when a boundary is crossed
         inside the fused block — same cadence as the unfused loop up to
-        quantization to block ends."""
+        quantization to block ends. The NaN guard selects per-step inside
+        the scan; host-side the block's MEAN loss is the abort signal
+        (per-step granularity doesn't exist here)."""
         import time as _time
 
         full, n = fused_src
@@ -1015,12 +1077,17 @@ class TPUBaseTrainer(BaseRLTrainer):
         keys = [k for k in stats if np.ndim(stats[k]) == 0]
         packed = np.asarray(jnp.stack([loss] + [stats[k] for k in keys]))
         elapsed = _time.time() - t0
+        mean_loss = float(packed[0])
         stats = {k: float(v) for k, v in zip(keys, packed[1:])}
         stats["time/step"] = elapsed / n_steps
         stats["learning_rate_group_0"] = float(self.schedule(self.iter_count))
 
         prev = self.iter_count
         self.iter_count += n_steps
+        # one fused block counts as ONE bad step for the abort counter:
+        # a single poisoned (skipped) step inside the scan taints the
+        # block mean even when later steps recovered
+        self._guard_bad_loss(mean_loss)
         for _ in range(self.n_inner_epochs):
             self.post_backward_callback()
 
@@ -1030,29 +1097,12 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
 
         if crossed(self.config.train.checkpoint_interval):
-            subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
-            directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
-            logger.info("Saving checkpoint into %s", directory)
-            if self.config.train.save_optimizer:
-                self.save(directory)
-            self.save_pretrained(os.path.join(directory, "hf_model"))
+            self._save_checkpoint(self._checkpoint_tag())
 
         if crossed(self.config.train.eval_interval):
             results = self.evaluate()
             stats.update(results)
-            if self.config.train.save_best:
-                reward = stats.get(
-                    "reward/mean", stats.get("metrics/reward", -float("inf"))
-                )
-                if reward > best_reward:
-                    best_reward = reward
-                    directory = os.path.join(
-                        self.config.train.checkpoint_dir, "best_checkpoint"
-                    )
-                    logger.info("Saving best checkpoint into %s", directory)
-                    if self.config.train.save_optimizer:
-                        self.save(directory)
-                    self.save_pretrained(os.path.join(directory, "hf_model"))
+            self._maybe_save_best(stats)
 
         desc = " | ".join(
             f"{k}: {v:.2f}"
@@ -1063,8 +1113,12 @@ class TPUBaseTrainer(BaseRLTrainer):
             "[step %d/%d] (fused x%d) %s",
             self.iter_count, self.total_steps, n_steps, desc,
         )
-        self.tracker.log(stats, step=self.iter_count)
-        return results, best_reward, self.iter_count >= self.total_steps
+        self._tracker_log(stats, step=self.iter_count)
+        done = self.iter_count >= self.total_steps
+        if not done and self._should_stop(n_steps=n_steps):
+            self._preemption_exit()
+            done = True
+        return results, done
 
     def _measure_forward(self, device_batch) -> float:
         """Time a jitted loss-only (forward) pass, once per batch shape
@@ -1122,11 +1176,177 @@ class TPUBaseTrainer(BaseRLTrainer):
     def add_prompt_pipeline(self, pipeline) -> None:
         raise NotImplementedError
 
+    # -- fault-tolerance helpers ----------------------------------------
+
+    # consecutive exhausted-retry tracker failures before the circuit
+    # opens: a PERMANENTLY dead tracker must not charge the full backoff
+    # (seconds of sleep) to every subsequent step for the rest of the run
+    _TRACKER_CIRCUIT_LIMIT = 3
+
+    def _tracker_log(self, stats: Dict[str, Any], step: int) -> None:
+        """tracker.log with retry/backoff; a tracker outage degrades to a
+        logged error, never a dead run (metrics are droppable, the
+        training state is not). After _TRACKER_CIRCUIT_LIMIT consecutive
+        exhausted-retry failures the circuit opens: one un-retried
+        attempt per step (so a recovered backend resumes logging) with
+        failures swallowed silently."""
+        train = self.config.train
+        if self._tracker_failures >= self._TRACKER_CIRCUIT_LIMIT:
+            try:
+                self.tracker.log(stats, step=step)
+            except Exception:
+                return
+            self._tracker_failures = 0
+            logger.info("tracker recovered; resuming retried logging")
+            return
+        try:
+            retry_call(
+                self.tracker.log, stats, step=step,
+                retries=train.external_retries,
+                base_delay=train.retry_base_delay,
+                description="tracker.log",
+            )
+            self._tracker_failures = 0
+        except Exception as e:
+            self._tracker_failures += 1
+            logger.error(
+                "tracker.log failed after retries; continuing without "
+                "logging step %d: %s%s", step, e,
+                " (circuit open: further steps attempt once, no backoff)"
+                if self._tracker_failures >= self._TRACKER_CIRCUIT_LIMIT
+                else "",
+            )
+
+    def _call_reward_fn(self, **kwargs):
+        """reward_fn with retry/backoff. Unlike the tracker, rewards are
+        load-bearing: the final failure propagates (the preemption path
+        still gets a chance to checkpoint via learn()'s finally)."""
+        train = self.config.train
+        return retry_call(
+            self.reward_fn,
+            retries=train.external_retries,
+            base_delay=train.retry_base_delay,
+            description="reward_fn",
+            **kwargs,
+        )
+
+    def _checkpoint_tag(self) -> str:
+        return f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
+
+    def _save_checkpoint(self, name: str) -> None:
+        """Commit a full checkpoint (state + deploy export) atomically
+        under checkpoint_dir/<name> via the CheckpointManager."""
+        logger.info(
+            "Saving checkpoint into %s",
+            os.path.join(self.config.train.checkpoint_dir, name),
+        )
+
+        def write(tmp_dir: str) -> None:
+            if self.config.train.save_optimizer:
+                self.save(tmp_dir)
+            self.save_pretrained(os.path.join(tmp_dir, "hf_model"))
+
+        self.ckpt_manager.commit(name, write)
+
+    def _commit_final_checkpoint(self, reason: str) -> None:
+        """Commit the current step's checkpoint before the run exits —
+        unless it already committed (e.g. preemption right after an
+        interval save; rewriting every shard would re-open the re-commit
+        window for nothing). The skip decision is process 0's view
+        broadcast to all hosts: commit() is collective, so a host with a
+        stale filesystem view deciding differently would deadlock the
+        others."""
+        tag = self._checkpoint_tag()
+        # compare parsed STEP numbers, not directory names: the name's
+        # zero-pad width tracks run-mutable total_steps (PPO re-derives
+        # it from the store), so the same step can print differently
+        # across a resume
+        ckpts = self.ckpt_manager.step_checkpoints()
+        skip = mh.broadcast_flag(
+            bool(ckpts) and ckpts[-1][0] == self.iter_count
+        )
+        if skip:
+            logger.info(
+                "%s: step %d checkpoint already committed", reason,
+                self.iter_count,
+            )
+            return
+        self._save_checkpoint(tag)
+        logger.info(
+            "%s: checkpoint committed at step %d", reason, self.iter_count
+        )
+
+    def _preemption_exit(self) -> None:
+        self._commit_final_checkpoint("preemption; exiting cleanly")
+
+    def _maybe_save_best(self, stats: Dict[str, Any]) -> None:
+        """Track the best eval reward and commit best_checkpoint on a new
+        high (shared by the fused and unfused loops)."""
+        if not self.config.train.save_best:
+            return
+        reward = stats.get(
+            "reward/mean", stats.get("metrics/reward", -float("inf"))
+        )
+        if reward > self.best_reward:
+            self.best_reward = reward
+            logger.info("Saving best checkpoint")
+            self._save_checkpoint("best_checkpoint")
+
+    # multihost: agree on preemption every N optimizer steps rather than
+    # every step — the ANY-reduce is a blocking host collective, and
+    # preemption grace periods (30s+) dwarf a few steps of latency
+    PREEMPT_SYNC_STEPS = 8
+
+    def _should_stop(self, n_steps: int = 1, force: bool = False) -> bool:
+        """Preemption check, agreed across hosts: the signal lands on
+        whichever host the scheduler chose, so this is an ANY-reduce
+        (mh.any_flag), not a process-0 broadcast. Single-host reads the
+        local flag directly; multihost amortizes the collective over
+        PREEMPT_SYNC_STEPS steps (every process runs the same control
+        flow, so the sync cadence stays in lockstep). `force=True` syncs
+        unconditionally — used at coarse boundaries (epoch tops, rollout
+        chunks) where the collective is cheap relative to the work."""
+        if not mh.is_multihost():
+            return self.preemption.requested()
+        if not force:
+            self._preempt_sync_counter += n_steps
+            if self._preempt_sync_counter < self.PREEMPT_SYNC_STEPS:
+                return False
+            self._preempt_sync_counter = 0
+        return mh.any_flag(self.preemption.requested())
+
+    def _guard_bad_loss(self, loss: float) -> bool:
+        """Host half of the NaN/inf guard: returns True when the update
+        was skipped device-side (non-finite loss). Aborts the run after
+        `max_bad_steps` CONSECUTIVE skipped steps — a persistent NaN
+        means diverged state, and looping forever on it would burn the
+        whole job allocation silently."""
+        if not self.config.train.skip_nan_updates or np.isfinite(loss):
+            self._bad_steps = 0
+            return False
+        self._bad_steps += 1
+        logger.warning(
+            "non-finite loss %s at step %d: update skipped (%d/%d "
+            "consecutive bad steps before abort)",
+            loss, self.iter_count, self._bad_steps,
+            self.config.train.max_bad_steps,
+        )
+        if self._bad_steps >= self.config.train.max_bad_steps:
+            raise RuntimeError(
+                f"aborting: {self._bad_steps} consecutive non-finite "
+                f"losses (train.max_bad_steps={self.config.train.max_bad_steps}); "
+                "the model state has diverged — restart from the last "
+                "committed checkpoint with a lower lr / tighter clipping"
+            )
+        return True
+
     def learn(self):
         """The training loop (parity: reference learn() :518-651)."""
+        self.preemption.install()
         try:
             return self._learn()
         finally:
+            self.preemption.uninstall()
             # rollout phases defer their stats behind an async device->host
             # copy; flush even when learn() exits straight after a rollout
             # (total_steps hit before the next train step, or an exception)
@@ -1135,28 +1355,75 @@ class TPUBaseTrainer(BaseRLTrainer):
 
     def _learn(self):
         logger.info("Starting training")
+        # the relaunch loop re-runs a COMPLETED job's command line: bail
+        # before prepare_learning, which for PPO would pay a full rollout
+        # (generation + reward scoring) for nothing. The run-derived
+        # budget from state.json covers store-limited PPO runs, gated on
+        # an unchanged config total (raising total_steps means the user
+        # wants to continue past the old budget).
+        restored_done = (
+            self._restored_total_steps is not None
+            and self.iter_count >= self._restored_total_steps
+            and self.config.train.total_steps == self._restored_config_total_steps
+        )
+        if self.iter_count > 0 and (
+            self.iter_count >= self.config.train.total_steps or restored_done
+        ):
+            logger.info(
+                "restored iter_count %d already covers the step budget "
+                "(total_steps=%d%s); nothing to train", self.iter_count,
+                self.config.train.total_steps,
+                "" if self._restored_total_steps is None
+                else f", run-derived={self._restored_total_steps}",
+            )
+            return {}
         self.prepare_learning()
-        self.iter_count = 0
-        self.nth_evaluation = 0
+        if self._should_stop(force=True):
+            # preemption landed during prepare_learning (PPO: the first
+            # rollout, possibly abandoned part-way) — checkpoint and
+            # exit before paying the initial evaluation
+            self._preemption_exit()
+            return {}
 
-        results = self.evaluate()
-        self.tracker.log(results, step=self.iter_count)
+        if self.iter_count > 0:
+            # resumed run: continue from the restored step — replaying
+            # from 0 with a restored optimizer state was the old (silent)
+            # failure mode. The initial evaluation is skipped so tracker
+            # step indices stay strictly monotonic across the restart.
+            logger.info(
+                "Resuming training at step %d/%d (best_reward=%s)",
+                self.iter_count, self.total_steps,
+                significant(self.best_reward),
+            )
+            results: Dict[str, Any] = {}
+            if self.iter_count >= self.total_steps:
+                logger.info(
+                    "restored iter_count %d already >= total_steps %d; "
+                    "nothing to train", self.iter_count, self.total_steps,
+                )
+                return results
+        else:
+            results = self.evaluate()
+            self._tracker_log(results, step=self.iter_count)
 
-        best_reward = -float("inf")
         if self._train_step is None:
             self._train_step = self.make_train_step()
 
         clock = Clock()
         for _ in range(self.config.train.epochs):
+            # epoch-top check catches a preemption that landed during
+            # rollout collection / evaluation (PPO abandons the rollout
+            # and falls through to here with a short or empty store)
+            if self._should_stop(force=True):
+                self._preemption_exit()
+                return results
             fused_src = (
                 self._fused_epoch_batch()
                 if self.config.train.fused_inner_loop
                 else None
             )
             if fused_src is not None:
-                results, best_reward, done = self._learn_fused(
-                    fused_src, best_reward, results
-                )
+                results, done = self._learn_fused(fused_src, results)
                 if done:
                     return results
                 self.post_epoch_callback()
@@ -1164,6 +1431,9 @@ class TPUBaseTrainer(BaseRLTrainer):
             for _ in range(self.n_inner_epochs):
                 train_dataloader = self.create_train_dataloader()
                 for batch in train_dataloader:
+                    if self._should_stop():
+                        self._preemption_exit()
+                        return results
                     if self.config.train.profile_dir is not None:
                         if self.iter_count == self.config.train.profile_start:
                             jax.profiler.start_trace(self.config.train.profile_dir)
@@ -1177,6 +1447,12 @@ class TPUBaseTrainer(BaseRLTrainer):
                         )
                     loss = to_scalar(loss)  # sync point: step is done
                     step_time = clock.tick()
+                    if self._guard_bad_loss(loss):
+                        # poisoned update was skipped device-side: the
+                        # step index does not advance and nothing is
+                        # logged for it (the next good step keeps the
+                        # tracker's step sequence contiguous)
+                        continue
                     stats = {
                         k: to_scalar(v)
                         for k, v in stats.items()
@@ -1207,12 +1483,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                         self.iter_count % self.config.train.checkpoint_interval == 0
                         or self.iter_count >= self.total_steps
                     ):
-                        subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
-                        directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
-                        logger.info("Saving checkpoint into %s", directory)
-                        if self.config.train.save_optimizer:
-                            self.save(directory)
-                        self.save_pretrained(os.path.join(directory, "hf_model"))
+                        self._save_checkpoint(self._checkpoint_tag())
 
                     if (
                         self.iter_count % self.config.train.eval_interval == 0
@@ -1220,20 +1491,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                     ):
                         results = self.evaluate()
                         stats.update(results)
-
-                        if self.config.train.save_best:
-                            reward = stats.get(
-                                "reward/mean", stats.get("metrics/reward", -float("inf"))
-                            )
-                            if reward > best_reward:
-                                best_reward = reward
-                                directory = os.path.join(
-                                    self.config.train.checkpoint_dir, "best_checkpoint"
-                                )
-                                logger.info("Saving best checkpoint into %s", directory)
-                                if self.config.train.save_optimizer:
-                                    self.save(directory)
-                                self.save_pretrained(os.path.join(directory, "hf_model"))
+                        self._maybe_save_best(stats)
 
                     desc = " | ".join(
                         f"{k}: {v:.2f}"
@@ -1244,12 +1502,19 @@ class TPUBaseTrainer(BaseRLTrainer):
                     # pending rollout stats carry an earlier step index:
                     # flush them first so tracker steps stay monotonic
                     self._finish_rollout_stats()
-                    self.tracker.log(stats, step=self.iter_count)
+                    self._tracker_log(stats, step=self.iter_count)
 
                     if self.iter_count >= self.total_steps:
                         return results
                 self.post_backward_callback()
             self.post_epoch_callback()
+        # epoch exhaustion can end BELOW total_steps (a NaN-skipped step
+        # consumes its batch without advancing iter_count, and small
+        # datasets simply run out of epochs): commit whatever progress
+        # exists rather than leaving up to checkpoint_interval steps of
+        # training only in memory
+        if self.iter_count > 0:
+            self._commit_final_checkpoint("epoch budget exhausted")
         return results
 
     # ------------------------------------------------------------------
@@ -1259,9 +1524,39 @@ class TPUBaseTrainer(BaseRLTrainer):
     def _state_tree(self) -> Dict:
         return {"params": self.params, "opt_state": self.opt_state}
 
+    def _extra_state(self) -> Dict[str, Any]:
+        """Subclass hook: extra JSON-serializable resumable state (KL
+        controller value, data cursors, ...) merged into state.json."""
+        return {}
+
+    def _restore_extra_state(self, state: Dict[str, Any]) -> None:
+        """Subclass hook: restore what `_extra_state` saved."""
+
+    def _pack_rng(self) -> List[int]:
+        try:
+            data = jax.random.key_data(self.rng)
+        except Exception:  # old-style raw uint32 key array
+            data = self.rng
+        return np.asarray(data).astype(np.uint32).tolist()
+
+    def _unpack_rng(self, data) -> None:
+        arr = jnp.asarray(np.asarray(data, np.uint32))
+        try:
+            if jnp.issubdtype(self.rng.dtype, jax.dtypes.prng_key):
+                arr = jax.random.wrap_key_data(arr)
+        except Exception:
+            pass
+        self.rng = arr
+
     def save(self, directory: Optional[str] = None) -> None:
         """Full training state via Orbax + state.json (parity: reference
-        save :309-326 / accelerator.save_state)."""
+        save :309-326 / accelerator.save_state).
+
+        state.json carries everything needed to CONTINUE the run rather
+        than replay it: iter_count, best_reward, the trainer PRNG key,
+        the eval counter and per-trainer cursors (_extra_state). It is
+        written to a temp file and os.replace'd — a preemption mid-save
+        can never leave a truncated state.json shadowing a good one."""
         import orbax.checkpoint as ocp
 
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
@@ -1273,8 +1568,38 @@ class TPUBaseTrainer(BaseRLTrainer):
             os.path.join(directory, "state"), self._state_tree(), force=True
         )
         if mh.is_main():
-            with open(os.path.join(directory, "state.json"), "w") as f:
-                json.dump({"iter_count": self.iter_count}, f)
+            state = {
+                "iter_count": self.iter_count,
+                "best_reward": (
+                    self.best_reward if np.isfinite(self.best_reward) else None
+                ),
+                "nth_evaluation": self.nth_evaluation,
+                "rng_key": self._pack_rng(),
+                # run-derived budget (PPO: min of config and store size):
+                # lets a same-config relaunch of a COMPLETED run bail
+                # before paying a rollout. A preemption-abandoned rollout
+                # truncates the store, so the just-derived total_steps
+                # UNDERSTATES the real budget — persisting it would make
+                # every later relaunch bail as "completed"; carry the
+                # restored values forward instead.
+                "total_steps": (
+                    self._restored_total_steps
+                    if self._rollout_abandoned else self.total_steps
+                ),
+                "config_total_steps": (
+                    self._restored_config_total_steps
+                    if self._rollout_abandoned
+                    else self.config.train.total_steps
+                ),
+            }
+            state.update(self._extra_state())
+            state_fp = os.path.join(directory, "state.json")
+            tmp_fp = state_fp + ".tmp"
+            with open(tmp_fp, "w") as f:
+                json.dump(state, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp_fp, state_fp)
 
     def load(self, directory: Optional[str] = None) -> None:
         import orbax.checkpoint as ocp
@@ -1287,9 +1612,28 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         state_fp = os.path.join(directory, "state.json")
-        if os.path.exists(state_fp):
-            with open(state_fp) as f:
-                self.iter_count = json.load(f).get("iter_count", 0)
+        if not os.path.exists(state_fp):
+            # a corrupt/legacy checkpoint must not masquerade as a fresh
+            # run: params were restored above, but the step counter and
+            # reward history are unknown — resume would restart from 0
+            logger.warning(
+                "checkpoint %s has no state.json: params/opt_state were "
+                "restored but iter_count/best_reward are unknown — "
+                "treating as step 0 (legacy layout or a corrupted save)",
+                directory,
+            )
+            return
+        with open(state_fp) as f:
+            state = json.load(f)
+        self.iter_count = state.get("iter_count", 0)
+        best = state.get("best_reward")
+        self.best_reward = float(best) if best is not None else -float("inf")
+        self.nth_evaluation = state.get("nth_evaluation", 0)
+        if state.get("rng_key") is not None:
+            self._unpack_rng(state["rng_key"])
+        self._restored_total_steps = state.get("total_steps")
+        self._restored_config_total_steps = state.get("config_total_steps")
+        self._restore_extra_state(state)
 
     def save_pretrained(self, directory: Optional[str] = None) -> None:
         """Deploy artifact: HF-format export of the base model when the

@@ -116,6 +116,12 @@ class TPUPPOTrainer(TPUBaseTrainer):
 
         self.mean_kl = 0.0
         self._pending_rollout_stats = None
+        # rollout-data cursor: how many prompt chunks this run has pulled
+        # off the (deterministically shuffled) prompt stream. Saved in
+        # state.json so a resumed run fast-forwards to the exact position
+        # instead of replaying the stream from its start.
+        self._prompt_batches_consumed = 0
+        self._resume_prompt_cursor = 0
         self.log_rollouts = config.train.rollout_logging_dir is not None
         if self.log_rollouts:
             self.setup_rollout_logging(config)
@@ -433,6 +439,11 @@ class TPUPPOTrainer(TPUBaseTrainer):
         """Collect `num_rollouts` rollouts into the store (parity:
         reference make_experience :251-525; §3.2 call stack)."""
         logger.info("Collecting rollouts")
+        self._rollout_abandoned = False
+        # snapshot the prompt cursor: an abandoned (preempted) rollout
+        # discards its partial store, so the cursor must rewind to here
+        # or the resumed run would skip prompts that never trained
+        prompt_cursor_start = self._prompt_batches_consumed
         self._finish_rollout_stats()  # flush any deferred previous-cycle stats
         clock = Clock()
         n_collected = 0
@@ -444,17 +455,35 @@ class TPUPPOTrainer(TPUBaseTrainer):
         # before chunk i's host work (decode + reward_fn), so the device
         # samples while the host scores — the reference's rollout loop is
         # fully serial here (SURVEY §7 "host-device choreography")
-        next_batch: Optional[PromptBatch] = next(self.prompt_iterator)
+        next_batch: Optional[PromptBatch] = self._next_prompt_batch()
         rollout_generate_time = time()
         next_gen = self.generate(next_batch.input_ids, next_batch.attention_mask)
         next_gen_time = time() - rollout_generate_time
         chunk_rows = len(next_batch.input_ids) * mh.data_group_count(self.mesh)
         while n_collected < num_rollouts:
+            # rollout collection dominates PPO wall-clock: a preemption
+            # landing here must not wait out the remaining chunks (the
+            # grace period would expire before the final save). Abandon
+            # the rollout — learn()'s epoch-top check saves and exits.
+            # Forced sync: every host runs this loop in lockstep.
+            if self._should_stop(force=True):
+                logger.warning(
+                    "preemption during rollout collection: abandoning "
+                    "after %d/%d rollouts", n_collected, num_rollouts,
+                )
+                # flags the store as truncated: the total_steps that
+                # prepare_learning derives from it must not be persisted
+                # as this run's real budget. The cursor rewinds to the
+                # cycle start — this cycle's chunks never train, so the
+                # resumed run must replay them.
+                self._rollout_abandoned = True
+                self._prompt_batches_consumed = prompt_cursor_start
+                break
             stats: Dict[str, float] = {}
             batch, gen_out = next_batch, next_gen
             stats["time/rollout_generate"] = next_gen_time
             if n_collected + chunk_rows < num_rollouts:
-                next_batch = next(self.prompt_iterator)
+                next_batch = self._next_prompt_batch()
                 rollout_generate_time = time()
                 next_gen = self.generate(
                     next_batch.input_ids, next_batch.attention_mask
@@ -552,7 +581,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
             )
 
             rollout_score_time = time()
-            all_scores = self.reward_fn(
+            all_scores = self._call_reward_fn(
                 samples=str_samples,
                 prompts=str_prompts,
                 outputs=str_outputs,
@@ -746,6 +775,12 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 pbar.update(len(sequences) * mh.data_group_count(self.mesh))
             logger.info("[rollout %d / %d]", n_collected, num_rollouts)
 
+        if not accumulated_stats:
+            # rollout abandoned before the first chunk completed
+            # (preemption): nothing to log, nothing pending
+            if hasattr(pbar, "close"):
+                pbar.close()
+            return
         agg = {
             k: sum(xs[k] for xs in accumulated_stats) / len(accumulated_stats)
             for k in accumulated_stats[-1]
@@ -786,7 +821,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
         stats = {k: float(v) for k, v in zip(keys, vals)}
         stats["kl_ctl_value"] = kl_ctl_value
         self.mean_kl = stats["policy/sqrt_kl"] ** 2
-        self.tracker.log(stats, step=iter_count)
+        self._tracker_log(stats, step=iter_count)
 
     # -- loop hooks ------------------------------------------------------
 
@@ -821,12 +856,71 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 len(pipeline), shuffle=True, seed=self.config.train.seed
             )
         self.prompt_iterator = infinite_loader(loader)
+        self._fast_forward_prompts()
+
+    def _next_prompt_batch(self) -> PromptBatch:
+        batch = next(self.prompt_iterator)
+        self._prompt_batches_consumed += 1
+        return batch
+
+    def _fast_forward_prompts(self) -> None:
+        """Resume: advance the prompt stream to the saved cursor. The
+        loader's shuffle RNG is stateful per epoch, so replaying `skip`
+        host-side batch pulls (cheap: pre-tokenized collation, no
+        generation) reproduces the exact data order the killed run would
+        have continued with."""
+        skip = self._resume_prompt_cursor - self._prompt_batches_consumed
+        if skip <= 0 or not hasattr(self, "prompt_iterator"):
+            return
+        logger.info(
+            "resume: fast-forwarding the prompt stream by %d chunks to "
+            "restore the rollout data order", skip,
+        )
+        for _ in range(skip):
+            next(self.prompt_iterator)
+        self._prompt_batches_consumed += skip
+
+    # -- resumable state -------------------------------------------------
+
+    def _extra_state(self):
+        rm = self.running_moments
+        return {
+            "kl_ctl_value": float(self.kl_ctl.value),
+            "mean_kl": float(self.mean_kl),
+            "ref_mean": None if self.ref_mean is None else float(self.ref_mean),
+            "ref_std": None if self.ref_std is None else float(self.ref_std),
+            "running_moments": {
+                "mean": float(rm.mean), "var": float(rm.var),
+                "std": float(rm.std), "count": float(rm.count),
+            },
+            "prompt_batches_consumed": self._prompt_batches_consumed,
+        }
+
+    def _restore_extra_state(self, state) -> None:
+        from trlx_tpu.ops.common import RunningMoments
+
+        if "kl_ctl_value" in state:
+            self.kl_ctl.value = state["kl_ctl_value"]
+        self.mean_kl = state.get("mean_kl", 0.0)
+        self.ref_mean = state.get("ref_mean", self.ref_mean)
+        self.ref_std = state.get("ref_std", self.ref_std)
+        rm = state.get("running_moments")
+        if rm:
+            self.running_moments = RunningMoments(
+                mean=jnp.float32(rm["mean"]), var=jnp.float32(rm["var"]),
+                std=jnp.float32(rm["std"]), count=jnp.float32(rm["count"]),
+            )
+        self._resume_prompt_cursor = state.get("prompt_batches_consumed", 0)
+        self._fast_forward_prompts()
 
     def prepare_learning(self) -> None:
         self.eval_dataloader = mh.shard_pipeline(self.eval_pipeline, self.mesh).create_loader(
             max(self.config.method.chunk_size // mh.data_group_count(self.mesh), 1)
         )
-        self.make_experience(self.config.method.num_rollouts)
+        # the restored iter_count keys the deferred rollout-stats flush:
+        # without it a resumed run logs its first rollout at step 0 and
+        # breaks tracker-step monotonicity
+        self.make_experience(self.config.method.num_rollouts, self.iter_count)
         self.n_inner_epochs = self.config.method.ppo_epochs
         n_batches = len(self.store) // self.config.train.batch_size
         self.total_steps = min(

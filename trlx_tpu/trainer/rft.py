@@ -124,7 +124,7 @@ class TPURFTTrainer(TPUBaseTrainer):
                         for p, o in zip(str_prompts, str_outputs)
                     )
 
-            scores = self.reward_fn(
+            scores = self._call_reward_fn(
                 samples=[g["prompt"] + g["output"] for g in generations],
                 prompts=[g["prompt"] for g in generations],
                 outputs=[g["output"] for g in generations],
@@ -166,7 +166,7 @@ class TPURFTTrainer(TPUBaseTrainer):
                     samples_selected.append((prompt, x["output"]))
         samples_selected = sorted(set(samples_selected))
 
-        self.tracker.log(
+        self._tracker_log(
             {
                 "scores_mean": float(np.mean(np.hstack(per_prompt_scores))),
                 "len_samples_selected": len(samples_selected),

@@ -156,8 +156,13 @@ def get_scheduler_class(name: str):
 
     if name == SchedulerName.COSINE_ANNEALING:
         def make_cos(lr, T_max, eta_min=0.0, warmup_steps: int = 0, **_):
+            # reference configs ship T_max=1e12 ("effectively constant");
+            # without x64 the step counter traces as int32 and optax's
+            # jnp.minimum(count, decay_steps) overflows on it — clamp to
+            # the largest representable step
+            decay_steps = int(min(max(int(T_max), 1), np.iinfo(np.int32).max))
             cos = optax.cosine_decay_schedule(
-                init_value=lr, decay_steps=max(int(T_max), 1),
+                init_value=lr, decay_steps=decay_steps,
                 alpha=(eta_min / lr) if lr else 0.0,
             )
             if warmup_steps:
@@ -168,7 +173,8 @@ def get_scheduler_class(name: str):
         return make_cos
     if name == SchedulerName.LINEAR:
         def make_lin(lr, total_steps, final_lr=0.0, warmup_steps: int = 0, **_):
-            lin = optax.linear_schedule(lr, final_lr, max(int(total_steps), 1))
+            steps = int(min(max(int(total_steps), 1), np.iinfo(np.int32).max))
+            lin = optax.linear_schedule(lr, final_lr, steps)
             if warmup_steps:
                 warm = optax.linear_schedule(0.0, lr, warmup_steps)
                 return optax.join_schedules([warm, lin], [warmup_steps])

@@ -1,0 +1,376 @@
+"""Fault-tolerant checkpointing for preemptible multi-host training.
+
+The reference stack leans on accelerate/DeepSpeed ``save_state`` for
+crash recovery; on preemptible TPU pods the failure surface is wider: a
+SIGTERM can land mid-save (leaving a torn checkpoint on shared storage),
+the tracker backend or the reward model can flake transiently, and a
+resumed run must continue — not replay — the original schedule. This
+module owns the host-side half of that story:
+
+  CheckpointManager   atomic commits (write to ``tmp_<name>``, fsync,
+                      rename, then a ``COMMIT`` marker — a torn write is
+                      never discoverable), ``latest_committed()``
+                      discovery for ``resume_from_checkpoint="auto"``,
+                      and a ``keep_last_n`` retention policy that always
+                      preserves ``best_checkpoint``.
+  PreemptionHandler   SIGTERM/SIGINT -> a flag the train loop polls once
+                      per step; the loop agrees on it across hosts via
+                      ``multihost.any_flag`` and saves one final
+                      consistent checkpoint before exiting.
+  retry_call          exponential backoff (cap + jitter) around the two
+                      external calls in the loop — ``tracker.log`` and
+                      the user reward function.
+
+The device-side half (what goes *into* a checkpoint: params, opt_state,
+``iter_count``, ``best_reward``, the trainer PRNG key and per-trainer
+cursors) lives in ``trainer/base.py save()/load()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import re
+import shutil
+import signal
+import time
+from typing import Callable, List, Optional, Tuple
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+COMMIT_MARKER = "COMMIT"
+_TMP_PREFIX = "tmp_"
+_STEP_RE = re.compile(r"^checkpoint_(\d+)$")
+
+# backoff jitter must come from an OS-entropy RNG, NOT the global
+# `random` module: set_seed() seeds that globally with the (shared)
+# config seed, which would make every host of a pod back off in lockstep
+# — the exact synchronized herd the jitter exists to prevent
+_JITTER_RNG = random.Random()
+
+
+def _fsync_path(path: str) -> None:
+    """Best-effort fsync of a file or directory (some filesystems refuse
+    directory fsync; a failed sync narrows durability, not correctness)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def fsync_tree(directory: str) -> None:
+    """fsync every file + directory under `directory`, bottom-up, so the
+    subsequent rename publishes fully-durable contents."""
+    for root, dirs, files in os.walk(directory, topdown=False):
+        for name in files:
+            _fsync_path(os.path.join(root, name))
+        _fsync_path(root)
+
+
+def is_committed(directory: str) -> bool:
+    """True iff `directory` is a checkpoint whose commit marker landed —
+    the only state an auto-resume is allowed to pick up."""
+    return os.path.isfile(os.path.join(directory, COMMIT_MARKER))
+
+
+class CheckpointManager:
+    """Atomic checkpoint commits + discovery + retention under one root.
+
+    Commit protocol (crash-safe at every boundary):
+      1. writers fill ``<root>/tmp_<name>/`` (a preemption here leaves
+         only a ``tmp_`` directory, which discovery ignores and the next
+         commit clears),
+      2. the tree is fsynced and renamed to ``<root>/<name>/`` (still
+         not discoverable: no marker yet),
+      3. a ``COMMIT`` marker file is written *into* the final directory
+         via its own tmp-file + ``os.replace`` (the atomic publish).
+
+    Multi-host: every process calls :meth:`commit` (orbax array saves
+    are collective); only the primary performs the host-filesystem
+    rename/marker/retention, with barriers on both sides so no process
+    races ahead into device collectives while files move.
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        keep_last_n: Optional[int] = None,
+        best_subdir: str = "best_checkpoint",
+    ):
+        self.root = os.path.abspath(checkpoint_dir)
+        self.keep_last_n = keep_last_n
+        self.best_subdir = best_subdir
+
+    # -- commit ----------------------------------------------------------
+
+    def commit(self, name: str, write_fn: Callable[[str], None]) -> str:
+        """Run ``write_fn(tmp_dir)`` then atomically publish the result
+        as ``<root>/<name>``. Returns the final directory path."""
+        from trlx_tpu.parallel import multihost as mh
+
+        final = os.path.join(self.root, name)
+        tmp = os.path.join(self.root, _TMP_PREFIX + name)
+        prep_err: Optional[BaseException] = None
+        if mh.is_main():
+            try:
+                # sweep ALL stale in-flight dirs, not just this name's: a
+                # crashed commit of a step checkpoint leaves a tmp_ tree
+                # no later commit would ever reuse (step names are
+                # unique), leaking multi-GB shard dumps onto shared
+                # storage. tmp_old_* aside copies are preserved — they
+                # are the recoverable previous versions.
+                if os.path.isdir(self.root):
+                    for entry in os.listdir(self.root):
+                        if entry.startswith(_TMP_PREFIX) and not (
+                            entry.startswith(_TMP_PREFIX + "old_")
+                        ):
+                            shutil.rmtree(
+                                os.path.join(self.root, entry),
+                                ignore_errors=True,
+                            )
+                os.makedirs(tmp, exist_ok=True)
+            except Exception as e:
+                prep_err = e
+        # writers must see the (clean) tmp dir before filling it; the
+        # agreement also aborts every host together if the primary's
+        # filesystem prep failed (a bare barrier would deadlock them)
+        if mh.any_flag(prep_err is not None):
+            if prep_err is not None:
+                raise prep_err
+            raise RuntimeError(
+                f"checkpoint {name!r}: tmp dir preparation failed on the "
+                "primary process; commit aborted on all hosts"
+            )
+        err: Optional[BaseException] = None
+        try:
+            write_fn(tmp)
+        except Exception as e:
+            err = e
+        # failure agreement doubles as the "all shard writes landed"
+        # sync point: one host's write error (disk full, export failure)
+        # must abort the commit on EVERY host — a bare barrier here would
+        # leave the survivors deadlocked in it while the failed host
+        # unwinds (the torn tmp_ dir is left for postmortem; discovery
+        # ignores it)
+        if mh.any_flag(err is not None):
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                f"checkpoint {name!r}: write failed on another process; "
+                "commit aborted on all hosts"
+            )
+        pub_err: Optional[BaseException] = None
+        if mh.is_main():
+            try:
+                fsync_tree(tmp)
+                # re-commit of the same name (best_checkpoint, a
+                # preemption right after an interval save): move the old
+                # committed copy ASIDE (unique name, marker still inside)
+                # and delete it only after the new marker lands. A crash
+                # inside the swap window leaves the previous copy
+                # recoverable under tmp_old_<name>.* — never deleted by
+                # later commits or retention; verify_ckpt reports such
+                # leftovers.
+                old = None
+                if os.path.isdir(final):
+                    import uuid
+
+                    old = os.path.join(
+                        self.root,
+                        f"{_TMP_PREFIX}old_{name}.{uuid.uuid4().hex[:8]}",
+                    )
+                    os.rename(final, old)
+                os.rename(tmp, final)
+                _fsync_path(self.root)
+                self._write_marker(final, name)
+                if old is not None:
+                    shutil.rmtree(old, ignore_errors=True)
+                self._apply_retention()
+            except Exception as e:
+                pub_err = e
+        # publish-failure agreement doubles as the commit-done sync: a
+        # primary that failed to rename/mark must not strand the other
+        # hosts in a bare barrier
+        if mh.any_flag(pub_err is not None):
+            if pub_err is not None:
+                raise pub_err
+            raise RuntimeError(
+                f"checkpoint {name!r}: publish failed on the primary "
+                "process; commit aborted on all hosts"
+            )
+        return final
+
+    @staticmethod
+    def _write_marker(directory: str, name: str) -> None:
+        marker_tmp = os.path.join(directory, COMMIT_MARKER + ".tmp")
+        with open(marker_tmp, "w") as f:
+            json.dump({"name": name, "time": time.time()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(marker_tmp, os.path.join(directory, COMMIT_MARKER))
+        _fsync_path(directory)
+
+    # -- discovery -------------------------------------------------------
+
+    def step_checkpoints(self) -> List[Tuple[int, str]]:
+        """Committed ``checkpoint_<step>`` directories as (step, path),
+        ascending by step. Uncommitted (torn) directories are skipped
+        with a warning — they are exactly what a mid-save preemption
+        leaves behind."""
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for entry in sorted(os.listdir(self.root)):
+            m = _STEP_RE.match(entry)
+            if not m:
+                continue
+            path = os.path.join(self.root, entry)
+            if not is_committed(path):
+                logger.warning(
+                    "skipping uncommitted checkpoint %s (no %s marker — "
+                    "likely a torn write from a preemption mid-save)",
+                    path, COMMIT_MARKER,
+                )
+                continue
+            out.append((int(m.group(1)), path))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def latest_committed(self) -> Optional[str]:
+        """The newest committed step checkpoint, or None (fresh start)."""
+        ckpts = self.step_checkpoints()
+        return ckpts[-1][1] if ckpts else None
+
+    def latest_resumable(self) -> Optional[str]:
+        """The newest committed checkpoint that carries FULL training
+        state (a `state/` tree). A `save_optimizer=false` run commits
+        deploy-only checkpoints (hf_model/ without state/); feeding one
+        to trainer.load() would crash every relaunch attempt, so
+        auto-resume skips them with a warning instead."""
+        for _step, path in reversed(self.step_checkpoints()):
+            if os.path.isdir(os.path.join(path, "state")):
+                return path
+            logger.warning(
+                "skipping %s for auto-resume: committed but has no "
+                "state/ tree (saved with save_optimizer=false?) — not "
+                "resumable", path,
+            )
+        return None
+
+    # -- retention -------------------------------------------------------
+
+    def _apply_retention(self) -> None:
+        """Delete committed step checkpoints beyond the newest
+        ``keep_last_n``. ``best_checkpoint`` (and any non-step-named
+        directory) is never touched; the marker is removed before the
+        tree so a crash mid-delete leaves an ignorable torn dir, not a
+        discoverable half-checkpoint."""
+        if not self.keep_last_n or self.keep_last_n < 1:
+            return
+        ckpts = self.step_checkpoints()
+        for _step, path in ckpts[: max(len(ckpts) - self.keep_last_n, 0)]:
+            logger.info("retention (keep_last_n=%d): removing %s",
+                        self.keep_last_n, path)
+            marker = os.path.join(path, COMMIT_MARKER)
+            if os.path.exists(marker):
+                os.unlink(marker)
+                _fsync_path(path)
+            shutil.rmtree(path, ignore_errors=True)
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT -> a poll-able flag for graceful shutdown.
+
+    The train loop polls :meth:`requested` once per step and coordinates
+    the decision across hosts (``multihost.any_flag`` — the signal lands
+    on whichever host the scheduler picked, not necessarily process 0),
+    then saves one final consistent checkpoint and exits cleanly. A
+    second SIGINT raises ``KeyboardInterrupt`` so a double Ctrl-C still
+    kills a hung save."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self._flag = False
+        self._prev = {}
+        self._installed = False
+
+    def _handle(self, signum, frame):
+        if self._flag and signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        self._flag = True
+        logger.warning(
+            "received signal %d: finishing the current step, then saving "
+            "a final checkpoint and exiting", signum,
+        )
+
+    def install(self) -> "PreemptionHandler":
+        """Install handlers (main thread only — signal.signal raises
+        elsewhere, so background-thread callers keep default handling).
+        Clears any stale flag from a previously handled preemption so a
+        follow-up learn() on the same trainer trains instead of
+        immediately exiting."""
+        self._flag = False
+        if self._installed:
+            return self
+        try:
+            for sig in self.SIGNALS:
+                self._prev[sig] = signal.signal(sig, self._handle)
+            self._installed = True
+        except ValueError:  # not the main thread
+            self._prev.clear()
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except ValueError:
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def requested(self) -> bool:
+        return self._flag
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    retries: int = 3,
+    base_delay: float = 0.5,
+    max_delay: float = 8.0,
+    description: Optional[str] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying transient failures with
+    exponential backoff (doubling from ``base_delay``, capped at
+    ``max_delay``, +-25% jitter so a fleet of preempted workers doesn't
+    thundering-herd a recovering tracker/reward service). ``retries`` is
+    the number of RE-tries after the first attempt; the final failure
+    re-raises — the caller decides whether the call is load-bearing
+    (reward_fn: yes) or droppable (tracker.log: catch and continue)."""
+    what = description or getattr(fn, "__name__", repr(fn))
+    for attempt in range(retries + 1):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            if attempt >= retries:
+                logger.error(
+                    "%s failed after %d attempts: %s", what, attempt + 1, e
+                )
+                raise
+            delay = min(base_delay * (2 ** attempt), max_delay)
+            delay *= 1.0 + _JITTER_RNG.uniform(-0.25, 0.25)
+            logger.warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.2fs",
+                what, attempt + 1, retries + 1, e, delay,
+            )
+            time.sleep(max(delay, 0.0))
