@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -172,6 +173,10 @@ def bench_tpu() -> tuple:
         tokenizer=dict(tokenizer_path="byte"),
         method=dict(
             num_rollouts=NUM_ROLLOUTS, chunk_size=CHUNK, ppo_epochs=PPO_EPOCHS,
+            # cycle-level overlap: the next cycle's generation dispatches
+            # ahead of the fused train scan, so decode+scoring of cycle
+            # t+1 runs host-side while cycle t optimizes on-device
+            overlap_rollouts=True,
             gen_kwargs=dict(max_new_tokens=NEW_TOKENS, top_k=0, top_p=1.0, do_sample=True),
         ),
     )
@@ -195,9 +200,12 @@ def bench_tpu() -> tuple:
         """One steady-state PPO cycle; returns the rollout/train phase
         boundary timestamp (everything after make_experience — epoch
         batch assembly, device placement, the fused train dispatch — is
-        booked under "train")."""
+        booked under "train"). With overlap_rollouts the next cycle's
+        generation is dispatched ahead of the fused scan, so the
+        "rollout" phase of the NEXT cycle starts from samples that
+        already computed under this cycle's train step."""
         trainer.store.clear_history()
-        trainer.make_experience(NUM_ROLLOUTS)
+        trainer.make_experience(NUM_ROLLOUTS)  # consumes any prefetched chunk
         mark = time.time()
         # all PPO_EPOCHS x minibatches in ONE dispatch (fused scan) —
         # the same path train.fused_inner_loop drives inside learn()
@@ -208,12 +216,64 @@ def bench_tpu() -> tuple:
             [rng.permutation(n)[:BATCH] for _ in range(PPO_EPOCHS * (n // BATCH))]
         ).astype(np.int32)
         device_full = trainer.place_batch(full)
+        # dispatch cycle t+1's generation BEFORE the train scan donates
+        # the params (device FIFO: generation samples first, then the
+        # block trains while the host scores those samples)
+        trainer.pre_optimization_hook(True)
         with trainer.mesh:
             trainer.params, trainer.opt_state, loss, _ = trainer._fused_train_step(
                 trainer.params, trainer.opt_state, device_full, jnp.asarray(perms)
             )
         float(loss)  # sync
         return mark
+
+    def train_contrast():
+        """Dispatch contrast: the SAME epoch data through the scanned
+        scan AND the per-minibatch loop, both WITHOUT a rollout prefetch
+        riding in the block (the overlapped cycle()'s train_s includes
+        next-cycle generation, which would bias the ratio low and hide a
+        looped-path dispatch regression). Returns (scanned_s, looped_s)."""
+        trainer._abandon_prefetch()  # keep the contrast prefetch-free
+        trainer.store.clear_history()
+        trainer.make_experience(NUM_ROLLOUTS)
+        full, n = trainer._fused_epoch_batch()
+        if trainer._train_step is None:
+            trainer._train_step = trainer.make_train_step()
+        device_full = trainer.place_batch(full)
+
+        def one_scanned():
+            perms = np.stack(
+                [rng.permutation(n)[:BATCH] for _ in range(PPO_EPOCHS * (n // BATCH))]
+            ).astype(np.int32)
+            t0 = time.time()
+            with trainer.mesh:
+                trainer.params, trainer.opt_state, loss, _ = trainer._fused_train_step(
+                    trainer.params, trainer.opt_state, device_full, jnp.asarray(perms)
+                )
+            float(loss)  # sync
+            return time.time() - t0
+
+        def one_looped():
+            perms = np.stack(
+                [rng.permutation(n)[:BATCH] for _ in range(PPO_EPOCHS * (n // BATCH))]
+            ).astype(np.int32)
+            t0 = time.time()
+            loss = None
+            with trainer.mesh:
+                for row in perms:
+                    mb = jax.tree_util.tree_map(
+                        lambda x: x[jnp.asarray(row)], device_full
+                    )
+                    trainer.params, trainer.opt_state, loss, _ = trainer._train_step(
+                        trainer.params, trainer.opt_state, mb
+                    )
+            float(loss)  # sync
+            return time.time() - t0
+
+        # first looped pass may compile its step; report each path's best
+        t_scan = min(one_scanned(), one_scanned())
+        t_loop = min(one_looped(), one_looped())
+        return t_scan, t_loop
 
     cycle()  # warmup: compiles sampler, experience fn, train step
     # median-of-5: the remote-tunneled chip adds latency jitter worth
@@ -247,6 +307,12 @@ def bench_tpu() -> tuple:
         "rollout_s": _mmm(rollouts),
         "train_s": _mmm(trains),
     }
+    # scanned-vs-looped dispatch contrast on the same workload, both
+    # prefetch-free so the ratio isolates the dispatch path
+    t_scan, t_loop = train_contrast()
+    spread["train_s_scanned_noprefetch"] = round(t_scan, 3)
+    spread["train_s_looped"] = round(t_loop, 3)
+    spread["train_looped_over_scanned"] = round(t_loop / max(t_scan, 1e-9), 2)
     return NUM_ROLLOUTS / median_dt, split, spread
 
 
@@ -762,6 +828,100 @@ def bench_randomwalks() -> dict:
     return out
 
 
+def bench_smoke() -> dict:
+    """Dispatch-path perf smoke (`python bench.py --smoke`, also
+    scripts/bench_smoke.py): ONE tiny PPO cycle run through BOTH train
+    paths — the scanned lax.scan over minibatch permutations and the
+    per-minibatch dispatch loop — printing their train_s and the ratio.
+    Small enough for CPU, so a regression on the dispatch path is
+    visible without the full bench (or a TPU)."""
+    _enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.utils.loading import get_trainer
+
+    S_ROLLOUTS, S_CHUNK, S_BATCH, S_EPOCHS = 16, 16, 8, 2
+    S_PROMPT, S_NEW = 16, 8
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=S_BATCH, total_steps=10_000, eval_interval=10_000,
+            checkpoint_interval=10_000, seq_length=S_PROMPT + S_NEW,
+            epochs=10_000, tracker=None,
+            checkpoint_dir=os.path.join("/tmp", "bench_smoke_ckpts"),
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    vocab_size=258, hidden_size=64, n_layer=2, n_head=2,
+                    n_positions=64,
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=S_ROLLOUTS, chunk_size=S_CHUNK, ppo_epochs=S_EPOCHS,
+            gen_kwargs=dict(max_new_tokens=S_NEW, top_k=0, top_p=1.0,
+                            do_sample=True),
+        ),
+    )
+    trainer = get_trainer(config.train.trainer)(
+        config=config, reward_fn=reward_fn
+    )
+    trainer.add_prompt_pipeline(
+        PromptPipeline(PROMPTS[:S_ROLLOUTS], S_PROMPT, trainer.tokenizer)
+    )
+    trainer.n_inner_epochs = S_EPOCHS
+    trainer.make_experience(S_ROLLOUTS)
+    full, n = trainer._fused_epoch_batch()
+    perms = trainer._epoch_perms(n)
+    device_full = trainer.place_batch(full)
+    fused = trainer.make_fused_train_steps()
+    looped = trainer.make_train_step()
+
+    def copy_tree(tree):
+        # both paths start from bit-identical state; donation must not
+        # touch the trainer's own params
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), x.sharding), tree
+        )
+
+    def run_scanned():
+        p, o = copy_tree(trainer.params), copy_tree(trainer.opt_state)
+        t0 = time.time()
+        with trainer.mesh:
+            p, o, loss, _ = fused(p, o, device_full, jnp.asarray(perms))
+        return time.time() - t0, float(loss)
+
+    def run_looped():
+        p, o = copy_tree(trainer.params), copy_tree(trainer.opt_state)
+        t0 = time.time()
+        loss = None
+        with trainer.mesh:
+            for row in perms:
+                mb = jax.tree_util.tree_map(
+                    lambda x: x[jnp.asarray(row)], device_full
+                )
+                p, o, loss, _ = looped(p, o, mb)
+        return time.time() - t0, float(loss)
+
+    run_scanned(), run_looped()  # compile warmup for both paths
+    t_scan, mean_loss = run_scanned()
+    t_loop, last_loss = run_looped()
+    return {
+        "smoke_steps": int(len(perms)),
+        "smoke_train_s_scanned": round(t_scan, 4),
+        "smoke_train_s_looped": round(t_loop, 4),
+        "smoke_looped_over_scanned": round(t_loop / max(t_scan, 1e-9), 2),
+        "smoke_mean_loss_scanned": round(mean_loss, 6),
+        "smoke_last_loss_looped": round(last_loss, 6),
+    }
+
+
 def bench_torch_cpu() -> float:
     """The reference stack's CPU configuration on the same workload."""
     import torch
@@ -880,6 +1040,9 @@ def run_sections(deadline: float) -> dict:
 
 
 def main():
+    if "--smoke" in sys.argv:
+        print(json.dumps({"metric": "ppo_smoke_train_ratio", **bench_smoke()}))
+        return
     # global wall budget: the driver records NOTHING on a timeout, so
     # every auxiliary section is budget-gated against this deadline
     deadline = time.time() + float(os.environ.get("BENCH_BUDGET_SEC", "540"))

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Dispatch-path perf smoke: one tiny PPO cycle run through BOTH train
+paths (scanned lax.scan vs per-minibatch dispatch loop), printing one
+JSON line with each train_s and the looped/scanned ratio.
+
+CPU-friendly (tiny random model, byte tokenizer, zero egress) — run it
+after touching the trainer dispatch path to see regressions without the
+full bench: `python scripts/bench_smoke.py` (equivalently
+`python bench.py --smoke`).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+if __name__ == "__main__":
+    print(json.dumps({"metric": "ppo_smoke_train_ratio", **bench.bench_smoke()}))

@@ -2,7 +2,8 @@
 discovery, retention, preemption, retry/backoff and the NaN-loss guard —
 including a fault-injection harness that kills a tiny-PPO run
 mid-training, corrupts checkpoints, and injects a flaky tracker and a NaN
-reward (ISSUE 1 acceptance scenario). Runs under tier-1 (CPU, not slow)."""
+reward (ISSUE 1 acceptance scenario). Runs under tier-1 (CPU, not slow),
+except the ILQL resume roundtrip (slow-marked: two full learn() runs)."""
 
 import json
 import os
@@ -382,6 +383,63 @@ def test_ppo_kill_resume_auto(tmp_path, monkeypatch):
     assert relaunch_calls["n"] == 0, "completed relaunch paid a rollout"
 
 
+def test_ppo_preemption_mid_prefetch_rewinds_cursor(tmp_path):
+    """overlap_rollouts dispatches cycle t+1's first chunk ahead of
+    cycle t's fused optimization block. A preemption that lands while
+    that prefetched chunk is being scored must rewind the prompt cursor
+    PAST the prefetch pull — the chunk never trains, so the resumed run
+    has to replay those prompts (not skip them), and then finish the
+    full step budget."""
+    ckpt_dir = str(tmp_path / "ckpts")
+
+    def cfg(**train):
+        return ppo_tiny_config(
+            ckpt_dir,
+            train=dict(
+                dict(total_steps=8, epochs=4, eval_interval=100,
+                     checkpoint_interval=100, save_best=False, **FAST_RETRY),
+                **train,
+            ),
+            # 2 chunks per cycle: the prefetched chunk is chunk 0 of the
+            # next cycle; the kill lands in its scoring, and the
+            # abandonment check fires before chunk 1
+            method=dict(num_rollouts=16, chunk_size=8,
+                        overlap_rollouts=True),
+        )
+
+    calls = {"n": 0}
+
+    def reward_kill_fourth(samples, prompts, outputs, **kw):
+        calls["n"] += 1
+        # calls 1+2: the initial cycle's two chunks; call 3: the initial
+        # evaluation; call 4: the PREFETCHED chunk of cycle 2, scored
+        # after cycle 1's fused block
+        if calls["n"] == 4:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return word_count_reward(samples, prompts, outputs)
+
+    trainer = trlx_tpu.train(
+        reward_fn=reward_kill_fourth, prompts=PPO_PROMPTS, config=cfg()
+    )
+    assert calls["n"] == 4, "kill should land on the prefetched chunk"
+    assert trainer.iter_count == 2  # one fused block (2 steps) trained
+    assert trainer._prefetched_gen is None
+    last = CheckpointManager(ckpt_dir).latest_committed()
+    assert last is not None
+    with open(os.path.join(last, "state.json")) as f:
+        state = json.load(f)
+    assert state["iter_count"] == 2
+    # the cursor excludes the prefetched chunk (pulled as batch #3): a
+    # resume replays it instead of skipping prompts that never trained
+    assert state["prompt_batches_consumed"] == 2, state
+
+    resumed = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS,
+        config=cfg(resume_from_checkpoint="auto"),
+    )
+    assert resumed.iter_count == 8
+
+
 def test_ppo_preemption_abandons_rollout(tmp_path):
     """A SIGTERM during rollout collection must abandon the remaining
     chunks (collection dominates PPO wall-clock; the grace period would
@@ -455,7 +513,13 @@ def test_sft_save_resume_roundtrip(tmp_path):
     assert len(loss_steps) == len(set(loss_steps)) == 4, loss_steps
 
 
+@pytest.mark.slow
 def test_ilql_save_resume_roundtrip(tmp_path):
+    # marker audit 2026-08-03: two full ILQL learn() runs = 37s of CPU
+    # wall, 2.5x the next-slowest tier-1 test — this is the "full
+    # learn()-loop integration" class the slow marker exists for. PPO
+    # and SFT resume coverage stays tier-1 (test_ppo_kill_resume_auto,
+    # test_sft_save_resume_roundtrip).
     import jax
 
     from trlx_tpu.data.default_configs import default_ilql_config

@@ -224,8 +224,24 @@ class TrainConfig(_Section):
     # (trainers that hold the epoch's data as a rectangular batch — PPO's
     # rollout store — support this; others fall back to the per-step
     # loop). Removes per-step dispatch latency and host syncs; per-step
-    # metric granularity collapses to per-block means.
-    fused_inner_loop: bool = False
+    # metric granularity collapses to per-block means. The scanned path
+    # draws its shuffles from the same seed stream as the looped
+    # dataloaders, so it is numerically equivalent step-for-step
+    # (tests/test_scanned_epochs.py); checkpoint/eval cadence quantizes
+    # to block boundaries when the intervals don't divide the block.
+    # Default ON since the dispatch-free-cycle change; set False for
+    # exact per-step cadence/metrics.
+    fused_inner_loop: bool = True
+    # Defer fused-block metrics behind an async device->host copy and
+    # consume them one cycle later (next block start / learn() exit):
+    # the host never blocks on the device between cycle boundaries, so
+    # per-block `jax.block_until_ready`-style fetches (a full host
+    # round-trip each on a remote-tunneled chip) disappear from the
+    # steady-state loop. Checkpoint/eval boundary blocks still flush
+    # synchronously (those operations block on the device anyway), and
+    # the NaN-abort guard then fires at most one cycle late. False
+    # restores the immediate per-block fetch.
+    async_metrics: bool = True
 
 
 _SECTIONS: Tuple[Tuple[str, type], ...] = (

@@ -87,6 +87,17 @@ class PPOConfig(MethodConfig):
     gen_kwargs: dict = field(default_factory=lambda: dict(max_new_tokens=40))
     gen_experience_kwargs: Optional[dict] = None
     num_value_layers_unfrozen: int = 0
+    # Cycle-level rollout/optimization overlap: dispatch the first chunk
+    # of cycle t+1's generation AHEAD of cycle t's fused optimization
+    # block (device FIFO samples it first; the host decodes+scores it
+    # while the block trains). The samples are one policy update stale,
+    # which PPO's importance ratio absorbs — old_logprobs are recomputed
+    # by the teacher-forced scorer with the params the optimization
+    # epoch actually starts from, so the ratio stays self-consistent.
+    # Preemption/resume cursors account for the in-flight chunk (it
+    # rewinds if it never trains). Requires the scanned epoch path
+    # (train.fused_inner_loop); off by default.
+    overlap_rollouts: bool = False
 
     def get_advantages_and_returns(self, values, rewards, response_length, use_whitening=True):
         from trlx_tpu.ops.ppo import gae_advantages_and_returns

@@ -33,12 +33,29 @@ def register_datapipeline(name_or_cls):
     return _register(name_or_cls, name_or_cls.__name__)
 
 
+def epoch_shuffle_order(n: int, seed: int) -> np.ndarray:
+    """THE canonical shuffled index order for one epoch over `n` rows.
+
+    Single source of truth shared by the host DataLoader, the
+    device-gather loader (ppo_pipeline._DeviceGatherLoader) and the
+    trainers' scanned-epoch path (TPUBaseTrainer._epoch_perms): all
+    three must consume rows in the same order for a given seed, or the
+    fused lax.scan over minibatch permutations stops being numerically
+    equivalent to the per-step loop (tests/test_scanned_epochs.py pins
+    this)."""
+    order = np.arange(n)
+    np.random.default_rng(seed).shuffle(order)
+    return order
+
+
 class DataLoader:
     """Minimal host-side batcher over an indexable dataset.
 
     Replaces torch.utils.data.DataLoader (reference BasePipeline
     create_loader): yields `collate_fn([items...])` over shuffled or
-    sequential index order. Deterministic given `seed`.
+    sequential index order. Deterministic given `seed`: the FIRST
+    iteration consumes `epoch_shuffle_order(n, seed)`; later iterations
+    of the same loader continue the generator stream.
     """
 
     def __init__(

@@ -35,7 +35,10 @@ class _DeviceGatherLoader:
 
     Keep the shuffle/drop_last/len semantics in lockstep with
     `pipeline.DataLoader` — the host and device paths must produce the
-    same batch composition for a given seed."""
+    same batch composition for a given seed, and the FIRST iteration's
+    order must equal `pipeline.epoch_shuffle_order(n, seed)` (the
+    scanned-epoch path derives its permutations from it; pinned by
+    tests/test_scanned_epochs.py)."""
 
     def __init__(self, history, batch_size, shuffle, drop_last, seed):
         self.history = history
@@ -134,6 +137,20 @@ class PPORolloutStorage(BaseRolloutStore):
 
     def collate(self, elems: List[PPORolloutBatch]) -> PPORolloutBatch:
         return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *elems)
+
+    def fused_epoch_source(self):
+        """The whole store as ONE rectangular epoch batch: (pytree,
+        n_rows), or None when empty.
+
+        This is the scanned-epoch export: the trainer's fused lax.scan
+        gathers minibatch rows from this tree on-device (`tree[perm]`
+        inside the scan body), so the ppo_epochs x minibatch loop runs
+        without per-step host dispatch. Shuffling stays equivalent to
+        the loader path because both draw index orders from
+        `pipeline.epoch_shuffle_order`."""
+        if self.history is None or len(self) == 0:
+            return None
+        return self.history, len(self)
 
     def create_loader(
         self, batch_size: int, shuffle: bool = False, drop_last: bool = False, seed: int = 0

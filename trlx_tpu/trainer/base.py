@@ -59,7 +59,7 @@ from trlx_tpu.utils.checkpointing import (
     retry_call,
 )
 from trlx_tpu.utils.tokenizers import load_tokenizer
-from trlx_tpu.utils.trackers import Tracker
+from trlx_tpu.utils.trackers import DeferredStats, Tracker
 
 logger = logging.get_logger(__name__)
 
@@ -204,6 +204,9 @@ class TPUBaseTrainer(BaseRLTrainer):
         self._train_step = None  # built lazily (jitted)
         self._fused_train_step = None  # built lazily (jitted inner loop)
         self._warned_fused_cadence = False
+        # fused-block metrics ride an async device->host copy and are
+        # consumed one cycle later (train.async_metrics)
+        self._deferred_train = DeferredStats()
         self._measured_forward_times = {}  # timing_split probes by batch shape
         self._seen_step_shapes = set()  # batch shapes whose step has compiled
         self._generate_fns: Dict[Tuple, Callable] = {}
@@ -1022,26 +1025,132 @@ class TPUBaseTrainer(BaseRLTrainer):
         when the trainer cannot provide one (streaming pipelines)."""
         return None
 
+    def _epoch_perms(self, n: int) -> np.ndarray:
+        """Stacked minibatch index rows [n_steps, batch_size] covering
+        every inner epoch, drawn from the SAME per-epoch seed stream the
+        looped path's create_train_dataloader consumes
+        (pipeline.epoch_shuffle_order with seed = train.seed + the
+        iter_count each epoch's loader would be created at). The scanned
+        path therefore trains on minibatches in exactly the order the
+        per-step loop would — the golden-equivalence contract
+        (tests/test_scanned_epochs.py)."""
+        from trlx_tpu.pipeline import epoch_shuffle_order
+
+        bs = self.config.train.batch_size
+        n_batches = max(n // bs, 1)
+        rows = []
+        it = self.iter_count
+        for _ in range(self.n_inner_epochs):
+            order = epoch_shuffle_order(n, self.config.train.seed + it)
+            rows.append(order[: n_batches * bs].reshape(n_batches, -1))
+            it += n_batches
+        return np.concatenate(rows, axis=0).astype(np.int32)
+
+    def pre_optimization_hook(self, will_continue: bool) -> None:
+        """Hook fired right before the fused optimization block is
+        dispatched, with every device input for the block already
+        enqueued and the param buffers still valid (the block's donation
+        invalidates them for any LATER dispatch). PPO uses it to launch
+        the next cycle's rollout generation ahead of the block
+        (ppo.overlap_rollouts); `will_continue` is False when this block
+        reaches total_steps, so nothing is prefetched for a cycle that
+        will never run."""
+
+    def _abandon_prefetch(self) -> None:
+        """Hook: drop any in-flight cross-cycle prefetch and rewind its
+        data cursors (the prefetched work never trains). Called when
+        learn() exits."""
+
+    def _finish_train_stats(self, log: bool = True, suppress_abort: bool = False):
+        """Materialize + process deferred fused-block metrics: run the
+        NaN-abort guard on each block's mean loss, attach the
+        host-derived keys (time/step — quantized to the flush boundary
+        under async_metrics — and the LR), and log through the tracker.
+        With `log=False` the LAST block's stats dict is returned instead
+        of logged, for the caller to merge eval results into (any older
+        pending blocks are still logged). `suppress_abort` demotes the
+        guard's abort to an error log — used on exit paths where raising
+        would mask the original control flow. Idempotent."""
+        import time as _time
+
+        entries = self._deferred_train.flush()
+        out = None
+        for i, (stats, step, meta) in enumerate(entries):
+            mean_loss = stats.pop("__mean_loss__")
+            n_steps = meta["n_steps"]
+            # time/step is only honest at a SYNC flush (log=False: the
+            # boundary path materializes right after dispatch, so
+            # elapsed is the true block wall). A deferred flush happens
+            # after the next rollout phase already ran — reporting that
+            # wall as time/step would fabricate a multi-x slowdown, so
+            # deferred blocks log only the host dispatch cost per step.
+            if not log and i == len(entries) - 1:
+                stats["time/step"] = (_time.time() - meta["t0"]) / n_steps
+            stats["time/dispatch"] = meta["dispatch_s"] / n_steps
+            # LR at the block-START step (what the block actually
+            # trained with) — same convention as the per-step loop
+            stats["learning_rate_group_0"] = float(
+                self.schedule(step - n_steps)
+            )
+            # one fused block counts as ONE bad step for the abort
+            # counter: a single poisoned (skipped) step inside the scan
+            # taints the block mean even when later steps recovered
+            try:
+                self._guard_bad_loss(mean_loss)
+            except RuntimeError:
+                if not suppress_abort:
+                    raise
+                logger.error(
+                    "NaN-abort condition reached while flushing deferred "
+                    "stats on an exit path; not re-raising"
+                )
+            if log or i < len(entries) - 1:
+                self._log_fused_block(stats, step, n_steps)
+            out = stats
+        return out
+
+    def _log_fused_block(self, stats, step: int, n_steps: int) -> None:
+        """Console + tracker logging for one fused block (shared by the
+        deferred flush and the boundary path, so the two can't drift)."""
+        desc = " | ".join(
+            f"{k}: {v:.2f}"
+            for k, v in stats.items()
+            if k.startswith("losses/") or k == "loss"
+        )
+        logger.info(
+            "[step %d/%d] (fused x%d) %s",
+            step, self.total_steps, n_steps, desc,
+        )
+        # pending rollout stats carry an earlier-or-equal step index:
+        # flush them first so tracker steps stay monotonic
+        self._finish_rollout_stats()
+        self._tracker_log(stats, step=step)
+
     def _learn_fused(self, fused_src, results):
         """All inner epochs in one device call (see make_fused_train_steps).
 
         Checkpoint/eval interval checks fire when a boundary is crossed
         inside the fused block — same cadence as the unfused loop up to
-        quantization to block ends. The NaN guard selects per-step inside
-        the scan; host-side the block's MEAN loss is the abort signal
-        (per-step granularity doesn't exist here)."""
+        quantization to block ends. Steady-state blocks (no boundary
+        crossed) keep the host dispatch-only: the block's metrics stay
+        on device behind an async copy (DeferredStats) and materialize
+        one cycle later, so there is no blocking device read between
+        cycle boundaries (train.async_metrics). The NaN guard selects
+        per-step inside the scan; host-side the block's MEAN loss is the
+        abort signal, evaluated when the stats materialize (at most one
+        cycle late)."""
         import time as _time
+
+        # the previous block's metrics land first: their copy streamed
+        # under the rollout phase, so this is a free read — and the
+        # NaN-abort check runs before any new work is dispatched
+        self._finish_train_stats()
 
         full, n = fused_src
         bs = self.config.train.batch_size
         n_batches = max(n // bs, 1)
         steps_left = max(self.total_steps - self.iter_count, 1)
-        rng = np.random.default_rng(self.iter_count)
-        perm_rows = []
-        for _ in range(self.n_inner_epochs):
-            order = rng.permutation(n)[: n_batches * bs]
-            perm_rows.extend(order.reshape(n_batches, bs))
-        perms = np.asarray(perm_rows[:steps_left], np.int32)
+        perms = self._epoch_perms(n)[:steps_left]
         n_steps = len(perms)
         # quantization is silent degradation whenever the requested eval
         # cadence doesn't land on fused-block boundaries (finer than one
@@ -1068,26 +1177,28 @@ class TPUBaseTrainer(BaseRLTrainer):
         if self._fused_train_step is None:
             self._fused_train_step = self.make_fused_train_steps()
         device_full = self.place_batch(full)
+        # cycle-level overlap: the next cycle's rollout generation is
+        # dispatched NOW, ahead of the block — device FIFO samples it
+        # first, and the host decodes+scores it while the block trains
+        self.pre_optimization_hook(self.iter_count + n_steps < self.total_steps)
         t0 = _time.time()
         with self.mesh:
             self.params, self.opt_state, loss, stats = self._fused_train_step(
                 self.params, self.opt_state, device_full, jnp.asarray(perms)
             )
-        # ONE host fetch for loss + every scalar stat
-        keys = [k for k in stats if np.ndim(stats[k]) == 0]
-        packed = np.asarray(jnp.stack([loss] + [stats[k] for k in keys]))
-        elapsed = _time.time() - t0
-        mean_loss = float(packed[0])
-        stats = {k: float(v) for k, v in zip(keys, packed[1:])}
-        stats["time/step"] = elapsed / n_steps
-        stats["learning_rate_group_0"] = float(self.schedule(self.iter_count))
-
+        dispatch_s = _time.time() - t0
+        # ONE async device->host copy for loss + every scalar stat,
+        # consumed at the next flush point (no blocking fetch here)
         prev = self.iter_count
         self.iter_count += n_steps
-        # one fused block counts as ONE bad step for the abort counter:
-        # a single poisoned (skipped) step inside the scan taints the
-        # block mean even when later steps recovered
-        self._guard_bad_loss(mean_loss)
+        staged = {"__mean_loss__": loss}
+        staged.update(
+            {k: stats[k] for k in stats if np.ndim(stats[k]) == 0}
+        )
+        self._deferred_train.stage(
+            staged, step=self.iter_count,
+            meta={"t0": t0, "n_steps": n_steps, "dispatch_s": dispatch_s},
+        )
         for _ in range(self.n_inner_epochs):
             self.post_backward_callback()
 
@@ -1096,25 +1207,24 @@ class TPUBaseTrainer(BaseRLTrainer):
                 self.iter_count >= self.total_steps
             )
 
-        if crossed(self.config.train.checkpoint_interval):
-            self._save_checkpoint(self._checkpoint_tag())
-
-        if crossed(self.config.train.eval_interval):
-            results = self.evaluate()
-            stats.update(results)
-            self._maybe_save_best(stats)
-
-        desc = " | ".join(
-            f"{k}: {v:.2f}"
-            for k, v in stats.items()
-            if k.startswith("losses/") or k == "loss"
-        )
-        logger.info(
-            "[step %d/%d] (fused x%d) %s",
-            self.iter_count, self.total_steps, n_steps, desc,
-        )
-        self._tracker_log(stats, step=self.iter_count)
+        ckpt_cross = crossed(self.config.train.checkpoint_interval)
+        eval_cross = crossed(self.config.train.eval_interval)
         done = self.iter_count >= self.total_steps
+        if (
+            ckpt_cross or eval_cross or done
+            or not self.config.train.async_metrics
+        ):
+            # boundary block: materialize this block's stats now (the
+            # checkpoint/eval work blocks on the device anyway) and log
+            # them merged with any eval results, like the unfused loop
+            stats = self._finish_train_stats(log=False)
+            if ckpt_cross:
+                self._save_checkpoint(self._checkpoint_tag())
+            if eval_cross:
+                results = self.evaluate()
+                stats.update(results)
+                self._maybe_save_best(stats)
+            self._log_fused_block(stats, self.iter_count, n_steps)
         if not done and self._should_stop(n_steps=n_steps):
             self._preemption_exit()
             done = True
@@ -1352,6 +1462,14 @@ class TPUBaseTrainer(BaseRLTrainer):
             # (total_steps hit before the next train step, or an exception)
             # so the final chunk's stats always reach the tracker
             self._finish_rollout_stats()
+            # a deferred fused block may still be pending on an abnormal
+            # exit (preemption/exception): flush it for the tracker, but
+            # don't let the NaN-abort guard mask the live control flow
+            self._finish_train_stats(suppress_abort=True)
+            # an in-flight cross-cycle rollout prefetch never trains once
+            # learn() exits: drop it and rewind its prompt cursor so a
+            # resumed run replays those prompts
+            self._abandon_prefetch()
 
     def _learn(self):
         logger.info("Starting training")
@@ -1428,6 +1546,10 @@ class TPUBaseTrainer(BaseRLTrainer):
                     return results
                 self.post_epoch_callback()
                 continue
+            # falling back to the per-step loop (empty/streaming store):
+            # a still-deferred fused block from an earlier epoch must log
+            # before this loop emits newer step indices
+            self._finish_train_stats()
             for _ in range(self.n_inner_epochs):
                 train_dataloader = self.create_train_dataloader()
                 for batch in train_dataloader:

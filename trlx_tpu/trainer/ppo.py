@@ -45,6 +45,7 @@ from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
 from trlx_tpu.utils import Clock, infinite_loader, logging
+from trlx_tpu.utils.trackers import DeferredStats
 from trlx_tpu.ops.remat import resolve_remat
 
 logger = logging.get_logger(__name__)
@@ -115,13 +116,19 @@ class TPUPPOTrainer(TPUBaseTrainer):
             self.kl_ctl = FixedKLController(config.method.init_kl_coef)
 
         self.mean_kl = 0.0
-        self._pending_rollout_stats = None
+        self._deferred_rollout = DeferredStats()
         # rollout-data cursor: how many prompt chunks this run has pulled
         # off the (deterministically shuffled) prompt stream. Saved in
         # state.json so a resumed run fast-forwards to the exact position
         # instead of replaying the stream from its start.
         self._prompt_batches_consumed = 0
         self._resume_prompt_cursor = 0
+        # cross-cycle rollout prefetch (method.overlap_rollouts): the
+        # next cycle's first chunk, generated ahead of the current fused
+        # optimization block, plus the prompt cursor it must rewind to
+        # if it never trains (preemption / run end)
+        self._prefetched_gen: Optional[Tuple] = None
+        self._prefetch_cursor_start: Optional[int] = None
         self.log_rollouts = config.train.rollout_logging_dir is not None
         if self.log_rollouts:
             self.setup_rollout_logging(config)
@@ -442,8 +449,15 @@ class TPUPPOTrainer(TPUBaseTrainer):
         self._rollout_abandoned = False
         # snapshot the prompt cursor: an abandoned (preempted) rollout
         # discards its partial store, so the cursor must rewind to here
-        # or the resumed run would skip prompts that never trained
-        prompt_cursor_start = self._prompt_batches_consumed
+        # or the resumed run would skip prompts that never trained. When
+        # the cycle starts from a prefetched chunk (overlap_rollouts),
+        # the rewind target is the cursor BEFORE that chunk's prompts
+        # were pulled — the prefetch pull already advanced it.
+        prompt_cursor_start = (
+            self._prefetch_cursor_start
+            if self._prefetched_gen is not None
+            else self._prompt_batches_consumed
+        )
         self._finish_rollout_stats()  # flush any deferred previous-cycle stats
         clock = Clock()
         n_collected = 0
@@ -455,10 +469,20 @@ class TPUPPOTrainer(TPUBaseTrainer):
         # before chunk i's host work (decode + reward_fn), so the device
         # samples while the host scores — the reference's rollout loop is
         # fully serial here (SURVEY §7 "host-device choreography")
-        next_batch: Optional[PromptBatch] = self._next_prompt_batch()
-        rollout_generate_time = time()
-        next_gen = self.generate(next_batch.input_ids, next_batch.attention_mask)
-        next_gen_time = time() - rollout_generate_time
+        if self._prefetched_gen is not None:
+            # cycle-level overlap: chunk 0 was dispatched ahead of the
+            # previous cycle's fused optimization block and sampled
+            # under it on-device (pre_optimization_hook)
+            next_batch, next_gen, next_gen_time = self._prefetched_gen
+            self._prefetched_gen = None
+            self._prefetch_cursor_start = None
+        else:
+            next_batch = self._next_prompt_batch()
+            rollout_generate_time = time()
+            next_gen = self.generate(
+                next_batch.input_ids, next_batch.attention_mask
+            )
+            next_gen_time = time() - rollout_generate_time
         chunk_rows = len(next_batch.input_ids) * mh.data_group_count(self.mesh)
         while n_collected < num_rollouts:
             # rollout collection dominates PPO wall-clock: a preemption
@@ -785,43 +809,22 @@ class TPUPPOTrainer(TPUBaseTrainer):
             k: sum(xs[k] for xs in accumulated_stats) / len(accumulated_stats)
             for k in accumulated_stats[-1]
         }
-        # ONE packed fetch for every accumulated device scalar — started
-        # asynchronously here and materialized lazily (post_backward /
-        # next make_experience): on a remote-tunneled chip the blocking
-        # read costs a full ~100ms round trip, which this way overlaps the
+        # ONE packed async device->host copy for every accumulated device
+        # scalar, materialized lazily (post_backward / next
+        # make_experience): on a remote-tunneled chip the blocking read
+        # costs a full ~100ms round trip, which this way overlaps the
         # train step instead of extending the rollout phase
-        keys = list(agg)
-        vals = [agg[k] for k in keys]
-        dev_ix = [i for i, v in enumerate(vals) if isinstance(v, jax.Array)]
-        stacked = None
-        if dev_ix:
-            stacked = jnp.stack([vals[i] for i in dev_ix])
-            try:
-                stacked.copy_to_host_async()
-            except Exception:
-                pass  # transfer still happens at materialization
         if hasattr(pbar, "close"):
             pbar.close()
-        self._pending_rollout_stats = (
-            keys, vals, dev_ix, stacked, self.kl_ctl.value, iter_count
-        )
+        self._deferred_rollout.stage(agg, step=iter_count, meta=self.kl_ctl.value)
 
     def _finish_rollout_stats(self) -> None:
         """Materialize + log the deferred make_experience stats (sets
         self.mean_kl for the KL controller). Idempotent."""
-        pending = getattr(self, "_pending_rollout_stats", None)
-        if pending is None:
-            return
-        self._pending_rollout_stats = None
-        keys, vals, dev_ix, stacked, kl_ctl_value, iter_count = pending
-        if dev_ix:
-            fetched = np.asarray(stacked)
-            for i, f in zip(dev_ix, fetched.tolist()):
-                vals[i] = f
-        stats = {k: float(v) for k, v in zip(keys, vals)}
-        stats["kl_ctl_value"] = kl_ctl_value
-        self.mean_kl = stats["policy/sqrt_kl"] ** 2
-        self._tracker_log(stats, step=iter_count)
+        for stats, step, kl_ctl_value in self._deferred_rollout.flush():
+            stats["kl_ctl_value"] = kl_ctl_value
+            self.mean_kl = stats["policy/sqrt_kl"] ** 2
+            self._tracker_log(stats, step=step)
 
     # -- loop hooks ------------------------------------------------------
 
@@ -863,6 +866,39 @@ class TPUPPOTrainer(TPUBaseTrainer):
         self._prompt_batches_consumed += 1
         return batch
 
+    # -- cross-cycle rollout prefetch (method.overlap_rollouts) ----------
+
+    def pre_optimization_hook(self, will_continue: bool) -> None:
+        """Dispatch the FIRST chunk of the next cycle's generation ahead
+        of the fused optimization block, with the pre-update params.
+        Device FIFO runs the generation before the train scan — whose
+        buffer donation invalidates these params for any LATER dispatch
+        — and the host decodes+scores the chunk while the block trains.
+        The samples are one policy update stale, which PPO's importance
+        ratio absorbs: the teacher-forced scorer recomputes old_logprobs
+        with the updated params when the chunk is consumed, so the ratio
+        stays self-consistent with the optimization epoch's start."""
+        if not self.config.method.overlap_rollouts or not will_continue:
+            return
+        if self._prefetched_gen is not None or not hasattr(self, "prompt_iterator"):
+            return
+        cursor0 = self._prompt_batches_consumed
+        batch = self._next_prompt_batch()
+        t0 = time()
+        gen = self.generate(batch.input_ids, batch.attention_mask)
+        self._prefetched_gen = (batch, gen, time() - t0)
+        self._prefetch_cursor_start = cursor0
+
+    def _abandon_prefetch(self) -> None:
+        """Drop an in-flight prefetched chunk and rewind the prompt
+        cursor: its rollouts never train (run ending / preempted), so a
+        resumed run must replay those prompts."""
+        if self._prefetched_gen is None:
+            return
+        self._prefetched_gen = None
+        self._prompt_batches_consumed = self._prefetch_cursor_start
+        self._prefetch_cursor_start = None
+
     def _fast_forward_prompts(self) -> None:
         """Resume: advance the prompt stream to the saved cursor. The
         loader's shuffle RNG is stateful per epoch, so replaying `skip`
@@ -893,7 +929,14 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 "mean": float(rm.mean), "var": float(rm.var),
                 "std": float(rm.std), "count": float(rm.count),
             },
-            "prompt_batches_consumed": self._prompt_batches_consumed,
+            # an in-flight prefetched chunk has NOT trained: persist the
+            # cursor from before its pull, so a resume from this
+            # checkpoint replays those prompts instead of skipping them
+            "prompt_batches_consumed": (
+                self._prefetch_cursor_start
+                if self._prefetched_gen is not None
+                else self._prompt_batches_consumed
+            ),
         }
 
     def _restore_extra_state(self, state) -> None:
@@ -944,9 +987,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
     def _fused_epoch_batch(self):
         # the rollout store is a rectangular (device-resident) pytree:
         # the whole ppo_epochs x minibatch loop can run as one fused scan
-        if self.store.history is None or len(self.store) == 0:
-            return None
-        return self.store.history, len(self.store)
+        return self.store.fused_epoch_source()
 
     def post_epoch_callback(self) -> None:
         if self.log_rollouts:

@@ -31,6 +31,59 @@ def _run_name(config) -> str:
     return config.train.run_name or f"{script}/{model}/{len(jax.devices())}dev"
 
 
+class DeferredStats:
+    """One-cycle-delayed metric staging for device-resident scalars.
+
+    `stage()` packs every jax.Array scalar in a stats dict into ONE
+    stacked device array and starts its device->host copy
+    asynchronously; `flush()` materializes the staged dicts (blocking
+    only if a copy hasn't landed yet — normally it streamed under
+    whatever the device ran next) and returns `[(stats, step, meta),
+    ...]` in stage order, all values as host floats.
+
+    This is how the trainers keep the hot path dispatch-free: each
+    blocking per-stat read costs a full host round-trip (~100ms+ on a
+    remote-tunneled chip), so rollout and fused-train metrics stay on
+    device until the next cycle boundary consumes them."""
+
+    def __init__(self):
+        self._pending = []
+
+    def stage(self, stats: Dict[str, Any], step: int, meta: Any = None) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        keys = list(stats)
+        vals = [stats[k] for k in keys]
+        dev_ix = [i for i, v in enumerate(vals) if isinstance(v, jax.Array)]
+        stacked = None
+        if dev_ix:
+            stacked = jnp.stack([vals[i] for i in dev_ix])
+            try:
+                stacked.copy_to_host_async()
+            except Exception:
+                pass  # transfer still happens at materialization
+        self._pending.append((keys, vals, dev_ix, stacked, step, meta))
+
+    def __bool__(self) -> bool:
+        return bool(self._pending)
+
+    def flush(self):
+        import numpy as np
+
+        out = []
+        for keys, vals, dev_ix, stacked, step, meta in self._pending:
+            if dev_ix:
+                fetched = np.asarray(stacked)
+                for i, f in zip(dev_ix, fetched.tolist()):
+                    vals[i] = f
+            out.append(
+                ({k: float(v) for k, v in zip(keys, vals)}, step, meta)
+            )
+        self._pending.clear()
+        return out
+
+
 class Tracker:
     """Dispatches scalar stats to the configured backend + a JSONL log."""
 
