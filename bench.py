@@ -922,6 +922,107 @@ def bench_smoke() -> dict:
     }
 
 
+def bench_chaos() -> dict:
+    """Robustness smoke (`python bench.py --chaos`, also
+    scripts/chaos_smoke.py): one short PPO learn() run under an injected
+    NaN burst + reward-service timeout, with the guardrails watchdog and
+    the resilient reward path armed and the overlapped rollout prefetch
+    ON. CPU-sized (tiny random model, byte tokenizer, zero egress).
+
+    Asserts the run recovers WITHOUT human intervention: completes its
+    full step budget, executes >= 1 auto-rollback to the last good
+    checkpoint, engages the reward fallback for the injected timeout,
+    and finishes with a finite final reward."""
+    _enable_compile_cache()
+    import shutil
+
+    import numpy as np
+
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    ckpt_dir = os.path.join("/tmp", "chaos_smoke_ckpts")
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    config = default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=8, eval_interval=100,
+            checkpoint_interval=2, seq_length=24, epochs=64,
+            tracker="jsonl", checkpoint_dir=ckpt_dir, save_best=False,
+            keep_last_n=3, external_retries=1, retry_base_delay=0.05,
+            guardrails=dict(
+                enabled=True, min_history=2,
+                ladder=["requeue", "rollback", "abort"],
+                cooldown_cycles=2, max_rollbacks=3,
+            ),
+            resilient_io=dict(
+                reward_timeout=0.05, fallback_reward="hold_mean",
+                breaker_threshold=2,
+            ),
+            chaos=dict(
+                seed=0,
+                faults=[
+                    # fused blocks 3 and 4 train on NaN-poisoned batches
+                    {"fault": "nan_loss", "at": 3, "span": 2},
+                    # the 4th reward call stalls past the 0.05s deadline
+                    {"fault": "reward_timeout", "at": 4},
+                ],
+                reward_delay=0.5,
+            ),
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    vocab_size=258, hidden_size=64, n_layer=2, n_head=2,
+                    n_positions=64,
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            overlap_rollouts=True,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                            do_sample=True),
+        ),
+    )
+    prompts = ["hello world", "the cat", "a b", "xyz",
+               "what is", "I am", "go", "ok"]
+
+    def reward(samples, prompts, outputs, **kw):
+        return [float(len(o.split())) for o in outputs]
+
+    t0 = time.time()
+    trainer = trlx_tpu.train(reward_fn=reward, prompts=prompts, config=config)
+    wall = time.time() - t0
+
+    with open(os.path.join(ckpt_dir, "logs", "metrics.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    rewards = [r["reward/mean"] for r in recs if "reward/mean" in r]
+    final_reward = rewards[-1] if rewards else float("nan")
+    fallbacks = (
+        trainer._reward_caller.fallback_engaged
+        if trainer._reward_caller is not None else 0
+    )
+    assert trainer.iter_count >= config.train.total_steps, (
+        f"chaos run stalled at step {trainer.iter_count}"
+    )
+    assert trainer.guardrails.rollbacks >= 1, (
+        f"expected >= 1 auto-rollback, saw {trainer.guardrails.rollbacks} "
+        f"(actions: {trainer.guardrails.actions_taken})"
+    )
+    assert np.isfinite(final_reward), f"final reward {final_reward} not finite"
+    return {
+        "chaos_completed_steps": int(trainer.iter_count),
+        "chaos_rollbacks": int(trainer.guardrails.rollbacks),
+        "chaos_actions": list(trainer.guardrails.actions_taken),
+        "chaos_faults_fired": trainer.chaos.fired,
+        "chaos_reward_fallbacks": int(fallbacks),
+        "chaos_final_reward": round(float(final_reward), 4),
+        "chaos_wall_s": round(wall, 2),
+    }
+
+
 def bench_torch_cpu() -> float:
     """The reference stack's CPU configuration on the same workload."""
     import torch
@@ -1042,6 +1143,9 @@ def run_sections(deadline: float) -> dict:
 def main():
     if "--smoke" in sys.argv:
         print(json.dumps({"metric": "ppo_smoke_train_ratio", **bench_smoke()}))
+        return
+    if "--chaos" in sys.argv:
+        print(json.dumps({"metric": "ppo_chaos_smoke", **bench_chaos()}))
         return
     # global wall budget: the driver records NOTHING on a timeout, so
     # every auxiliary section is budget-gated against this deadline

@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Robustness smoke: a short PPO `learn()` under injected chaos (NaN
+burst in the fused-block losses + a reward-service timeout), with the
+guardrails watchdog, the resilient reward path and the overlapped
+rollout prefetch all armed.
+
+Prints one JSON line and exits non-zero if the run does not recover
+without human intervention (full step budget completed, >= 1
+auto-rollback to the last good checkpoint, finite final reward).
+
+CPU-friendly (tiny random model, byte tokenizer, zero egress) — run it
+after touching guardrails / checkpointing / the rollout loop:
+`python scripts/chaos_smoke.py` (equivalently `python bench.py --chaos`).
+See docs/robustness.md for the fault-schedule format.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+if __name__ == "__main__":
+    print(json.dumps({"metric": "ppo_chaos_smoke", **bench.bench_chaos()}))

@@ -1,0 +1,81 @@
+"""DeferredStats unit tests (ISSUE 3 satellite): the sync-flush
+contract (flush materializes every staged value as a host float, in
+stage order, exactly once) and the one-cycle-late delivery ordering the
+dispatch-free cycle relies on — previously only exercised indirectly
+through learn()."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.utils.trackers import DeferredStats
+
+
+def test_flush_materializes_device_scalars_in_stage_order():
+    ds = DeferredStats()
+    ds.stage({"a": jnp.float32(1.5), "b": 2.0}, step=1, meta={"tag": "x"})
+    ds.stage({"a": jnp.float32(3.5), "c": jnp.int32(7)}, step=2, meta=None)
+    out = ds.flush()
+    assert [step for _, step, _ in out] == [1, 2]
+    stats1, _, meta1 = out[0]
+    stats2, _, meta2 = out[1]
+    assert stats1 == {"a": 1.5, "b": 2.0} and meta1 == {"tag": "x"}
+    assert stats2 == {"a": 3.5, "c": 7.0} and meta2 is None
+    # every value is a HOST float after flush (tracker contract)
+    assert all(isinstance(v, float) for v in {**stats1, **stats2}.values())
+
+
+def test_flush_is_consuming_and_idempotent():
+    ds = DeferredStats()
+    assert not ds and ds.flush() == []
+    ds.stage({"x": jnp.float32(1.0)}, step=0)
+    assert bool(ds)
+    assert len(ds.flush()) == 1
+    # a second flush delivers nothing: entries are consumed exactly once
+    assert not ds and ds.flush() == []
+
+
+def test_one_cycle_late_delivery_ordering():
+    """The trainer stages cycle t's stats and flushes them at cycle
+    t+1's boundary, BEFORE staging t+1's stats: interleaved
+    stage/flush/stage must deliver each block exactly once, in step
+    order, never reordering across flush points."""
+    ds = DeferredStats()
+    delivered = []
+    for cycle in range(4):
+        # cycle boundary: the previous block's stats land first
+        for stats, step, _ in ds.flush():
+            delivered.append((step, stats["loss"]))
+        ds.stage(
+            {"loss": jnp.float32(float(cycle))}, step=cycle + 1,
+            meta={"n_steps": 1},
+        )
+    # final flush (learn() exit path)
+    for stats, step, _ in ds.flush():
+        delivered.append((step, stats["loss"]))
+    assert delivered == [(1, 0.0), (2, 1.0), (3, 2.0), (4, 3.0)]
+
+
+def test_flush_values_survive_device_computation():
+    """Staged device scalars must flush to their computed values even
+    when other device work was dispatched in between (the async copy
+    streams under whatever ran next)."""
+    ds = DeferredStats()
+    x = jnp.arange(1024, dtype=jnp.float32)
+    ds.stage({"mean": x.mean(), "max": x.max()}, step=1)
+    # unrelated device work after staging
+    _ = np.asarray(jnp.ones((256, 256)) @ jnp.ones((256, 256)))
+    (stats, step, _), = ds.flush()
+    assert step == 1
+    assert stats["mean"] == float(np.arange(1024).mean())
+    assert stats["max"] == 1023.0
+
+
+def test_stage_mixed_host_and_device_values():
+    ds = DeferredStats()
+    ds.stage(
+        {"dev": jnp.float32(2.25), "host_int": 3, "host_float": 0.5},
+        step=9,
+    )
+    (stats, step, meta), = ds.flush()
+    assert step == 9 and meta is None
+    assert stats == {"dev": 2.25, "host_int": 3.0, "host_float": 0.5}

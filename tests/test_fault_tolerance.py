@@ -484,6 +484,49 @@ def test_ppo_preemption_abandons_rollout(tmp_path):
     assert resumed.iter_count == 2
 
 
+def test_chaos_sigterm_mid_fused_block_checkpoints_and_resumes(tmp_path):
+    """Chaos `sigterm` raises SIGTERM right after the fused block is
+    dispatched — the signal lands while the device is mid-block, the
+    worst moment a scheduler reclaim can pick. learn() must finish the
+    block, commit one final consistent checkpoint and exit cleanly; a
+    relaunch resumes and completes the budget (ISSUE 3 acceptance)."""
+    ckpt_dir = str(tmp_path / "ckpts")
+
+    def cfg(chaos=None, **train):
+        return ppo_tiny_config(
+            ckpt_dir,
+            train=dict(
+                dict(total_steps=4, epochs=8, eval_interval=100,
+                     checkpoint_interval=100, save_best=False,
+                     chaos=chaos, **FAST_RETRY),
+                **train,
+            ),
+            method=dict(num_rollouts=8, chunk_size=8,
+                        overlap_rollouts=True),
+        )
+
+    trainer = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS,
+        config=cfg(chaos=dict(seed=0, faults=[{"fault": "sigterm", "at": 2}])),
+    )
+    # the 2nd fused block completed (the signal is polled at the next
+    # safe point), then the run checkpointed and exited
+    assert 0 < trainer.iter_count < 4
+    assert trainer.chaos.fired == [{"fault": "sigterm", "count": 2}]
+    last = CheckpointManager(ckpt_dir).latest_committed()
+    assert last is not None and is_committed(last)
+    with open(os.path.join(last, "state.json")) as f:
+        assert json.load(f)["iter_count"] == trainer.iter_count
+    # an in-flight prefetched chunk never trained: its prompts replay
+    assert trainer._prefetched_gen is None
+
+    resumed = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS,
+        config=cfg(resume_from_checkpoint="auto"),
+    )
+    assert resumed.iter_count == 4
+
+
 # ---------------------------------------------------------------------------
 # save -> reconstruct -> resume round-trips (SFT, ILQL; PPO above)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,257 @@
+"""Resilient-I/O unit tests: retry/backoff/jitter with a FAKE clock (no
+real sleeps in tier-1), deadline'd calls, circuit-breaker state machine
+incl. half-open recovery, and the composed ResilientCaller fallback
+semantics (ISSUE 3 satellite)."""
+
+import random
+
+import pytest
+
+from trlx_tpu.utils.resilient import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    ResilientCaller,
+    ResilientIOConfig,
+    call_with_deadline,
+    compute_backoff,
+    retry_call,
+)
+
+
+class FakeClock:
+    """Deterministic clock + sleep pair: sleep() advances the clock."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = start
+        self.sleeps = []
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+# ---------------------------------------------------------------------------
+# backoff / retry
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_doubles_and_caps():
+    rng = random.Random(0)
+    delays = [
+        compute_backoff(a, base_delay=0.5, max_delay=8.0, jitter=0.0, rng=rng)
+        for a in range(6)
+    ]
+    assert delays == [0.5, 1.0, 2.0, 4.0, 8.0, 8.0]
+
+
+def test_backoff_jitter_bounds():
+    rng = random.Random(1234)
+    for attempt in range(5):
+        base = min(0.5 * (2 ** attempt), 8.0)
+        for _ in range(200):
+            d = compute_backoff(attempt, 0.5, 8.0, jitter=0.25, rng=rng)
+            assert base * 0.75 <= d <= base * 1.25, (attempt, d)
+
+
+def test_retry_call_fake_clock_no_real_sleep():
+    clock = FakeClock()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise ConnectionError("transient")
+        return "ok"
+
+    out = retry_call(
+        flaky, retries=3, base_delay=0.5, jitter=0.0, sleep=clock.sleep
+    )
+    assert out == "ok" and calls["n"] == 4
+    # backoff schedule ran entirely on the fake clock
+    assert clock.sleeps == [0.5, 1.0, 2.0]
+
+
+def test_retry_call_exhaustion_raises_with_fake_clock():
+    clock = FakeClock()
+
+    def dead():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        retry_call(dead, retries=2, base_delay=0.5, jitter=0.0, sleep=clock.sleep)
+    assert clock.sleeps == [0.5, 1.0]  # no sleep after the final failure
+
+
+# ---------------------------------------------------------------------------
+# deadline
+# ---------------------------------------------------------------------------
+
+
+def test_call_with_deadline_passes_through():
+    assert call_with_deadline(lambda a, b: a + b, 5.0, 1, b=2) == 3
+
+
+def test_call_with_deadline_times_out():
+    import time
+
+    with pytest.raises(DeadlineExceeded):
+        call_with_deadline(time.sleep, 0.02, 0.5)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_opens_after_threshold_and_half_open_recovers():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=3, reset_timeout=30.0, clock=clock)
+    assert br.allow() and br.is_closed
+    for _ in range(2):
+        br.record_failure()
+    assert br.allow()  # below threshold: still closed
+    br.record_failure()  # 3rd consecutive: open
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()  # rejected while the reset window runs
+
+    clock.advance(29.9)
+    assert not br.allow()
+    clock.advance(0.2)  # window elapsed: one half-open probe allowed
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow()
+    br.record_success()  # probe succeeded: closed again
+    assert br.is_closed and br.allow()
+
+
+def test_breaker_half_open_failure_reopens():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=10.0, clock=clock)
+    br.record_failure()
+    assert not br.allow()
+    clock.advance(10.0)
+    assert br.allow()  # half-open probe
+    br.record_failure()  # probe failed: re-open with a fresh window
+    assert not br.allow()
+    clock.advance(9.9)
+    assert not br.allow()
+    clock.advance(0.2)
+    assert br.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(failure_threshold=2, reset_timeout=0.0)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.is_closed  # failures were not consecutive
+
+
+def test_breaker_reset_zero_probes_every_call():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=0.0, clock=clock)
+    br.record_failure()
+    # the tracker policy: one un-retried probe per call while open
+    assert br.allow()
+    br.record_failure()
+    assert br.allow()
+
+
+# ---------------------------------------------------------------------------
+# ResilientCaller composition
+# ---------------------------------------------------------------------------
+
+
+def test_caller_retries_then_falls_back():
+    clock = FakeClock()
+
+    def dead(**kw):
+        raise ConnectionError("service down")
+
+    caller = ResilientCaller(
+        fn=dead, description="test", retries=2, base_delay=0.1, jitter=0.0,
+        fallback=lambda exc, kwargs: ["held"] * len(kwargs["samples"]),
+        sleep=clock.sleep,
+    )
+    out = caller(samples=["a", "b", "c"])
+    assert out == ["held"] * 3
+    assert caller.fallback_engaged == 1
+    assert clock.sleeps == [0.1, 0.2]
+
+
+def test_caller_no_fallback_propagates():
+    clock = FakeClock()
+
+    def dead(**kw):
+        raise ConnectionError("down")
+
+    caller = ResilientCaller(
+        fn=dead, description="test", retries=1, base_delay=0.1, jitter=0.0,
+        sleep=clock.sleep,
+    )
+    with pytest.raises(ConnectionError):
+        caller(samples=["a"])
+
+
+def test_caller_breaker_open_skips_call_and_half_open_probe_recovers():
+    clock = FakeClock()
+    calls = {"n": 0, "fail": True}
+
+    def svc(**kw):
+        calls["n"] += 1
+        if calls["fail"]:
+            raise ConnectionError("down")
+        return ["real"]
+
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=60.0, clock=clock)
+    caller = ResilientCaller(
+        fn=svc, description="test", retries=2, base_delay=0.1, jitter=0.0,
+        breaker=br, fallback=lambda exc, kwargs: ["fb"], sleep=clock.sleep,
+    )
+    assert caller(samples=["x"]) == ["fb"]  # 3 attempts, breaker opens
+    assert calls["n"] == 3
+    # circuit open: the service is NOT called at all
+    assert caller(samples=["x"]) == ["fb"]
+    assert calls["n"] == 3
+    # reset window elapses -> half-open: exactly ONE un-retried probe
+    clock.advance(61.0)
+    calls["fail"] = False
+    assert caller(samples=["x"]) == ["real"]
+    assert calls["n"] == 4
+    assert br.is_closed
+
+
+def test_caller_deadline_attempt(monkeypatch):
+    import time
+
+    caller = ResilientCaller(
+        fn=lambda **kw: time.sleep(0.5) or ["late"],
+        description="slow", timeout=0.02, retries=0,
+        fallback=lambda exc, kwargs: ["fb"],
+    )
+    assert caller(samples=["x"]) == ["fb"]
+    assert caller.fallback_engaged == 1
+
+
+# ---------------------------------------------------------------------------
+# config parsing
+# ---------------------------------------------------------------------------
+
+
+def test_resilient_io_config_validation():
+    cfg = ResilientIOConfig.from_dict(
+        dict(reward_timeout=1.5, fallback_reward="hold_mean")
+    )
+    assert cfg.reward_timeout == 1.5 and cfg.has_fallback
+    assert not ResilientIOConfig.from_dict(None).has_fallback
+    assert ResilientIOConfig.from_dict({"fallback_reward": 0.5}).has_fallback
+    with pytest.raises(ValueError, match="unknown keys"):
+        ResilientIOConfig.from_dict({"not_a_knob": 1})
+    with pytest.raises(ValueError, match="fallback_reward"):
+        ResilientIOConfig.from_dict({"fallback_reward": "bogus"})

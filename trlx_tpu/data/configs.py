@@ -242,6 +242,32 @@ class TrainConfig(_Section):
     # the NaN-abort guard then fires at most one cycle late. False
     # restores the immediate per-block fetch.
     async_metrics: bool = True
+    # --- run guardrails (divergence watchdog) ---------------------------
+    # Parsed by utils/guardrails.GuardrailConfig (enabled/window/
+    # loss_spike_sigma/kl_factor/reward_sigma/grad_norm_max/
+    # cycle_time_factor/ladder/lr_cut_factor/cooldown_cycles/
+    # max_rollbacks/recover_after). Default {} = disabled: identical
+    # behavior to pre-guardrails builds. When enabled, health trips walk
+    # the escalation ladder (log -> requeue -> lr_cut -> rollback ->
+    # abort), checkpoint commits are gated on health, and auto-rollback
+    # restores the last good checkpoint. See docs/robustness.md.
+    guardrails: Dict[str, Any] = field(default_factory=dict)
+    # --- resilient external I/O -----------------------------------------
+    # Parsed by utils/resilient.ResilientIOConfig (reward_timeout/
+    # retries/base_delay/max_delay/jitter/breaker_threshold/
+    # breaker_reset_s/fallback_reward). Default {} keeps PR 1 semantics:
+    # plain retry+backoff, reward failures propagate. Setting
+    # fallback_reward ("hold_mean" or a number) arms the circuit
+    # breaker and degrades a dead reward service to the fallback instead
+    # of failing the run; reward_timeout bounds each attempt.
+    resilient_io: Dict[str, Any] = field(default_factory=dict)
+    # --- chaos injection (tests/CI only) --------------------------------
+    # Parsed by utils/chaos.ChaosMonkey: {"seed": int, "faults": [
+    # {"fault": "nan_loss"|"sigterm"|"nan_reward"|"reward_timeout"|
+    # "reward_error"|"ckpt_fail", "at": k | "every": n | "p": x,
+    # "span": m}], "reward_delay": s}. None/{} disables. Deterministic
+    # given the seed — see docs/robustness.md for the schedule format.
+    chaos: Optional[Dict[str, Any]] = None
 
 
 _SECTIONS: Tuple[Tuple[str, type], ...] = (

@@ -53,9 +53,17 @@ from trlx_tpu.parallel import (
 from trlx_tpu.parallel import multihost as mh
 from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import Clock, build_optimizer, logging, significant, to_scalar
+from trlx_tpu.utils.chaos import build_chaos
 from trlx_tpu.utils.checkpointing import (
     CheckpointManager,
     PreemptionHandler,
+)
+from trlx_tpu.utils.guardrails import build_monitor
+from trlx_tpu.utils.resilient import (
+    ChaosFault,
+    CircuitBreaker,
+    ResilientCaller,
+    ResilientIOConfig,
     retry_call,
 )
 from trlx_tpu.utils.tokenizers import load_tokenizer
@@ -135,16 +143,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         if self.mesh.shape["sp"] > 1 or self.mesh.shape["pp"] > 1:
             self._lm().mesh = self.mesh
 
-        tx, self.schedule = build_optimizer(config.optimizer, config.scheduler)
         self._update_mask = self.trainable_mask()
-        if hasattr(tx, "fused_apply"):
-            # fused optimizers write params directly (no updates tree to
-            # chain a mask into); _step_update streams the mask through
-            # fused_apply instead
-            pass
-        elif self._update_mask is not None:
-            tx = optax.chain(tx, _mask_updates(self._update_mask))
-        self.tx = tx
+        self.tx, self.schedule = self._assemble_optimizer(
+            config.optimizer, config.scheduler
+        )
         with self.mesh:
             self.opt_state = init_sharded_opt_state(self.mesh, self.tx, self.params)
 
@@ -180,8 +182,21 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.preemption = PreemptionHandler()
         self._bad_steps = 0  # consecutive non-finite-loss steps
         self._preempt_sync_counter = 0  # multihost any_flag cadence
-        self._tracker_failures = 0  # consecutive tracker outages (circuit)
+        # tracker outage circuit: open after _TRACKER_CIRCUIT_LIMIT
+        # consecutive exhausted-retry failures; reset_timeout=0 allows
+        # one un-retried probe per step while open
+        self._tracker_breaker = CircuitBreaker(
+            failure_threshold=self._TRACKER_CIRCUIT_LIMIT, reset_timeout=0.0
+        )
         self._rollout_abandoned = False  # preemption truncated the store
+        # run guardrails (divergence watchdog) + chaos harness +
+        # resilient reward I/O — all default-off / behavior-preserving
+        self.guardrails = build_monitor(train)
+        self.chaos = build_chaos(train)
+        self._resilient_cfg = ResilientIOConfig.from_dict(train.resilient_io)
+        self._reward_caller: Optional[ResilientCaller] = None  # lazy
+        self._lr_scale = 1.0  # cumulative guardrail LR-cut factor
+        self._ckpt_commit_failures = 0  # consecutive failed commits
         # run-derived step budget of a restored checkpoint (PPO lowers
         # total_steps from the store size inside prepare_learning, so
         # the config value alone can't tell a completed run from one
@@ -207,6 +222,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         # fused-block metrics ride an async device->host copy and are
         # consumed one cycle later (train.async_metrics)
         self._deferred_train = DeferredStats()
+        self._last_cycle_t0: Optional[float] = None  # guardrail wall signal
         self._measured_forward_times = {}  # timing_split probes by batch shape
         self._seen_step_shapes = set()  # batch shapes whose step has compiled
         self._generate_fns: Dict[Tuple, Callable] = {}
@@ -913,6 +929,11 @@ class TPUBaseTrainer(BaseRLTrainer):
             loss = l_sum / num_mb
             stats = jax.tree_util.tree_map(lambda x: x / num_mb, s_sum)
 
+        if self.guardrails.enabled and self.guardrails.cfg.grad_norm_max > 0:
+            # the watchdog watches the global grad norm; computed in-graph
+            # (one reduction over the grads already in registers) and
+            # riding the existing async stats copy — no extra host sync
+            stats = dict(stats, **{"losses/grad_norm": optax.global_norm(grads)})
         guard = self.config.train.skip_nan_updates
         good = None
         if guard:
@@ -1092,6 +1113,14 @@ class TPUBaseTrainer(BaseRLTrainer):
             stats["learning_rate_group_0"] = float(
                 self.schedule(step - n_steps)
             )
+            # watchdog: the block's mean loss (+ grad norm / cycle wall
+            # when tracked) is THE health signal the escalation ladder
+            # acts on at the next safe point (_run_guardrail_ladder)
+            self.guardrails.observe_train(
+                step=step, loss=mean_loss,
+                grad_norm=stats.get("losses/grad_norm"),
+                wall=meta.get("cycle_s"),
+            )
             # one fused block counts as ONE bad step for the abort
             # counter: a single poisoned (skipped) step inside the scan
             # taints the block mean even when later steps recovered
@@ -1145,6 +1174,18 @@ class TPUBaseTrainer(BaseRLTrainer):
         # under the rollout phase, so this is a free read — and the
         # NaN-abort check runs before any new work is dispatched
         self._finish_train_stats()
+        if self.guardrails.enabled:
+            # pull the just-collected rollout stats early so KL/reward
+            # trips are seen BEFORE training on a poisoned batch (the
+            # tiny scalar copy was staged at rollout end and has landed
+            # by now; flush order matches the logging path, so tracker
+            # steps stay monotonic)
+            self._finish_rollout_stats()
+            if self._run_guardrail_ladder():
+                # the cycle was consumed by the action (batch requeued /
+                # state rolled back): skip training, let the epoch loop
+                # collect fresh experience
+                return results, False
 
         full, n = fused_src
         bs = self.config.train.batch_size
@@ -1177,6 +1218,15 @@ class TPUBaseTrainer(BaseRLTrainer):
         if self._fused_train_step is None:
             self._fused_train_step = self.make_fused_train_steps()
         device_full = self.place_batch(full)
+        if self.chaos is not None and self.chaos.consult("nan_loss"):
+            # chaos: NaN-poison THIS cycle's epoch batch (a fresh tree —
+            # the store's own arrays stay clean, so the burst ends when
+            # the schedule says it ends)
+            device_full = jax.tree_util.tree_map(
+                lambda x: jnp.full_like(x, jnp.nan)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                device_full,
+            )
         # cycle-level overlap: the next cycle's rollout generation is
         # dispatched NOW, ahead of the block — device FIFO samples it
         # first, and the host decodes+scores it while the block trains
@@ -1187,6 +1237,13 @@ class TPUBaseTrainer(BaseRLTrainer):
                 self.params, self.opt_state, device_full, jnp.asarray(perms)
             )
         dispatch_s = _time.time() - t0
+        if self.chaos is not None and self.chaos.consult("sigterm"):
+            # chaos: the preemption signal lands while the device is
+            # mid-fused-block (dispatch is async) — exactly the worst
+            # moment a scheduler reclaim can pick
+            import signal as _signal
+
+            os.kill(os.getpid(), _signal.SIGTERM)
         # ONE async device->host copy for loss + every scalar stat,
         # consumed at the next flush point (no blocking fetch here)
         prev = self.iter_count
@@ -1195,9 +1252,12 @@ class TPUBaseTrainer(BaseRLTrainer):
         staged.update(
             {k: stats[k] for k in stats if np.ndim(stats[k]) == 0}
         )
+        cycle_s = None if self._last_cycle_t0 is None else t0 - self._last_cycle_t0
+        self._last_cycle_t0 = t0
         self._deferred_train.stage(
             staged, step=self.iter_count,
-            meta={"t0": t0, "n_steps": n_steps, "dispatch_s": dispatch_s},
+            meta={"t0": t0, "n_steps": n_steps, "dispatch_s": dispatch_s,
+                  "cycle_s": cycle_s},
         )
         for _ in range(self.n_inner_epochs):
             self.post_backward_callback()
@@ -1297,66 +1357,173 @@ class TPUBaseTrainer(BaseRLTrainer):
         """tracker.log with retry/backoff; a tracker outage degrades to a
         logged error, never a dead run (metrics are droppable, the
         training state is not). After _TRACKER_CIRCUIT_LIMIT consecutive
-        exhausted-retry failures the circuit opens: one un-retried
-        attempt per step (so a recovered backend resumes logging) with
-        failures swallowed silently."""
+        exhausted-retry failures the circuit opens (resilient.
+        CircuitBreaker with reset_timeout=0): one un-retried attempt per
+        step — so a recovered backend resumes logging — with failures
+        swallowed silently."""
         train = self.config.train
-        if self._tracker_failures >= self._TRACKER_CIRCUIT_LIMIT:
-            try:
-                self.tracker.log(stats, step=step)
-            except Exception:
-                return
-            self._tracker_failures = 0
-            logger.info("tracker recovered; resuming retried logging")
+        probing = not self._tracker_breaker.is_closed
+        if not self._tracker_breaker.allow():  # unreachable at reset=0
             return
         try:
-            retry_call(
-                self.tracker.log, stats, step=step,
-                retries=train.external_retries,
-                base_delay=train.retry_base_delay,
-                description="tracker.log",
-            )
-            self._tracker_failures = 0
+            if probing:
+                self.tracker.log(stats, step=step)
+            else:
+                retry_call(
+                    self.tracker.log, stats, step=step,
+                    retries=train.external_retries,
+                    base_delay=train.retry_base_delay,
+                    description="tracker.log",
+                )
         except Exception as e:
-            self._tracker_failures += 1
-            logger.error(
-                "tracker.log failed after retries; continuing without "
-                "logging step %d: %s%s", step, e,
-                " (circuit open: further steps attempt once, no backoff)"
-                if self._tracker_failures >= self._TRACKER_CIRCUIT_LIMIT
-                else "",
-            )
+            self._tracker_breaker.record_failure()
+            if not probing:
+                logger.error(
+                    "tracker.log failed after retries; continuing without "
+                    "logging step %d: %s%s", step, e,
+                    " (circuit open: further steps attempt once, no backoff)"
+                    if not self._tracker_breaker.is_closed else "",
+                )
+            return
+        if probing:
+            logger.info("tracker recovered; resuming retried logging")
+        self._tracker_breaker.record_success()
+
+    def _reward_fallback_value(self) -> float:
+        """Value the fallback reward substitutes per sample when the
+        reward service is down and `resilient_io.fallback_reward:
+        hold_mean` is configured. PPO overrides with its running-moments
+        mean; the base has no reward history, so 0 (neutral after
+        running-moment scaling)."""
+        return 0.0
+
+    def _chaos_wrapped_reward(self, **kwargs):
+        """reward_fn with the chaos fault sites threaded around it (the
+        object the ResilientCaller retries — injected timeouts/errors
+        exercise the real deadline/backoff/breaker path)."""
+        if self.chaos is not None:
+            self.chaos.reward_fault_pre()
+        out = self.reward_fn(**kwargs)
+        if self.chaos is not None:
+            out = self.chaos.reward_fault_post(out)
+        return out
+
+    def _build_reward_caller(self) -> ResilientCaller:
+        """Compose the hardened reward path from train.resilient_io:
+        per-attempt deadline, retry/backoff/jitter, circuit breaker and
+        fallback. With the default (empty) config this reduces exactly
+        to PR 1 semantics: plain retries, final failure propagates."""
+        train = self.config.train
+        rcfg = self._resilient_cfg
+        breaker = None
+        fallback = None
+        if rcfg.has_fallback:
+            if rcfg.breaker_threshold > 0:
+                breaker = CircuitBreaker(
+                    failure_threshold=rcfg.breaker_threshold,
+                    reset_timeout=rcfg.breaker_reset_s,
+                )
+
+            def fallback(exc, kwargs):
+                n = len(kwargs.get("samples") or [])
+                v = (
+                    self._reward_fallback_value()
+                    if rcfg.fallback_reward == "hold_mean"
+                    else float(rcfg.fallback_reward)
+                )
+                return [v] * n
+
+        return ResilientCaller(
+            fn=self._chaos_wrapped_reward,
+            description="reward_fn",
+            timeout=rcfg.reward_timeout,
+            retries=(
+                rcfg.retries
+                if rcfg.retries is not None else train.external_retries
+            ),
+            base_delay=(
+                rcfg.base_delay
+                if rcfg.base_delay is not None else train.retry_base_delay
+            ),
+            max_delay=rcfg.max_delay,
+            jitter=rcfg.jitter,
+            breaker=breaker,
+            fallback=fallback,
+        )
 
     def _call_reward_fn(self, **kwargs):
-        """reward_fn with retry/backoff. Unlike the tracker, rewards are
-        load-bearing: the final failure propagates (the preemption path
-        still gets a chance to checkpoint via learn()'s finally)."""
-        train = self.config.train
-        return retry_call(
-            self.reward_fn,
-            retries=train.external_retries,
-            base_delay=train.retry_base_delay,
-            description="reward_fn",
-            **kwargs,
-        )
+        """reward_fn through the resilient caller. Without a configured
+        fallback, rewards stay load-bearing: the final failure
+        propagates (the preemption path still gets a chance to
+        checkpoint via learn()'s finally). With one, a slow or dead
+        reward service degrades the run instead of hanging or killing
+        it — the overlapped rollout pipeline keeps moving."""
+        if self._reward_caller is None:
+            self._reward_caller = self._build_reward_caller()
+        return self._reward_caller(**kwargs)
 
     def _checkpoint_tag(self) -> str:
         return f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
 
-    def _save_checkpoint(self, name: str) -> None:
+    # consecutive failed checkpoint commits tolerated before the failure
+    # propagates: transient shared-storage flakes must not kill a run
+    # whose training state is intact (the next interval retries), but a
+    # permanently unwritable store should not fail silently forever
+    _CKPT_FAILURE_LIMIT = 3
+
+    def _save_checkpoint(self, name: str, final: bool = False) -> None:
         """Commit a full checkpoint (state + deploy export) atomically
-        under checkpoint_dir/<name> via the CheckpointManager."""
+        under checkpoint_dir/<name> via the CheckpointManager.
+        ``final=True`` marks an exit-path save (preemption / epoch
+        exhaustion): a commit failure there propagates immediately —
+        "the next interval retries" does not exist on the way out.
+
+        Health-gated: with guardrails enabled, a commit is SKIPPED while
+        the watchdog considers the run unhealthy — with async metrics
+        the NaN signal lands one cycle late, and an ungated boundary
+        right behind a bad block would publish a poisoned "last good
+        checkpoint", the exact state auto-rollback restores. The skip
+        decision is process 0's view broadcast to every host (commit()
+        is collective)."""
+        if self.guardrails.enabled and mh.broadcast_flag(
+            not self.guardrails.commit_ok()
+        ):
+            logger.warning(
+                "guardrails: run unhealthy (%s) — skipping checkpoint "
+                "commit %r so the last good checkpoint stays good",
+                self.guardrails.state_summary(), name,
+            )
+            return
         logger.info(
             "Saving checkpoint into %s",
             os.path.join(self.config.train.checkpoint_dir, name),
         )
 
         def write(tmp_dir: str) -> None:
+            if self.chaos is not None and self.chaos.consult("ckpt_fail"):
+                raise ChaosFault("chaos: injected checkpoint write failure")
             if self.config.train.save_optimizer:
                 self.save(tmp_dir)
             self.save_pretrained(os.path.join(tmp_dir, "hf_model"))
 
-        self.ckpt_manager.commit(name, write)
+        try:
+            self.ckpt_manager.commit(name, write)
+        except Exception as e:
+            # the manager's protocol guarantees a failed commit is never
+            # discoverable (torn tmp_ dir only) and aborts consistently
+            # on every host, so training state is intact — log, count,
+            # and continue; the next interval (or the final save) retries
+            self._ckpt_commit_failures += 1
+            if final or self._ckpt_commit_failures >= self._CKPT_FAILURE_LIMIT:
+                raise
+            logger.error(
+                "checkpoint commit %r failed (%d/%d consecutive before "
+                "the failure propagates): %s — training continues; the "
+                "next checkpoint interval retries", name,
+                self._ckpt_commit_failures, self._CKPT_FAILURE_LIMIT, e,
+            )
+            return
+        self._ckpt_commit_failures = 0
 
     def _commit_final_checkpoint(self, reason: str) -> None:
         """Commit the current step's checkpoint before the run exits —
@@ -1381,7 +1548,7 @@ class TPUBaseTrainer(BaseRLTrainer):
                 self.iter_count,
             )
             return
-        self._save_checkpoint(tag)
+        self._save_checkpoint(tag, final=True)
         logger.info(
             "%s: checkpoint committed at step %d", reason, self.iter_count
         )
@@ -1441,13 +1608,165 @@ class TPUBaseTrainer(BaseRLTrainer):
             loss, self.iter_count, self._bad_steps,
             self.config.train.max_bad_steps,
         )
+        corrective = self.guardrails.enabled and any(
+            a != "log" for a in self.guardrails.cfg.ladder
+        )
         if self._bad_steps >= self.config.train.max_bad_steps:
+            if corrective:
+                # the watchdog owns escalation now: its ladder decides
+                # whether this becomes a requeue, an LR cut, a rollback
+                # or an abort — raising here would pre-empt a recoverable
+                # intervention with a run-fatal one. A log-only ladder
+                # cannot intervene, so the legacy abort stays the
+                # backstop there (otherwise a persistent NaN would train
+                # forever with every checkpoint commit health-gated off).
+                logger.warning(
+                    "%d consecutive non-finite losses (max_bad_steps=%d); "
+                    "deferring the abort to the guardrails escalation "
+                    "ladder", self._bad_steps,
+                    self.config.train.max_bad_steps,
+                )
+                return True
             raise RuntimeError(
                 f"aborting: {self._bad_steps} consecutive non-finite "
                 f"losses (train.max_bad_steps={self.config.train.max_bad_steps}); "
                 "the model state has diverged — restart from the last "
                 "committed checkpoint with a lower lr / tighter clipping"
             )
+        return True
+
+    # -- guardrails (divergence watchdog) -------------------------------
+
+    def _run_guardrail_ladder(self) -> bool:
+        """Consume this cycle's watchdog verdict and execute the ladder
+        action. Returns True when the cycle must be skipped (the batch
+        was requeued / state was rolled back); raises on abort. Called
+        once per cycle (fused block / optimizer step) at a point where
+        no new device work has been dispatched."""
+        if mh.is_multihost():
+            # lockstep: most signals derive from globally-reduced stats
+            # and trip identically everywhere, but per-cycle wall time
+            # is host-LOCAL by design (a stuck host trips it alone) —
+            # and the resulting actions are collective (rollback's
+            # allgather/load) or data-divergent (requeue's stream
+            # rewind). Agree on "anyone tripped" every cycle so all
+            # ladders advance together; one any_flag per cycle is noise
+            # next to the rollout phase's collectives.
+            peer = mh.any_flag(self.guardrails.has_pending_trips)
+            if peer and not self.guardrails.has_pending_trips:
+                self.guardrails.peer_trip()
+        action = self.guardrails.pending_action()
+        if action is None or action == "log":
+            return False  # pending_action already logged the trip
+        if action == "requeue":
+            return self._requeue_poisoned_batch()
+        if action == "lr_cut":
+            self._apply_lr_cut(self.guardrails.cfg.lr_cut_factor)
+            return False
+        if action == "rollback":
+            return self._rollback_to_last_good()
+        # abort: coordinated across hosts via any_flag — every host
+        # computes the same verdict from the same global stats, but the
+        # agreement makes a pathological divergence (one host seeing
+        # different numbers) abort the pod instead of deadlocking it
+        if mh.any_flag(action == "abort"):
+            raise RuntimeError(
+                "guardrails abort: escalation ladder exhausted "
+                f"({self.guardrails.state_summary()}); the run did not "
+                "recover — relaunch resumes from the last good checkpoint"
+            )
+        return False
+
+    def _requeue_poisoned_batch(self) -> bool:
+        """Hook: discard the current (poisoned) training batch and
+        arrange for its source data to be replayed. Base trainers have
+        no requeue-able store; PPO discards the rollout store and
+        rewinds the prompt cursor."""
+        return False
+
+    def _reset_data_stream(self) -> None:
+        """Hook: rebuild the training data stream from position zero so
+        a subsequent load()'s cursor restore can fast-forward to an
+        EARLIER position than the live one (streams only advance). PPO
+        rebuilds its prompt iterator from the retained pipeline."""
+
+    def _apply_lr_cut(self, factor: float) -> None:
+        """Multiply the whole LR schedule by ``factor`` (cumulative in
+        self._lr_scale, persisted in state.json). The optimizer is
+        rebuilt around the scaled schedule; optimizer STATE carries over
+        unchanged (same transform structure), and the jitted steps are
+        dropped so the next dispatch traces the new schedule in."""
+        self._lr_scale *= float(factor)
+        self._rebuild_optimizer()
+        logger.warning(
+            "guardrails: learning rate cut by %g (cumulative scale %g)",
+            factor, self._lr_scale,
+        )
+
+    def _assemble_optimizer(self, opt_cfg, sched_cfg):
+        """(tx, schedule) from configs, with the freeze mask chained in
+        — the ONE place the optimizer is assembled (__init__ and the
+        guardrail rebuild must never drift apart)."""
+        tx, schedule = build_optimizer(opt_cfg, sched_cfg)
+        if hasattr(tx, "fused_apply"):
+            # fused optimizers write params directly (no updates tree to
+            # chain a mask into); _step_update streams the mask through
+            # fused_apply instead
+            pass
+        elif self._update_mask is not None:
+            tx = optax.chain(tx, _mask_updates(self._update_mask))
+        return tx, schedule
+
+    def _rebuild_optimizer(self) -> None:
+        okw = dict(self.config.optimizer.kwargs)
+        skw = dict(self.config.scheduler.kwargs)
+        if self._lr_scale != 1.0:
+            okw["lr"] = okw["lr"] * self._lr_scale
+            for k in ("eta_min", "final_lr"):
+                # scale the schedule floor too, so the cut scales the
+                # whole curve instead of pinning it to the old floor
+                if k in skw:
+                    skw[k] = skw[k] * self._lr_scale
+        self.tx, self.schedule = self._assemble_optimizer(
+            dataclasses.replace(self.config.optimizer, kwargs=okw),
+            dataclasses.replace(self.config.scheduler, kwargs=skw),
+        )
+        self._train_step = None
+        self._fused_train_step = None
+
+    def _rollback_to_last_good(self) -> bool:
+        """Auto-rollback: restore the newest committed resumable
+        checkpoint — params, opt state, iter_count, PRNG key, KL
+        controller / running moments and the prompt cursor (untrained
+        prompts replay) — exactly as a process relaunch would, but
+        in-process, losing at most checkpoint_interval steps. Commits
+        are health-gated, so "latest resumable" is also "last good"."""
+        path = self.ckpt_manager.latest_resumable()
+        if mh.is_multihost():
+            # stale shared-filesystem views must not pick different
+            # checkpoints per host: process 0's discovery wins
+            path = mh.allgather_object(path)[0]
+        if path is None:
+            logger.error(
+                "guardrails: rollback requested but no resumable "
+                "checkpoint exists under %s — continuing without rollback "
+                "(the ladder will escalate if the run stays unhealthy)",
+                self.config.train.checkpoint_dir,
+            )
+            return False
+        logger.warning(
+            "guardrails: auto-rollback to %s (discarding the diverged "
+            "live state at step %d)", path, self.iter_count,
+        )
+        self._abandon_prefetch()
+        self._reset_data_stream()
+        self.load(path)
+        # the restored arrays are fresh buffers: drop the jitted steps
+        # whose output shardings were pinned to the donated originals
+        self._train_step = None
+        self._fused_train_step = None
+        self._bad_steps = 0
+        self.guardrails.notify_rollback(self.iter_count)
         return True
 
     def learn(self):
@@ -1550,6 +1869,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             # a still-deferred fused block from an earlier epoch must log
             # before this loop emits newer step indices
             self._finish_train_stats()
+            guard_break = False  # ladder consumed this epoch's data
             for _ in range(self.n_inner_epochs):
                 train_dataloader = self.create_train_dataloader()
                 for batch in train_dataloader:
@@ -1561,6 +1881,10 @@ class TPUBaseTrainer(BaseRLTrainer):
                             jax.profiler.start_trace(self.config.train.profile_dir)
                         elif self.iter_count == self.config.train.profile_stop:
                             jax.profiler.stop_trace()
+                    if self._train_step is None:
+                        # a guardrail lr_cut dropped the jitted step
+                        # mid-epoch (the new schedule must trace in)
+                        self._train_step = self.make_train_step()
                     device_batch = self.place_batch(batch)
                     forward_time = clock.tick()
                     with self.mesh:
@@ -1569,7 +1893,22 @@ class TPUBaseTrainer(BaseRLTrainer):
                         )
                     loss = to_scalar(loss)  # sync point: step is done
                     step_time = clock.tick()
-                    if self._guard_bad_loss(loss):
+                    bad = self._guard_bad_loss(loss)
+                    if self.guardrails.enabled:
+                        # unfused loop: one step = one watchdog cycle
+                        self.guardrails.observe_train(
+                            step=self.iter_count, loss=loss,
+                            grad_norm=(
+                                to_scalar(stats["losses/grad_norm"])
+                                if "losses/grad_norm" in stats else None
+                            ),
+                        )
+                        if self._run_guardrail_ladder():
+                            # rollback/requeue: this dataloader's source
+                            # is gone — restart from the epoch top
+                            guard_break = True
+                            break
+                    if bad:
                         # poisoned update was skipped device-side: the
                         # step index does not advance and nothing is
                         # logged for it (the next good step keeps the
@@ -1628,6 +1967,8 @@ class TPUBaseTrainer(BaseRLTrainer):
 
                     if self.iter_count >= self.total_steps:
                         return results
+                if guard_break:
+                    break
                 self.post_backward_callback()
             self.post_epoch_callback()
         # epoch exhaustion can end BELOW total_steps (a NaN-skipped step
@@ -1697,6 +2038,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                 ),
                 "nth_evaluation": self.nth_evaluation,
                 "rng_key": self._pack_rng(),
+                # cumulative guardrail LR-cut factor: a resumed (or
+                # rolled-back) run re-applies the cut schedule exactly
+                "lr_scale": self._lr_scale,
                 # run-derived budget (PPO: min of config and store size):
                 # lets a same-config relaunch of a COMPLETED run bail
                 # before paying a rollout. A preemption-abandoned rollout
@@ -1728,11 +2072,40 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
         ckptr = ocp.PyTreeCheckpointer()
-        restored = ckptr.restore(
-            os.path.join(directory, "state"), item=self._state_tree()
-        )
-        self.params = restored["params"]
-        self.opt_state = restored["opt_state"]
+        template = self._state_tree()
+        restored = ckptr.restore(os.path.join(directory, "state"), item=template)
+
+        # Re-materialize the restored leaves as fresh XLA-ALLOCATED
+        # buffers on the live arrays' shardings. The train step DONATES
+        # params/opt_state, and restored arrays can be host-memory
+        # backed (orbax restores to numpy; device_put of host memory
+        # zero-copies on CPU) — donating such a buffer hands XLA memory
+        # it does not own, observed under the chaos harness as
+        # post-rollback NaN params and glibc "corrupted double-linked
+        # list" aborts. A jitted identity copy cannot alias its
+        # (non-donated) inputs, so its outputs are genuinely
+        # XLA-allocated; one extra state copy per resume/rollback is
+        # the price.
+        live = {"params": template["params"], "opt_state": template["opt_state"]}
+        raw = {"params": restored["params"], "opt_state": restored["opt_state"]}
+
+        def placed(tmpl, value):
+            if isinstance(tmpl, jax.Array):
+                return jax.device_put(np.asarray(value), tmpl.sharding)
+            return value
+
+        with self.mesh:
+            staged = jax.tree_util.tree_map(placed, live, raw)
+            shardings = jax.tree_util.tree_map(
+                lambda t, v: t.sharding if isinstance(t, jax.Array) else None,
+                live, raw,
+            )
+            restored_state = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t),
+                out_shardings=shardings,
+            )(staged)
+        self.params = restored_state["params"]
+        self.opt_state = restored_state["opt_state"]
         state_fp = os.path.join(directory, "state.json")
         if not os.path.exists(state_fp):
             # a corrupt/legacy checkpoint must not masquerade as a fresh
@@ -1751,6 +2124,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         best = state.get("best_reward")
         self.best_reward = float(best) if best is not None else -float("inf")
         self.nth_evaluation = state.get("nth_evaluation", 0)
+        scale = float(state.get("lr_scale", 1.0))
+        if scale != self._lr_scale:
+            self._lr_scale = scale
+            self._rebuild_optimizer()
         if state.get("rng_key") is not None:
             self._unpack_rng(state["rng_key"])
         self._restored_total_steps = state.get("total_steps")

@@ -458,6 +458,9 @@ class TPUPPOTrainer(TPUBaseTrainer):
             if self._prefetched_gen is not None
             else self._prompt_batches_consumed
         )
+        # guardrail `requeue` rewinds to here: the whole cycle's prompts
+        # replay when its rollout batch turns out poisoned
+        self._cycle_cursor_start = prompt_cursor_start
         self._finish_rollout_stats()  # flush any deferred previous-cycle stats
         clock = Clock()
         n_collected = 0
@@ -676,8 +679,18 @@ class TPUPPOTrainer(TPUBaseTrainer):
             if self.ref_mean is None:
                 self.ref_mean = float(score_sums.mean())
                 self.ref_std = float(score_sums.std())
-            self.running_moments, scores_mean, scores_std = running_moments_update(
+            new_moments, scores_mean, scores_std = running_moments_update(
                 self.running_moments, score_sums
+            )
+            # a NaN-poisoned chunk must not permanently poison the
+            # running reward moments (they scale every later reward and
+            # persist across checkpoints): keep the pre-chunk moments
+            # when the chunk's sums are non-finite. The chunk's OWN
+            # stats still report the poison, so the guardrails see it.
+            keep = jnp.all(jnp.isfinite(score_sums))
+            self.running_moments = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(keep, n, o),
+                new_moments, self.running_moments,
             )
             # stats stay DEVICE scalars until the single packed fetch at
             # the end of make_experience (each host read costs a full
@@ -820,10 +833,19 @@ class TPUPPOTrainer(TPUBaseTrainer):
 
     def _finish_rollout_stats(self) -> None:
         """Materialize + log the deferred make_experience stats (sets
-        self.mean_kl for the KL controller). Idempotent."""
+        self.mean_kl for the KL controller; feeds the guardrails the
+        rollout-side health signals). Idempotent."""
         for stats, step, kl_ctl_value in self._deferred_rollout.flush():
             stats["kl_ctl_value"] = kl_ctl_value
             self.mean_kl = stats["policy/sqrt_kl"] ** 2
+            if self.guardrails.enabled:
+                self.guardrails.observe_rollout(
+                    kl=self.mean_kl,
+                    kl_target=getattr(self.kl_ctl, "target", None),
+                    reward_mean=stats.get("rollout_scores/mean"),
+                    running_mean=stats.get("rollout_scores/running_mean"),
+                    running_std=stats.get("rollout_scores/running_std"),
+                )
             self._tracker_log(stats, step=step)
 
     # -- loop hooks ------------------------------------------------------
@@ -843,11 +865,23 @@ class TPUPPOTrainer(TPUBaseTrainer):
             f.write(json.dumps(config.to_dict(), indent=2))
 
     def add_prompt_pipeline(self, pipeline) -> None:
+        # the pipeline is retained so guardrail interventions (requeue /
+        # rollback) can rebuild the stream and replay untrained prompts
+        self._prompt_pipeline = pipeline
+        self._build_prompt_iterator()
+        self._fast_forward_prompts()
+
+    def _build_prompt_iterator(self) -> None:
+        """(Re)create the prompt stream from position zero. The loader
+        draws its shuffles from the config seed, so a rebuild replays
+        the exact chunk sequence — fast-forwarding then restores any
+        cursor, including one BEHIND the live position (streams only
+        advance; rewind = rebuild + replay)."""
         # multi-host: each process iterates its own strided slice of the
         # prompts at chunk_size/P rows; generation reassembles the global
         # chunk (the reference scatters from rank 0 instead —
         # accelerate_ppo_trainer.py:292-341)
-        pipeline = mh.shard_pipeline(pipeline, self.mesh)
+        pipeline = mh.shard_pipeline(self._prompt_pipeline, self.mesh)
         chunk = max(self.config.method.chunk_size // mh.data_group_count(self.mesh), 1)
         # drop_last keeps chunk shapes static: one compiled sampler
         loader = pipeline.create_loader(
@@ -859,7 +893,52 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 len(pipeline), shuffle=True, seed=self.config.train.seed
             )
         self.prompt_iterator = infinite_loader(loader)
-        self._fast_forward_prompts()
+        self._prompt_batches_consumed = 0
+
+    def _rewind_prompt_stream(self, cursor: int) -> None:
+        """Rebuild the stream and advance it so the NEXT pull is chunk
+        ``cursor`` — the replay path for prompts whose rollouts never
+        trained (host-side batch pulls only: no generation, no scoring)."""
+        self._build_prompt_iterator()
+        for _ in range(cursor):
+            next(self.prompt_iterator)
+        self._prompt_batches_consumed = cursor
+
+    def _reset_data_stream(self) -> None:
+        """Guardrail-rollback hook: stream back to zero; the subsequent
+        load() fast-forwards to the checkpoint's saved cursor."""
+        if getattr(self, "_prompt_pipeline", None) is None:
+            return
+        self._resume_prompt_cursor = 0
+        self._build_prompt_iterator()
+
+    def _requeue_poisoned_batch(self) -> bool:
+        """Guardrail `requeue` rung: drop the poisoned rollout store and
+        rewind the prompt stream to the cycle start, so the same prompts
+        are re-collected with the CURRENT policy (their poisoned
+        rollouts never train; recomputed importance ratios make the
+        replay sound — IMPACT, arXiv:1912.00167)."""
+        start = getattr(self, "_cycle_cursor_start", None)
+        if len(self.store) == 0 or start is None:
+            return False
+        self._abandon_prefetch()
+        self.store.clear_history()
+        self._rewind_prompt_stream(start)
+        logger.warning(
+            "guardrails: discarded the poisoned rollout batch; prompt "
+            "stream rewound to chunk %d for replay", start,
+        )
+        return True
+
+    def _reward_fallback_value(self) -> float:
+        """`resilient_io.fallback_reward: hold_mean` — substitute the
+        running-moments mean while the reward service is down, keeping
+        the reward distribution stationary instead of injecting zeros."""
+        try:
+            v = float(np.asarray(self.running_moments.mean))
+        except Exception:
+            return 0.0
+        return v if np.isfinite(v) else 0.0
 
     def _next_prompt_batch(self) -> PromptBatch:
         batch = next(self.prompt_iterator)

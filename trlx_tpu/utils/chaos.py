@@ -1,0 +1,189 @@
+"""Chaos-injection harness: deterministic, seed-driven fault schedules.
+
+Proves the guardrails + resilient-I/O story end to end: a chaos config
+(``train.chaos``) injects the failure modes a long preemptible-pod run
+actually sees — NaN bursts in losses/rewards, reward-service timeouts
+and exceptions, checkpoint-write failures, SIGTERM mid-fused-block — at
+deterministic points, so `learn()`-under-chaos is a reproducible test,
+not a flake generator.
+
+Fault sites (each has its own monotonically increasing consult counter;
+the trainers consult at fixed points, so a schedule entry pins a fault
+to an exact cycle/call):
+
+  nan_loss        poison the fused epoch batch (every float leaf -> NaN)
+                  for one cycle; consulted once per fused block.
+  sigterm         raise SIGTERM in this process right after the fused
+                  block is dispatched (the signal lands while the device
+                  is mid-block); consulted once per fused block.
+  nan_reward      replace the reward function's outputs with NaN.
+  reward_timeout  sleep ``reward_delay`` seconds inside the reward call
+                  (trips the resilient deadline when one is configured).
+  reward_error    raise ``ChaosFault`` from the reward call.
+                  (the three reward sites are consulted once per
+                  reward_fn invocation, retries included)
+  ckpt_fail       raise ``ChaosFault`` from the checkpoint write
+                  function; consulted once per commit attempt.
+
+Schedule entries select by count: ``{"fault": "nan_loss", "at": 2}``
+fires on the 2nd consult (1-based), ``{"fault": ..., "at": 2, "span": 3}``
+on consults 2..4, and ``{"fault": ..., "every": 5}`` on every 5th.
+Probabilistic mode ``{"fault": ..., "p": 0.1}`` draws from a
+``random.Random(seed)`` stream — deterministic given the seed and the
+consult order (which is fixed by the trainer's control flow).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.resilient import ChaosFault
+
+logger = logging.get_logger(__name__)
+
+FAULT_SITES = (
+    "nan_loss",
+    "sigterm",
+    "nan_reward",
+    "reward_timeout",
+    "reward_error",
+    "ckpt_fail",
+)
+
+
+@dataclass
+class _Entry:
+    fault: str
+    at: Optional[int] = None
+    span: int = 1
+    every: Optional[int] = None
+    p: Optional[float] = None
+
+    def matches(self, count: int, rng: random.Random) -> bool:
+        # the p draw happens FIRST and unconditionally on every consult
+        # of a probabilistic entry, so the stream position depends only
+        # on consult order — never on whether at/every (on this entry or
+        # a sibling) happened to match
+        p_hit = self.p is not None and rng.random() < self.p
+        if self.at is not None and self.at <= count < self.at + self.span:
+            return True
+        if self.every is not None and count % self.every == 0:
+            return True
+        return p_hit
+
+
+class ChaosMonkey:
+    """Evaluates a fault schedule against per-site consult counters."""
+
+    def __init__(self, config: Optional[Dict[str, Any]]):
+        config = dict(config or {})
+        known = {"seed", "faults", "reward_delay"}
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f"train.chaos: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        self.seed = int(config.get("seed", 0))
+        self.reward_delay = float(config.get("reward_delay", 0.2))
+        self._entries: Dict[str, List[_Entry]] = {s: [] for s in FAULT_SITES}
+        self._counts: Dict[str, int] = {s: 0 for s in FAULT_SITES}
+        self._rngs: Dict[str, random.Random] = {
+            # one stream per site, derived from the master seed, so
+            # adding a schedule entry for one site cannot shift another
+            # site's draws
+            s: random.Random(self.seed * 1_000_003 + i)
+            for i, s in enumerate(FAULT_SITES)
+        }
+        self.fired: List[Dict[str, Any]] = []
+        # a deadline-abandoned reward worker (resilient.call_with_deadline
+        # cannot kill its thread) may still consult reward sites while
+        # the main thread's retry runs its own: the lock keeps the
+        # counters/fired list structurally sound. NOTE: schedules that
+        # mix `reward_timeout` with other reward-site entries can still
+        # interleave consult ORDER with abandoned workers — pin such
+        # combinations to disjoint call ranges if exact counts matter.
+        self._lock = threading.Lock()
+        for raw in config.get("faults", []):
+            raw = dict(raw)
+            fault = raw.pop("fault", None)
+            if fault not in FAULT_SITES:
+                raise ValueError(
+                    f"train.chaos.faults: unknown fault {fault!r} "
+                    f"(choose from {list(FAULT_SITES)})"
+                )
+            bad = set(raw) - {"at", "span", "every", "p"}
+            if bad:
+                raise ValueError(
+                    f"train.chaos.faults[{fault}]: unknown keys {sorted(bad)}"
+                )
+            entry = _Entry(fault=fault, **raw)
+            if entry.at is None and entry.every is None and entry.p is None:
+                raise ValueError(
+                    f"train.chaos.faults[{fault}]: one of at/every/p required"
+                )
+            self._entries[fault].append(entry)
+
+    def consult(self, site: str) -> bool:
+        """Advance ``site``'s counter and report whether a fault fires
+        at this point. Callers consult at FIXED control-flow points —
+        conditional consults would shift later counts and break the
+        schedule's determinism."""
+        with self._lock:
+            self._counts[site] += 1
+            count = self._counts[site]
+            rng = self._rngs[site]
+            # evaluate EVERY entry (no any() short-circuit): each
+            # probabilistic entry's stream must advance exactly once per
+            # consult regardless of sibling matches
+            hit = any([e.matches(count, rng) for e in self._entries[site]])
+            if hit:
+                self.fired.append({"fault": site, "count": count})
+        if hit:
+            logger.warning("chaos: injecting %s (consult #%d)", site, count)
+        return hit
+
+    def counts(self) -> Dict[str, int]:
+        return dict(self._counts)
+
+    # -- fault bodies (shared so trainer call sites stay one-liners) -----
+
+    def reward_fault_pre(
+        self, sleep: Callable[[float], None] = time.sleep
+    ) -> None:
+        """Consulted at the top of every reward call (retries included):
+        raises for ``reward_error``, sleeps ``reward_delay`` for
+        ``reward_timeout`` (tripping a configured resilient deadline)."""
+        if self.consult("reward_error"):
+            raise ChaosFault("chaos: injected reward exception")
+        if self.consult("reward_timeout"):
+            sleep(self.reward_delay)
+
+    def reward_fault_post(self, out):
+        """Consulted with the reward call's result: substitutes NaNs for
+        ``nan_reward``, else passes the result through."""
+        if self.consult("nan_reward"):
+            try:
+                n = len(out)
+            except TypeError:
+                n = 1
+            return [float("nan")] * n
+        return out
+
+
+def build_chaos(train_config) -> Optional[ChaosMonkey]:
+    """TrainConfig -> monkey, or None when ``train.chaos`` is unset."""
+    cfg = getattr(train_config, "chaos", None)
+    if not cfg:
+        return None
+    monkey = ChaosMonkey(cfg)
+    logger.warning(
+        "chaos harness ARMED (seed=%d): %s", monkey.seed,
+        [e.__dict__ for site in monkey._entries.values() for e in site],
+    )
+    return monkey

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import json
 import os
-import random
 import re
 import shutil
 import signal
@@ -45,11 +44,6 @@ COMMIT_MARKER = "COMMIT"
 _TMP_PREFIX = "tmp_"
 _STEP_RE = re.compile(r"^checkpoint_(\d+)$")
 
-# backoff jitter must come from an OS-entropy RNG, NOT the global
-# `random` module: set_seed() seeds that globally with the (shared)
-# config seed, which would make every host of a pod back off in lockstep
-# — the exact synchronized herd the jitter exists to prevent
-_JITTER_RNG = random.Random()
 
 
 def _fsync_path(path: str) -> None:
@@ -350,27 +344,16 @@ def retry_call(
     description: Optional[str] = None,
     **kwargs,
 ):
-    """Call ``fn(*args, **kwargs)``, retrying transient failures with
-    exponential backoff (doubling from ``base_delay``, capped at
-    ``max_delay``, +-25% jitter so a fleet of preempted workers doesn't
-    thundering-herd a recovering tracker/reward service). ``retries`` is
-    the number of RE-tries after the first attempt; the final failure
-    re-raises — the caller decides whether the call is load-bearing
-    (reward_fn: yes) or droppable (tracker.log: catch and continue)."""
-    what = description or getattr(fn, "__name__", repr(fn))
-    for attempt in range(retries + 1):
-        try:
-            return fn(*args, **kwargs)
-        except Exception as e:
-            if attempt >= retries:
-                logger.error(
-                    "%s failed after %d attempts: %s", what, attempt + 1, e
-                )
-                raise
-            delay = min(base_delay * (2 ** attempt), max_delay)
-            delay *= 1.0 + _JITTER_RNG.uniform(-0.25, 0.25)
-            logger.warning(
-                "%s failed (attempt %d/%d): %s — retrying in %.2fs",
-                what, attempt + 1, retries + 1, e, delay,
-            )
-            time.sleep(max(delay, 0.0))
+    """Back-compat alias: the canonical implementation (injectable
+    clock/sleep/jitter-RNG, optional per-attempt deadline, the circuit
+    breaker and the fallback composition) lives in
+    ``trlx_tpu.utils.resilient`` — same semantics as the original PR 1
+    helper (doubling backoff from ``base_delay``, capped at
+    ``max_delay``, +-25% OS-entropy jitter; the final failure
+    re-raises)."""
+    from trlx_tpu.utils import resilient
+
+    return resilient.retry_call(
+        fn, *args, retries=retries, base_delay=base_delay,
+        max_delay=max_delay, description=description, **kwargs,
+    )

@@ -1,0 +1,331 @@
+"""Resilient external-call plumbing: deadline + retry/backoff/jitter +
+circuit breaker + optional fallback.
+
+The train loop talks to exactly two things it does not control — the
+tracker backend and the user reward function — and on a pod every
+blocking second of theirs is a pod-second. PR 1 gave both calls plain
+retry/backoff (``checkpointing.retry_call``); this module generalizes
+that into composable pieces the guardrails subsystem and the trainers
+share:
+
+  retry_call        exponential backoff with cap + jitter; the clock,
+                    sleep and jitter RNG are injectable so tier-1 tests
+                    never really sleep (fake-clock contract).
+  call_with_deadline run a callable in a worker thread and abandon it
+                    past ``timeout`` (``DeadlineExceeded``). The thread
+                    cannot be killed — the abandoned call keeps running
+                    to completion and its result is dropped — so this is
+                    for I/O-ish calls (a reward service RPC), not for
+                    calls that mutate trainer state.
+  CircuitBreaker    closed -> open after N consecutive failures; open
+                    rejects until ``reset_timeout`` elapses, then allows
+                    one half-open probe (success closes, failure
+                    re-opens). ``reset_timeout=0`` degrades to "one
+                    un-retried probe per call" — the tracker circuit
+                    from PR 1, now reusable.
+  ResilientCaller   the composition: breaker gate -> (deadline'd,
+                    retried) call -> fallback. A slow or dead reward
+                    service then degrades the run (fallback reward,
+                    e.g. the running-moments mean) instead of hanging
+                    the overlapped rollout prefetch.
+
+Everything here is host-side and dependency-free (no jax import at
+module scope), so unit tests run in microseconds.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+# Jitter must come from an OS-entropy RNG, NOT the globally seeded
+# `random` module: set_seed() seeds that with the (shared) config seed,
+# which would make every host of a pod back off in lockstep — the
+# synchronized herd the jitter exists to prevent. Tests inject their own.
+_JITTER_RNG = random.Random()
+
+
+class DeadlineExceeded(TimeoutError):
+    """A deadline'd call did not return within its timeout."""
+
+
+class CircuitOpenError(RuntimeError):
+    """The circuit breaker rejected the call without attempting it."""
+
+
+class ChaosFault(RuntimeError):
+    """An injected fault (utils/chaos.py) — type-distinct so tests can
+    tell injected failures from real ones."""
+
+
+def compute_backoff(
+    attempt: int,
+    base_delay: float,
+    max_delay: float = 8.0,
+    jitter: float = 0.25,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Delay before re-try number ``attempt`` (0-based): doubling from
+    ``base_delay``, capped at ``max_delay``, +-``jitter`` fraction."""
+    rng = rng or _JITTER_RNG
+    delay = min(base_delay * (2 ** attempt), max_delay)
+    delay *= 1.0 + rng.uniform(-jitter, jitter)
+    return max(delay, 0.0)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    retries: int = 3,
+    base_delay: float = 0.5,
+    max_delay: float = 8.0,
+    jitter: float = 0.25,
+    description: Optional[str] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+    timeout: Optional[float] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying transient failures with
+    exponential backoff. ``retries`` is the number of RE-tries after the
+    first attempt; the final failure re-raises — the caller decides
+    whether the call is load-bearing (reward_fn) or droppable
+    (tracker.log). ``sleep``/``rng`` are injectable for fake-clock
+    tests; ``timeout`` applies :func:`call_with_deadline` per attempt."""
+    what = description or getattr(fn, "__name__", repr(fn))
+    for attempt in range(retries + 1):
+        try:
+            if timeout is not None:
+                return call_with_deadline(fn, timeout, *args, **kwargs)
+            return fn(*args, **kwargs)
+        except Exception as e:
+            if attempt >= retries:
+                logger.error(
+                    "%s failed after %d attempts: %s", what, attempt + 1, e
+                )
+                raise
+            delay = compute_backoff(attempt, base_delay, max_delay, jitter, rng)
+            logger.warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.2fs",
+                what, attempt + 1, retries + 1, e, delay,
+            )
+            sleep(delay)
+
+
+# one shared daemon pool for deadline'd calls: spawning a thread per
+# attempt is cheap, but an abandoned (timed-out) worker must not block
+# interpreter exit, and futures' lazy worker reuse keeps the steady
+# state at one live thread for a healthy reward service
+_DEADLINE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+
+def _pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _DEADLINE_POOL
+    if _DEADLINE_POOL is None:
+        _DEADLINE_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="trlx-deadline"
+        )
+    return _DEADLINE_POOL
+
+
+def call_with_deadline(fn: Callable, timeout: float, *args, **kwargs):
+    """Run ``fn`` in a worker thread, raising :class:`DeadlineExceeded`
+    if it does not return within ``timeout`` seconds. The worker is
+    abandoned, not killed: ``fn`` must not mutate state the caller will
+    touch again (pure RPC-style calls only)."""
+    fut = _pool().submit(fn, *args, **kwargs)
+    try:
+        return fut.result(timeout=timeout)
+    except concurrent.futures.TimeoutError:
+        fut.cancel()
+        raise DeadlineExceeded(
+            f"{getattr(fn, '__name__', 'call')} exceeded its "
+            f"{timeout:.3g}s deadline"
+        ) from None
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit with half-open recovery.
+
+    closed: all calls allowed. After ``failure_threshold`` CONSECUTIVE
+    ``record_failure`` calls the circuit opens: ``allow()`` returns
+    False until ``reset_timeout`` seconds pass on the injected
+    ``clock``, then one half-open probe is allowed — ``record_success``
+    closes the circuit, ``record_failure`` re-opens it (fresh timeout).
+    ``reset_timeout=0`` allows a probe on every call while open (the
+    one-unretried-attempt-per-step tracker policy)."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        reset_timeout: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self._clock = clock
+        self._failures = 0
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+
+    @property
+    def state(self) -> str:
+        if self._state == self.OPEN and (
+            self._clock() - self._opened_at >= self.reset_timeout
+        ):
+            return self.HALF_OPEN
+        return self._state
+
+    @property
+    def is_closed(self) -> bool:
+        return self.state == self.CLOSED
+
+    def allow(self) -> bool:
+        """Whether a call may proceed; transitions open->half_open when
+        the reset timeout has elapsed."""
+        st = self.state
+        if st == self.HALF_OPEN:
+            self._state = self.HALF_OPEN
+            return True
+        return st == self.CLOSED
+
+    def record_success(self) -> None:
+        self._failures = 0
+        self._state = self.CLOSED
+
+    def record_failure(self) -> None:
+        self._failures += 1
+        if self._state == self.HALF_OPEN or (
+            self._failures >= self.failure_threshold
+        ):
+            self._state = self.OPEN
+            self._opened_at = self._clock()
+
+
+@dataclass
+class ResilientIOConfig:
+    """Parsed ``train.resilient_io`` section (a plain dict in YAML so
+    the flat TrainConfig dataclass stays backward-compatible).
+
+    reward_timeout     per-attempt deadline (seconds) for reward_fn;
+                       None = no deadline (the default — a reward fn
+                       that computes on-device must not run in a worker
+                       thread).
+    retries/base_delay default to train.external_retries /
+                       train.retry_base_delay when unset.
+    max_delay/jitter   backoff cap and +-fraction.
+    breaker_threshold  consecutive exhausted-retry failures before the
+                       reward circuit opens (0 disables the breaker).
+    breaker_reset_s    seconds before a half-open reward probe.
+    fallback_reward    "none" (failures propagate — PR 1 behavior),
+                       "hold_mean" (trainer substitutes its running
+                       reward mean per sample), or a number (constant).
+    """
+
+    reward_timeout: Optional[float] = None
+    retries: Optional[int] = None
+    base_delay: Optional[float] = None
+    max_delay: float = 8.0
+    jitter: float = 0.25
+    breaker_threshold: int = 3
+    breaker_reset_s: float = 30.0
+    fallback_reward: Any = "none"
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ResilientIOConfig":
+        d = dict(d or {})
+        known = {f for f in cls.__dataclass_fields__}  # noqa: C416
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"train.resilient_io: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        cfg = cls(**d)
+        fb = cfg.fallback_reward
+        if fb not in ("none", "hold_mean") and not isinstance(fb, (int, float)):
+            raise ValueError(
+                "train.resilient_io.fallback_reward must be 'none', "
+                f"'hold_mean' or a number, got {fb!r}"
+            )
+        return cfg
+
+    @property
+    def has_fallback(self) -> bool:
+        return self.fallback_reward != "none"
+
+
+@dataclass
+class ResilientCaller:
+    """Breaker-gated, deadline'd, retried call with optional fallback.
+
+    ``fallback(exc, kwargs)`` is invoked (when provided) whenever the
+    call ultimately fails — retries exhausted, deadline exceeded on the
+    last attempt, or circuit open. Without a fallback the failure
+    propagates (load-bearing semantics). While the breaker is open,
+    half-open probes run with a single attempt (no retries) so a dead
+    service never charges the full backoff to every cycle."""
+
+    fn: Callable
+    description: str = "external call"
+    timeout: Optional[float] = None
+    retries: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 8.0
+    jitter: float = 0.25
+    breaker: Optional[CircuitBreaker] = None
+    fallback: Optional[Callable[[BaseException, Dict[str, Any]], Any]] = None
+    sleep: Callable[[float], None] = time.sleep
+    rng: Optional[random.Random] = None
+    fallback_engaged: int = field(default=0, init=False)
+
+    def _resolve_fallback(self, exc: BaseException, kwargs: Dict[str, Any]):
+        if self.fallback is None:
+            raise exc
+        self.fallback_engaged += 1
+        logger.warning(
+            "%s degraded to fallback (%d so far): %s",
+            self.description, self.fallback_engaged, exc,
+        )
+        return self.fallback(exc, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        probing = False
+        if self.breaker is not None:
+            if not self.breaker.allow():
+                return self._resolve_fallback(
+                    CircuitOpenError(
+                        f"{self.description}: circuit open, call skipped"
+                    ),
+                    kwargs,
+                )
+            probing = not self.breaker.is_closed
+        try:
+            out = retry_call(
+                self.fn, *args,
+                retries=0 if probing else self.retries,
+                base_delay=self.base_delay, max_delay=self.max_delay,
+                jitter=self.jitter, description=self.description,
+                sleep=self.sleep, rng=self.rng, timeout=self.timeout,
+                **kwargs,
+            )
+        except Exception as e:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            return self._resolve_fallback(e, kwargs)
+        if self.breaker is not None:
+            if probing:
+                logger.info("%s recovered; circuit closed", self.description)
+            self.breaker.record_success()
+        return out
