@@ -925,14 +925,20 @@ def bench_smoke() -> dict:
 def bench_chaos() -> dict:
     """Robustness smoke (`python bench.py --chaos`, also
     scripts/chaos_smoke.py): one short PPO learn() run under an injected
-    NaN burst + reward-service timeout, with the guardrails watchdog and
-    the resilient reward path armed and the overlapped rollout prefetch
-    ON. CPU-sized (tiny random model, byte tokenizer, zero egress).
+    NaN burst, a reward-service timeout, a bit-flipped committed
+    checkpoint shard (ckpt_corrupt) and a host fingerprint divergence
+    (host_divergence), with the guardrails watchdog — including the
+    cross-host consistency check — the resilient reward path and
+    checkpoint integrity manifests armed, and the overlapped rollout
+    prefetch ON. CPU-sized (tiny random model, byte tokenizer, zero
+    egress).
 
     Asserts the run recovers WITHOUT human intervention: completes its
-    full step budget, executes >= 1 auto-rollback to the last good
-    checkpoint, engages the reward fallback for the injected timeout,
-    and finishes with a finite final reward."""
+    full step budget, executes >= 1 auto-rollback whose corrupt target
+    is QUARANTINED (kept as *.corrupt) with a transparent fallback to
+    the previous committed step, records a consistency-watchdog trip
+    for the injected divergence, and finishes with a finite final
+    reward."""
     _enable_compile_cache()
     import shutil
 
@@ -951,8 +957,19 @@ def bench_chaos() -> dict:
             keep_last_n=3, external_retries=1, retry_base_delay=0.05,
             guardrails=dict(
                 enabled=True, min_history=2,
+                # spike detection OFF so only the INJECTED faults trip
+                # (non-finite losses always trip regardless): the
+                # schedule below choreographs commit -> corrupt -> NaN
+                # -> rollback -> quarantine -> fallback, and a natural
+                # early-loss spike would delay the commits out from
+                # under it
+                loss_spike_sigma=0.0,
                 ladder=["requeue", "rollback", "abort"],
                 cooldown_cycles=2, max_rollbacks=3,
+                # cross-host consistency watchdog, checked every cycle
+                # (single-host here: the chaos perturbation plays the
+                # drifted peer)
+                consistency_every=1,
             ),
             resilient_io=dict(
                 reward_timeout=0.05, fallback_reward="hold_mean",
@@ -961,8 +978,15 @@ def bench_chaos() -> dict:
             chaos=dict(
                 seed=0,
                 faults=[
-                    # fused blocks 3 and 4 train on NaN-poisoned batches
-                    {"fault": "nan_loss", "at": 3, "span": 2},
+                    # the 2nd committed checkpoint gets a bit-flipped
+                    # shard AFTER commit: the later rollback must
+                    # quarantine it and fall back to commit #1
+                    {"fault": "ckpt_corrupt", "at": 2},
+                    # the 1st consistency check sees this host's
+                    # fingerprint diverge from the consensus
+                    {"fault": "host_divergence", "at": 1},
+                    # fused blocks 5 and 6 train on NaN-poisoned batches
+                    {"fault": "nan_loss", "at": 5, "span": 2},
                     # the 4th reward call stalls past the 0.05s deadline
                     {"fault": "reward_timeout", "at": 4},
                 ],
@@ -1012,12 +1036,28 @@ def bench_chaos() -> dict:
         f"(actions: {trainer.guardrails.actions_taken})"
     )
     assert np.isfinite(final_reward), f"final reward {final_reward} not finite"
+    # elastic recovery: the bit-flipped checkpoint must have been
+    # QUARANTINED (renamed *.corrupt, kept on disk) on the rollback
+    # path, and the injected fingerprint divergence must have tripped
+    # the consistency watchdog
+    quarantined = [e for e in os.listdir(ckpt_dir) if ".corrupt" in e]
+    assert quarantined, (
+        f"expected the corrupted checkpoint to be quarantined; dir holds "
+        f"{sorted(os.listdir(ckpt_dir))}"
+    )
+    assert "consistency" in trainer.guardrails.trip_history, (
+        f"expected a consistency-watchdog trip, saw "
+        f"{trainer.guardrails.trip_history}"
+    )
     return {
         "chaos_completed_steps": int(trainer.iter_count),
         "chaos_rollbacks": int(trainer.guardrails.rollbacks),
         "chaos_actions": list(trainer.guardrails.actions_taken),
         "chaos_faults_fired": trainer.chaos.fired,
         "chaos_reward_fallbacks": int(fallbacks),
+        "chaos_quarantined": quarantined,
+        "chaos_consistency_trips":
+            trainer.guardrails.trip_history.count("consistency"),
         "chaos_final_reward": round(float(final_reward), 4),
         "chaos_wall_s": round(wall, 2),
     }

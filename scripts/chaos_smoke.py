@@ -1,12 +1,16 @@
 #!/usr/bin/env python
 """Robustness smoke: a short PPO `learn()` under injected chaos (NaN
-burst in the fused-block losses + a reward-service timeout), with the
-guardrails watchdog, the resilient reward path and the overlapped
-rollout prefetch all armed.
+burst in the fused-block losses, a reward-service timeout, a bit-flipped
+committed checkpoint shard, and a cross-host fingerprint divergence),
+with the guardrails watchdog — including the consistency watchdog — the
+resilient reward path, checkpoint integrity manifests and the
+overlapped rollout prefetch all armed.
 
 Prints one JSON line and exits non-zero if the run does not recover
 without human intervention (full step budget completed, >= 1
-auto-rollback to the last good checkpoint, finite final reward).
+auto-rollback to the last good checkpoint, the corrupted checkpoint
+quarantined — not loaded, not deleted — the divergence tripping the
+ladder, finite final reward).
 
 CPU-friendly (tiny random model, byte tokenizer, zero egress) — run it
 after touching guardrails / checkpointing / the rollout loop:

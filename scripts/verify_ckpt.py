@@ -4,12 +4,18 @@
 Validates a checkpoint directory (or a whole checkpoint root) without
 touching accelerators: commit marker present and well-formed, orbax
 `state/` tree present, `state.json` parses and carries a step counter,
-`hf_model/` deploy export present. With `--deep` the orbax tree is
-actually restored (CPU) and every array leaf is checked finite.
+`hf_model/` deploy export present, and — when the checkpoint carries an
+`integrity.json` manifest (every post-elastic commit does) — every
+hashed file matches its sha256, with a per-file mismatch report when
+not. With `--deep` the orbax tree is actually restored (CPU) and every
+array leaf is checked finite. `--write-manifest` BACKFILLS integrity
+manifests for pre-elastic checkpoints (committed directories lacking
+one), so old runs get quarantine protection on their next resume.
 
 Usage:
     python scripts/verify_ckpt.py ckpts/checkpoint_0042 [--deep]
     python scripts/verify_ckpt.py ckpts            # scan every checkpoint_*/best_checkpoint
+    python scripts/verify_ckpt.py ckpts --write-manifest
 Exit code 0 = everything checked out; 1 = at least one problem.
 """
 
@@ -25,7 +31,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from trlx_tpu.utils.checkpointing import COMMIT_MARKER, is_committed  # noqa: E402
+from trlx_tpu.utils.checkpointing import (  # noqa: E402
+    COMMIT_MARKER,
+    INTEGRITY_MANIFEST,
+    QUARANTINE_SUFFIX,
+    is_committed,
+    verify_integrity,
+    write_integrity_manifest,
+)
 
 
 def check_one(directory: str, deep: bool = False) -> list:
@@ -73,6 +86,21 @@ def check_one(directory: str, deep: bool = False) -> list:
     if not os.path.isdir(os.path.join(directory, "hf_model")):
         problems.append(f"{directory}: no hf_model/ deploy export")
 
+    status, mismatches = verify_integrity(directory)
+    if status == "corrupt":
+        problems.append(
+            f"{directory}: integrity manifest mismatch — {len(mismatches)} "
+            "leaves differ from the committed sha256s (a resume would "
+            "quarantine this checkpoint):"
+        )
+        problems.extend(f"  {directory}: {m}" for m in mismatches)
+    elif status == "no-manifest":
+        print(
+            f"NOTE  {directory}: no {INTEGRITY_MANIFEST} (pre-elastic "
+            "commit) — backfill with --write-manifest for quarantine "
+            "protection"
+        )
+
     if deep and os.path.isdir(state_dir):
         try:
             import numpy as np
@@ -105,6 +133,12 @@ def main(argv=None) -> int:
         "--deep", action="store_true",
         help="restore the orbax state tree and check every leaf finite",
     )
+    parser.add_argument(
+        "--write-manifest", action="store_true",
+        help="backfill integrity.json for committed checkpoints that "
+             "lack one (pre-elastic saves); existing manifests are "
+             "left untouched",
+    )
     args = parser.parse_args(argv)
 
     path = os.path.abspath(args.path)
@@ -113,8 +147,17 @@ def main(argv=None) -> int:
     children = [
         os.path.join(path, e)
         for e in entries
-        if e.startswith("checkpoint_") or e == "best_checkpoint"
+        if (e.startswith("checkpoint_") or e == "best_checkpoint")
+        and QUARANTINE_SUFFIX not in e  # quarantined = known-corrupt, NOTEd below
     ]
+    for entry in entries:
+        if entry.startswith("tmp_old_") or QUARANTINE_SUFFIX not in entry:
+            continue
+        print(
+            f"NOTE  {os.path.join(path, entry)}: QUARANTINED checkpoint "
+            "(failed integrity verification on a past load; kept for "
+            "postmortem, skipped by discovery)"
+        )
     if children:
         targets = children
     elif any(
@@ -124,10 +167,10 @@ def main(argv=None) -> int:
         targets = [path]  # a single checkpoint directory
     else:
         # a checkpoint ROOT with nothing committed yet (young run, or
-        # only tmp_/logs entries): that's a clean fresh-start state,
-        # not corruption — don't validate the root as if it were a
+        # only tmp_/logs/quarantine entries): that's a clean state, not
+        # corruption — don't validate the root as if it were a
         # checkpoint
-        print(f"OK    {path}: no checkpoints yet (fresh start)")
+        print(f"OK    {path}: no committed checkpoints to validate")
         return 0
 
     rc = 0
@@ -139,8 +182,24 @@ def main(argv=None) -> int:
                 f"'{entry[len('tmp_old_'):].rsplit('.', 1)[0]}'; restore "
                 "it by renaming if the final copy is missing/torn"
             )
+    if args.write_manifest and not args.deep:
+        print(
+            "NOTE  --write-manifest without --deep: the manifest will "
+            "bless whatever bytes are on disk; --deep first restores "
+            "the orbax tree and checks every leaf finite, so latent "
+            "corruption cannot be certified as verified"
+        )
     for target in targets:
         problems = check_one(target, deep=args.deep)
+        if (
+            args.write_manifest and is_committed(target) and not problems
+            and not os.path.isfile(os.path.join(target, INTEGRITY_MANIFEST))
+        ):
+            # backfill ONLY when every other check (incl. --deep when
+            # given) passed — a manifest over a checkpoint that already
+            # fails validation would certify corruption as verified
+            write_integrity_manifest(target)
+            print(f"WROTE {os.path.join(target, INTEGRITY_MANIFEST)}")
         if problems:
             rc = 1
             for p in problems:

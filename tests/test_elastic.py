@@ -1,0 +1,454 @@
+"""Elastic-recovery tests (ISSUE 4): topology-change resume onto a
+different dp/fsdp split, checkpoint integrity manifests + quarantine
+with automatic fallback to the previous committed step, and the
+cross-host consistency watchdog (`multihost.consensus`) — on the
+CPU-simulated 8-device mesh."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import trlx_tpu
+from trlx_tpu.parallel import multihost as mh
+from trlx_tpu.utils.checkpointing import (
+    INTEGRITY_MANIFEST,
+    QUARANTINE_SUFFIX,
+    TOPOLOGY_MANIFEST,
+    CheckpointCorruptError,
+    CheckpointManager,
+    ElasticConfig,
+    compute_integrity_manifest,
+    quarantine,
+    verify_integrity,
+    write_integrity_manifest,
+)
+
+from tests.test_trainers import (
+    PPO_PROMPTS,
+    ppo_tiny_config,
+    read_metrics,
+    tiny_model_cfg,
+    word_count_reward,
+)
+
+FAST_RETRY = dict(external_retries=2, retry_base_delay=0.01)
+
+
+# ---------------------------------------------------------------------------
+# multihost.consensus
+# ---------------------------------------------------------------------------
+
+
+def test_consensus_single_host_degenerate():
+    fp = {"a": 1.5, "b": -2.0, "iter": 7.0}
+    result = mh.consensus(fp)
+    assert result.agree
+    assert result.reference == fp
+    assert result.detail == ""
+
+
+def test_consensus_rows_compare():
+    keys = ["a", "b"]
+    agree, detail = mh._consensus_rows([[1.0, 2.0], [1.0, 2.0]], keys, 0.0)
+    assert agree and detail == ""
+
+    agree, detail = mh._consensus_rows([[1.0, 2.0], [1.0, 2.5]], keys, 0.0)
+    assert not agree
+    assert "b=" in detail and "process 1" in detail
+
+    # atol absorbs float noise; exact zero does not
+    agree, _ = mh._consensus_rows([[1.0, 2.0], [1.0, 2.0 + 1e-7]], keys, 1e-6)
+    assert agree
+    # a non-finite value on ONE host (vs finite peers) is divergence
+    # no matter the tolerance...
+    agree, detail = mh._consensus_rows(
+        [[1.0, 2.0], [float("nan"), 2.0]], keys, 1e6
+    )
+    assert not agree and "a=" in detail
+    # ...but bit-identical NaN everywhere is NOT cross-host divergence
+    # (the whole fleet holds the same poisoned state — the loss guards
+    # own that failure, this signal is about one host departing)
+    agree, _ = mh._consensus_rows(
+        [[float("nan"), 2.0], [float("nan"), 2.0]], keys, 0.0
+    )
+    assert agree
+
+
+# ---------------------------------------------------------------------------
+# integrity manifest + quarantine units
+# ---------------------------------------------------------------------------
+
+
+def _commit_with_files(mgr, name, files):
+    def write(tmp):
+        for rel, data in files.items():
+            fp = os.path.join(tmp, rel)
+            os.makedirs(os.path.dirname(fp), exist_ok=True)
+            with open(fp, "wb") as f:
+                f.write(data)
+
+    return mgr.commit(name, write)
+
+
+def test_commit_writes_integrity_manifest_and_verifies(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpts"))
+    path = _commit_with_files(
+        mgr, "checkpoint_2",
+        {"state/shard0": b"abc" * 100, "state.json": b'{"iter_count": 2}'},
+    )
+    manifest_fp = os.path.join(path, INTEGRITY_MANIFEST)
+    assert os.path.isfile(manifest_fp)
+    with open(manifest_fp) as f:
+        manifest = json.load(f)
+    # the commit marker and the manifest itself are excluded; the
+    # payload files are all covered
+    assert set(manifest["files"]) == {"state/shard0", "state.json"}
+    assert verify_integrity(path) == ("ok", [])
+
+    # a single flipped byte is caught and named per-leaf
+    with open(os.path.join(path, "state", "shard0"), "r+b") as f:
+        f.seek(10)
+        byte = f.read(1)
+        f.seek(10)
+        f.write(bytes([byte[0] ^ 0x01]))
+    status, problems = verify_integrity(path)
+    assert status == "corrupt"
+    assert any("state/shard0" in p and "mismatch" in p for p in problems)
+
+    # a deleted file is also a mismatch
+    os.unlink(os.path.join(path, "state.json"))
+    status, problems = verify_integrity(path)
+    assert status == "corrupt"
+    assert any("state.json" in p and "missing" in p for p in problems)
+
+
+def test_integrity_opt_out_and_no_manifest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), integrity=False)
+    path = _commit_with_files(mgr, "checkpoint_1", {"state.json": b"{}"})
+    assert not os.path.exists(os.path.join(path, INTEGRITY_MANIFEST))
+    assert verify_integrity(path) == ("no-manifest", [])
+    # backfill (the verify_ckpt --write-manifest path)
+    write_integrity_manifest(path)
+    assert verify_integrity(path) == ("ok", [])
+
+
+def test_quarantine_renames_never_deletes(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpts"))
+    path = _commit_with_files(mgr, "checkpoint_3", {"state.json": b"{}"})
+    moved = quarantine(path)
+    assert moved.endswith(QUARANTINE_SUFFIX)
+    assert not os.path.exists(path)
+    assert os.path.isfile(os.path.join(moved, "state.json"))
+    # discovery no longer sees it
+    assert mgr.latest_committed() is None
+    # a second quarantine of the same name gets a unique suffix
+    path2 = _commit_with_files(mgr, "checkpoint_3", {"state.json": b"{}"})
+    moved2 = quarantine(path2)
+    assert moved2 != moved and os.path.isdir(moved2)
+
+
+def test_elastic_config_rejects_unknown_keys():
+    cfg = ElasticConfig.from_dict({"integrity": False})
+    assert not cfg.integrity and cfg.verify_integrity
+    with pytest.raises(ValueError, match="unknown keys"):
+        ElasticConfig.from_dict({"integirty": True})
+
+
+# ---------------------------------------------------------------------------
+# topology-invariant prompt-chunk slicing
+# ---------------------------------------------------------------------------
+
+
+class _FakePrompts:
+    """Indexable stand-in for PromptPipeline (rows stay raw dicts)."""
+
+    def __init__(self, n):
+        self.rows = [{"input_ids": [i], "tag": f"r{i}"} for i in range(n)]
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        return self.rows[i]
+
+
+def test_group_chunk_loader_partitions_global_chunks():
+    from trlx_tpu.pipeline import DataLoader
+    from trlx_tpu.trainer.ppo import _GroupChunkLoader
+
+    pipe = _FakePrompts(24)
+    collate = list  # keep raw dict rows
+    # the reference global stream a single group would see
+    global_chunks = list(DataLoader(
+        pipe, 8, collate_fn=collate, shuffle=True, drop_last=True, seed=3
+    ))
+    assert len(global_chunks) == 3
+    for gcount in (2, 4):
+        per_group = [
+            list(_GroupChunkLoader(pipe, 8, collate, g, gcount, seed=3))
+            for g in range(gcount)
+        ]
+        for c, chunk in enumerate(global_chunks):
+            rows = set()
+            for g in range(gcount):
+                sliced = per_group[g][c]
+                # each host collates only its 1/G of the chunk
+                assert len(sliced) == 8 // gcount
+                rows.update(r["tag"] for r in sliced)
+            # the groups' slices PARTITION the global chunk: same rows
+            # regardless of gcount — the topology-invariance contract
+            assert rows == {r["tag"] for r in chunk}
+
+
+def test_group_chunk_loader_pads_ragged_by_wraparound():
+    from trlx_tpu.trainer.ppo import _GroupChunkLoader
+
+    pipe = _FakePrompts(6)
+    sizes = {
+        len(list(_GroupChunkLoader(
+            pipe, 6, list, g, 4, seed=0, drop_last=False
+        ))[0])
+        for g in range(4)
+    }
+    assert sizes == {2}  # every group equal-sized (SPMD lockstep)
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: topology manifest, quarantine fallback, resharded
+# resume equivalence, consistency watchdog under chaos
+# ---------------------------------------------------------------------------
+
+
+def _sft_cfg(ckpt_dir, **train):
+    from trlx_tpu.data.default_configs import default_sft_config
+
+    return default_sft_config().evolve(
+        train=dict(
+            dict(batch_size=8, total_steps=2, eval_interval=100,
+                 checkpoint_interval=2, seq_length=16, epochs=8,
+                 tracker="jsonl", save_best=False,
+                 compute_dtype="float32",
+                 checkpoint_dir=str(ckpt_dir), **FAST_RETRY),
+            **train,
+        ),
+        model=tiny_model_cfg(),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=False)),
+    )
+
+
+SFT_SAMPLES = [("question", "answer"), ("hi", "there")] * 8
+
+
+def test_topology_manifest_written_and_arch_mismatch_rejected(tmp_path):
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = _sft_cfg(tmp_path / "ckpts")
+    trainer = get_trainer(config.train.trainer)(config=config)
+    ckpt = str(tmp_path / "manual")
+    trainer.save(ckpt)
+    fp = os.path.join(ckpt, TOPOLOGY_MANIFEST)
+    assert os.path.isfile(fp)
+    with open(fp) as f:
+        topo = json.load(f)
+    assert topo["mesh"]["dp"] * topo["mesh"]["fsdp"] == 8
+    assert topo["process_count"] == 1 and topo["data_group_count"] == 1
+    assert topo["global_batch_size"] == 8
+    # every leaf carries a GLOBAL shape + dtype
+    leaf = next(iter(topo["leaves"].values()))
+    assert "shape" in leaf and "dtype" in leaf
+
+    # a different ARCHITECTURE (hidden size) must be rejected up front,
+    # not garbled by a silent reshard
+    other_cfg = _sft_cfg(tmp_path / "ckpts2").evolve(
+        model=dict(model_extra_configs={"transformer": dict(
+            hidden_size=32, n_layer=2, n_head=2, n_positions=64)}),
+    )
+    other = get_trainer(other_cfg.train.trainer)(config=other_cfg)
+    with pytest.raises(ValueError, match="ARCHITECTURE"):
+        other.load(ckpt)
+
+
+def test_corrupt_checkpoint_quarantined_resume_falls_back(tmp_path):
+    """ISSUE 4 acceptance: a deliberately corrupted checkpoint is
+    quarantined (not deleted, not loaded) and auto-resume proceeds from
+    the previous committed step."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    first = trlx_tpu.train(
+        samples=SFT_SAMPLES,
+        config=_sft_cfg(ckpt_dir, total_steps=2, checkpoint_interval=1),
+    )
+    assert first.iter_count == 2
+    names = os.listdir(ckpt_dir)
+    assert "checkpoint_1" in names and "checkpoint_2" in names
+
+    # bit-flip a committed shard of the NEWEST checkpoint
+    target = os.path.join(ckpt_dir, "checkpoint_2")
+    victims = sorted(
+        os.path.join(r, f)
+        for r, _d, fs in os.walk(os.path.join(target, "state"))
+        for f in fs if os.path.getsize(os.path.join(r, f)) > 0
+    )
+    with open(victims[0], "r+b") as f:
+        f.seek(os.path.getsize(victims[0]) // 2)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0x01]))
+
+    resumed = trlx_tpu.train(
+        samples=SFT_SAMPLES,
+        config=_sft_cfg(ckpt_dir, total_steps=3, checkpoint_interval=1,
+                        resume_from_checkpoint="auto"),
+    )
+    # resumed from checkpoint_1 (step 1), trained 2 more steps
+    assert resumed.iter_count == 3
+    names = os.listdir(ckpt_dir)
+    # quarantined: renamed, kept, with its payload intact
+    quarantined = [n for n in names if n.startswith("checkpoint_2" + QUARANTINE_SUFFIX)]
+    assert quarantined, names
+    assert os.path.isfile(
+        os.path.join(ckpt_dir, quarantined[0], "state.json")
+    )
+    # the resumed run logged steps 2 and 3 exactly once each (it did NOT
+    # restart from 0 and did NOT continue from the poisoned step 2)
+    loss_steps = [
+        r["_step"] for r in read_metrics(ckpt_dir) if "losses/loss" in r
+    ]
+    assert sorted(loss_steps) == [1, 2, 2, 3], loss_steps
+
+
+def test_explicit_corrupt_checkpoint_raises_without_rename(tmp_path):
+    """An explicitly named corrupt checkpoint is a hard error (no silent
+    fallback to a different step) — and the user-pinned path is NOT
+    quarantine-renamed: a transient storage mismatch must not
+    permanently break the configured path."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    trlx_tpu.train(
+        samples=SFT_SAMPLES,
+        config=_sft_cfg(ckpt_dir, total_steps=1, checkpoint_interval=1),
+    )
+    target = os.path.join(ckpt_dir, "checkpoint_1")
+    state_fp = os.path.join(target, "state.json")
+    with open(state_fp, "r+b") as f:
+        f.write(b"X")
+    with pytest.raises(CheckpointCorruptError):
+        trlx_tpu.train(
+            samples=SFT_SAMPLES,
+            config=_sft_cfg(ckpt_dir, total_steps=2,
+                            resume_from_checkpoint=target),
+        )
+    assert os.path.isdir(target)  # pinned path left in place
+
+
+def test_resharded_resume_matches_same_mesh_losses(tmp_path):
+    """ISSUE 4 acceptance: train k steps on mesh A -> resume on mesh B
+    with a different dp/fsdp split -> the continued losses match the
+    same-mesh resume (params AND opt state reshard losslessly; the
+    PRNG/cursor restore is topology-independent)."""
+    base_dir = str(tmp_path / "base")
+    trlx_tpu.train(
+        samples=SFT_SAMPLES,
+        config=_sft_cfg(base_dir, total_steps=2, checkpoint_interval=2),
+    )
+    saved = os.path.join(base_dir, "checkpoint_2")
+    assert os.path.isdir(saved)
+
+    def resume(ckpt_dir, mesh):
+        cfg = _sft_cfg(
+            ckpt_dir, total_steps=4, checkpoint_interval=100,
+            resume_from_checkpoint=saved, mesh=mesh,
+        )
+        trainer = trlx_tpu.train(samples=SFT_SAMPLES, config=cfg)
+        assert trainer.iter_count == 4
+        return [
+            (r["_step"], r["losses/loss"])
+            for r in read_metrics(ckpt_dir) if "losses/loss" in r
+        ]
+
+    # mesh A continued on mesh A (the golden), vs dp halved into fsdp
+    # (params+opt now SHARDED over 4 ways that were replicated before),
+    # vs dp halved outright (4 of 8 devices — a shrunken slice)
+    golden = resume(str(tmp_path / "same"), {"dp": 8, "fsdp": 1})
+    resharded = resume(str(tmp_path / "reshard"), {"dp": 2, "fsdp": 4})
+    shrunk = resume(str(tmp_path / "shrunk"), {"dp": 4, "fsdp": 1})
+
+    assert [s for s, _ in golden] == [3, 4]
+    for other in (resharded, shrunk):
+        assert [s for s, _ in other] == [3, 4]
+        np.testing.assert_allclose(
+            [l for _, l in other], [l for _, l in golden],
+            rtol=2e-4, atol=1e-5,
+        )
+
+
+def test_chaos_host_divergence_trips_guardrails(tmp_path):
+    """ISSUE 4 acceptance: an injected host-fingerprint divergence trips
+    the guardrails ladder (instead of the host drifting silently)."""
+    import warnings
+
+    config = ppo_tiny_config(
+        str(tmp_path / "ckpts"),
+        train=dict(
+            total_steps=2, epochs=2, eval_interval=100,
+            checkpoint_interval=100, save_best=False,
+            guardrails=dict(enabled=True, consistency_every=1,
+                            loss_spike_sigma=0.0, ladder=["log"]),
+            chaos=dict(seed=0, faults=[{"fault": "host_divergence", "at": 1}]),
+            **FAST_RETRY,
+        ),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        trainer = trlx_tpu.train(
+            reward_fn=word_count_reward, prompts=PPO_PROMPTS, config=config
+        )
+    assert trainer.iter_count == 2  # log-only ladder: the run completes
+    assert trainer.chaos.fired == [{"fault": "host_divergence", "count": 1}]
+    assert "consistency" in trainer.guardrails.trip_history
+    assert "log" in trainer.guardrails.actions_taken
+
+
+def test_verify_ckpt_integrity_and_backfill(tmp_path, capsys):
+    import importlib.util
+
+    fp = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "verify_ckpt.py",
+    )
+    spec = importlib.util.spec_from_file_location("verify_ckpt_elastic", fp)
+    verify_ckpt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(verify_ckpt)
+
+    root = str(tmp_path / "ckpts")
+    mgr = CheckpointManager(root, integrity=False)  # pre-elastic commit
+
+    def write_good(tmp):
+        os.makedirs(os.path.join(tmp, "state"))
+        os.makedirs(os.path.join(tmp, "hf_model"))
+        with open(os.path.join(tmp, "state", "shard"), "wb") as f:
+            f.write(b"y" * 64)
+        with open(os.path.join(tmp, "state.json"), "w") as f:
+            json.dump({"iter_count": 3}, f)
+
+    good = mgr.commit("checkpoint_3", write_good)
+    # backfill, then the manifest verifies
+    assert verify_ckpt.main([root, "--write-manifest"]) == 0
+    assert os.path.isfile(os.path.join(good, INTEGRITY_MANIFEST))
+    out = capsys.readouterr().out
+    assert "WROTE" in out
+
+    # flip a byte -> the validator reports the exact leaf and fails
+    with open(os.path.join(good, "state", "shard"), "r+b") as f:
+        f.seek(5)
+        f.write(b"Z")
+    assert verify_ckpt.main([root]) == 1
+    out = capsys.readouterr().out
+    assert "integrity manifest mismatch" in out and "state/shard" in out
+
+    # quarantined siblings are NOTEd, not validated as failures
+    quarantine(good)
+    assert verify_ckpt.main([root]) == 0
+    out = capsys.readouterr().out
+    assert "QUARANTINED" in out

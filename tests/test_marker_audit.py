@@ -29,6 +29,7 @@ TIER1_BUDGETS = {
     "test_configs.py": 5,
     "test_curves.py": 10,
     "test_deferred_stats.py": 5,
+    "test_elastic.py": 70,
     "test_examples.py": 20,
     "test_fault_tolerance.py": 90,
     "test_flash_attention.py": 15,
@@ -65,6 +66,7 @@ TIER1_BUDGET_CEILING_S = 700
 # marker, because that loop IS the subject under test and the configs
 # are tiny (documented tradeoff; everything else slow-marks them)
 LEARN_IN_TIER1_ALLOWLIST = {
+    "test_elastic.py",          # resharded-resume / quarantine-fallback
     "test_fault_tolerance.py",  # kill/resume + chaos scenarios
     "test_guardrails.py",       # rollback/requeue under chaos
     "test_scanned_epochs.py",   # scanned-vs-looped golden equivalence

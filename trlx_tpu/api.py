@@ -118,27 +118,46 @@ def train(
 
     resume = config.train.resume_from_checkpoint
     if resume == "auto":
+        from trlx_tpu.parallel import multihost as mh
+        from trlx_tpu.utils.checkpointing import CheckpointCorruptError
+
         # discover the newest COMMITted checkpoint under checkpoint_dir;
         # torn directories (preemption mid-save) and deploy-only ones
         # (save_optimizer=false) are skipped, and "nothing yet" is a
         # fresh start — the standard relaunch loop on preemptible pods
-        # points every attempt at the same command line
-        resume = trainer.ckpt_manager.latest_resumable()
-        from trlx_tpu.parallel import multihost as mh
-
-        if mh.is_multihost():
-            # stale shared-filesystem metadata can show different hosts
-            # different listings; every process must load the SAME
-            # checkpoint (or none), so process 0's discovery wins
-            resume = mh.allgather_object(resume)[0]
-        if resume is None:
-            logger.warning(
-                "resume_from_checkpoint='auto': no committed checkpoint "
-                "under %s — starting fresh", config.train.checkpoint_dir,
-            )
-    if resume:
+        # points every attempt at the same command line. A checkpoint
+        # that fails integrity verification is QUARANTINED by load()
+        # (renamed *.corrupt) and discovery falls back to the previous
+        # committed step instead of crashing every relaunch on poison.
+        while True:
+            resume = trainer.ckpt_manager.latest_resumable()
+            if mh.is_multihost():
+                # stale shared-filesystem metadata can show different
+                # hosts different listings; every process must load the
+                # SAME checkpoint (or none), so process 0's discovery wins
+                resume = mh.allgather_object(resume)[0]
+            if resume is None:
+                logger.warning(
+                    "resume_from_checkpoint='auto': no committed checkpoint "
+                    "under %s — starting fresh", config.train.checkpoint_dir,
+                )
+                break
+            logger.info("Resuming from checkpoint %s", resume)
+            try:
+                trainer.load(resume)
+                break
+            except CheckpointCorruptError as e:
+                logger.error(
+                    "auto-resume: %s — falling back to the previous "
+                    "committed checkpoint", e,
+                )
+    elif resume:
+        # an explicitly named checkpoint: a corrupt one is an error the
+        # user must see (no silent fallback to a different step), and
+        # the pinned path is NOT renamed — a transient storage mismatch
+        # must not permanently break the path the user configured
         logger.info("Resuming from checkpoint %s", resume)
-        trainer.load(resume)
+        trainer.load(resume, quarantine_corrupt=False)
 
     trainer.learn()
     return trainer

@@ -245,8 +245,12 @@ class TrainConfig(_Section):
     # --- run guardrails (divergence watchdog) ---------------------------
     # Parsed by utils/guardrails.GuardrailConfig (enabled/window/
     # loss_spike_sigma/kl_factor/reward_sigma/grad_norm_max/
-    # cycle_time_factor/ladder/lr_cut_factor/cooldown_cycles/
-    # max_rollbacks/recover_after). Default {} = disabled: identical
+    # cycle_time_factor/consistency_every/consistency_atol/ladder/
+    # lr_cut_factor/cooldown_cycles/max_rollbacks/recover_after).
+    # consistency_every > 0 arms the cross-host consistency watchdog:
+    # a cheap param/opt-state fingerprint is allgather-compared every N
+    # cycles (multihost.consensus) and a disagreeing host trips the
+    # ladder. Default {} = disabled: identical
     # behavior to pre-guardrails builds. When enabled, health trips walk
     # the escalation ladder (log -> requeue -> lr_cut -> rollback ->
     # abort), checkpoint commits are gated on health, and auto-rollback
@@ -261,10 +265,23 @@ class TrainConfig(_Section):
     # breaker and degrades a dead reward service to the fallback instead
     # of failing the run; reward_timeout bounds each attempt.
     resilient_io: Dict[str, Any] = field(default_factory=dict)
+    # --- elastic recovery (topology-change resume + ckpt integrity) ----
+    # Parsed by utils/checkpointing.ElasticConfig (integrity/
+    # verify_integrity/allow_topology_change). Defaults (all true):
+    # every checkpoint commit includes a per-file sha256 manifest and a
+    # topology manifest; trainer.load() verifies the hashes first and
+    # QUARANTINES a mismatching checkpoint (renamed *.corrupt, never
+    # deleted) — auto-resume and guardrail auto-rollback then fall back
+    # to the previous committed step; and a checkpoint saved under a
+    # different mesh/host-count restores onto the CURRENT mesh
+    # (params/opt-state resharded, PPO prompt stream re-split). See
+    # docs/robustness.md "Elastic recovery".
+    elastic: Dict[str, Any] = field(default_factory=dict)
     # --- chaos injection (tests/CI only) --------------------------------
     # Parsed by utils/chaos.ChaosMonkey: {"seed": int, "faults": [
     # {"fault": "nan_loss"|"sigterm"|"nan_reward"|"reward_timeout"|
-    # "reward_error"|"ckpt_fail", "at": k | "every": n | "p": x,
+    # "reward_error"|"ckpt_fail"|"ckpt_corrupt"|"host_divergence",
+    # "at": k | "every": n | "p": x,
     # "span": m}], "reward_delay": s}. None/{} disables. Deterministic
     # given the seed — see docs/robustness.md for the schedule format.
     chaos: Optional[Dict[str, Any]] = None

@@ -20,6 +20,9 @@ The helpers here are the complete host<->global bridge:
   global_from_local(t, s) per-process local rows -> one global array
   local_rows(arr)         this process's rows of a global batch array
   allgather(x)            host-side values -> full np array everywhere
+  consensus(values)       all-gather a dict of host scalars and verify
+                          every process agrees (the cross-host
+                          consistency-watchdog primitive)
   is_main()               gate for tracker/checkpoint-metadata writes
 
 Mesh layout note: jax.devices() orders devices process-major, and
@@ -284,6 +287,73 @@ def allgather_group_rows(x, mesh=None) -> np.ndarray:
     blocks = np.asarray(multihost_utils.process_allgather(np.asarray(x)))
     reps = group_representatives(mesh)
     return np.concatenate([blocks[r] for r in reps], axis=0)
+
+
+class ConsensusResult:
+    """Outcome of a cross-host fingerprint comparison: ``agree`` is the
+    fleet-wide verdict, ``reference`` the agreed values (process 0's
+    row), ``detail`` a human-readable mismatch description ('' when all
+    rows agree)."""
+
+    __slots__ = ("agree", "reference", "detail")
+
+    def __init__(self, agree: bool, reference: dict, detail: str = ""):
+        self.agree = agree
+        self.reference = reference
+        self.detail = detail
+
+
+def values_agree(a, b, atol: float = 0.0) -> bool:
+    """THE consistency-watchdog equality predicate (one place, used by
+    both the cross-host row compare and the trainer's local-vs-
+    reference drift check): bit-identical values agree — including
+    identical NaN, which is a fleet-wide health problem the loss
+    guards own, not a divergence — otherwise both must be finite and
+    within ``atol``."""
+    a, b = float(a), float(b)
+    if a == b or (np.isnan(a) and np.isnan(b)):
+        return True
+    return bool(np.isfinite(a) and np.isfinite(b) and abs(a - b) <= atol)
+
+
+def _consensus_rows(rows, keys, atol: float):
+    """Pure comparison core (unit-testable without multiple processes):
+    rows[p][i] is process p's value for keys[i]; rows agree when every
+    row is within ``atol`` of row 0 elementwise. Returns (agree, detail
+    listing the first few divergent (process, key, value, reference))."""
+    rows = np.asarray(rows, np.float64)
+    ref = rows[0]
+    mismatches = []
+    for p in range(1, rows.shape[0]):
+        for i, k in enumerate(keys):
+            a, b = rows[p, i], ref[i]
+            if not values_agree(a, b, atol):
+                mismatches.append(f"process {p}: {k}={a!r} != {b!r}")
+    detail = "; ".join(mismatches[:8]) + (
+        f" (+{len(mismatches) - 8} more)" if len(mismatches) > 8 else ""
+    )
+    return not mismatches, detail
+
+
+def consensus(values, atol: float = 0.0) -> ConsensusResult:
+    """All-gather a dict of host-side scalars and check every process
+    holds the same values (within ``atol``) — the cross-host consistency
+    watchdog primitive. Keys must be identical on every process (SPMD:
+    they derive from the same control flow). Single-host degenerates to
+    trivial agreement with ``reference == values``.
+
+    Values ride the gather as float32: callers must fold hashes into
+    the exactly-representable range (e.g. ``% 2**20``)."""
+    keys = sorted(values)
+    vec = np.asarray([float(values[k]) for k in keys], np.float32)
+    if not is_multihost():
+        return ConsensusResult(True, {k: float(values[k]) for k in keys})
+    from jax.experimental import multihost_utils
+
+    rows = np.asarray(multihost_utils.process_allgather(vec))
+    agree, detail = _consensus_rows(rows, keys, atol)
+    reference = {k: float(rows[0, i]) for i, k in enumerate(keys)}
+    return ConsensusResult(agree, reference, detail)
 
 
 def any_flag(value: bool) -> bool:

@@ -80,6 +80,14 @@ class DataLoader:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
+    def _select_rows(self, idxs):
+        """Hook: which rows of this batch's global index slice to
+        collate. Identity here; the PPO `_GroupChunkLoader` keeps only
+        its data group's strided rows so every host draws the SAME
+        shuffle stream (topology-invariant chunk composition) while
+        collating 1/G of the work."""
+        return idxs
+
     def __iter__(self) -> Iterator[Any]:
         order = np.arange(len(self.dataset))
         if self.shuffle:
@@ -88,7 +96,9 @@ class DataLoader:
             idxs = order[start : start + self.batch_size]
             if self.drop_last and len(idxs) < self.batch_size:
                 return
-            yield self.collate_fn([self.dataset[int(i)] for i in idxs])
+            yield self.collate_fn(
+                [self.dataset[int(i)] for i in self._select_rows(idxs)]
+            )
 
 
 class BasePipeline:

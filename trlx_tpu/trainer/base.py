@@ -55,8 +55,13 @@ from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import Clock, build_optimizer, logging, significant, to_scalar
 from trlx_tpu.utils.chaos import build_chaos
 from trlx_tpu.utils.checkpointing import (
+    TOPOLOGY_MANIFEST,
+    CheckpointCorruptError,
     CheckpointManager,
+    ElasticConfig,
     PreemptionHandler,
+    atomic_json_write,
+    verify_or_quarantine,
 )
 from trlx_tpu.utils.guardrails import build_monitor
 from trlx_tpu.utils.resilient import (
@@ -176,8 +181,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.nth_evaluation = 0
         self.best_reward = -float("inf")
         self.total_steps = train.total_steps
+        # elastic recovery: integrity manifests + topology-change resume
+        self.elastic = ElasticConfig.from_dict(train.elastic)
         self.ckpt_manager = CheckpointManager(
-            train.checkpoint_dir, keep_last_n=train.keep_last_n
+            train.checkpoint_dir, keep_last_n=train.keep_last_n,
+            integrity=self.elastic.integrity,
         )
         self.preemption = PreemptionHandler()
         self._bad_steps = 0  # consecutive non-finite-loss steps
@@ -226,6 +234,9 @@ class TPUBaseTrainer(BaseRLTrainer):
         self._measured_forward_times = {}  # timing_split probes by batch shape
         self._seen_step_shapes = set()  # batch shapes whose step has compiled
         self._generate_fns: Dict[Tuple, Callable] = {}
+        # cross-host consistency watchdog (guardrails.consistency_every)
+        self._fingerprint_fn = None  # jitted replicated state reduction
+        self._consistency_counter = 0
 
     # ------------------------------------------------------------------
     # model setup
@@ -1507,7 +1518,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             self.save_pretrained(os.path.join(tmp_dir, "hf_model"))
 
         try:
-            self.ckpt_manager.commit(name, write)
+            final_path = self.ckpt_manager.commit(name, write)
         except Exception as e:
             # the manager's protocol guarantees a failed commit is never
             # discoverable (torn tmp_ dir only) and aborts consistently
@@ -1524,6 +1535,14 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
             return
         self._ckpt_commit_failures = 0
+        if self.chaos is not None and self.chaos.consult("ckpt_corrupt"):
+            # chaos: silent post-commit storage corruption (a bad DCN
+            # write). The consult advances on EVERY host so the
+            # schedule stays deterministic; only the primary touches
+            # the shared filesystem. Recovery is the integrity
+            # manifest's job at the next load.
+            if mh.is_main():
+                self.chaos.corrupt_checkpoint(final_path)
 
     def _commit_final_checkpoint(self, reason: str) -> None:
         """Commit the current step's checkpoint before the run exits —
@@ -1643,6 +1662,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         was requeued / state was rolled back); raises on abort. Called
         once per cycle (fused block / optimizer step) at a point where
         no new device work has been dispatched."""
+        # cross-host consistency watchdog first: a detected divergence
+        # must join this cycle's trips (and the any_flag agreement
+        # below) rather than waiting a cycle
+        self._maybe_check_consistency()
         if mh.is_multihost():
             # lockstep: most signals derive from globally-reduced stats
             # and trip identically everywhere, but per-cycle wall time
@@ -1676,6 +1699,105 @@ class TPUBaseTrainer(BaseRLTrainer):
                 "recover — relaunch resumes from the last good checkpoint"
             )
         return False
+
+    # -- cross-host consistency watchdog --------------------------------
+
+    def _extra_fingerprint(self) -> Dict[str, float]:
+        """Subclass hook: extra host-side scalars folded into the
+        consistency fingerprint (PPO adds its prompt cursor and KL
+        controller value). Every value must be exactly representable in
+        float32 and derived from lockstep state."""
+        return {}
+
+    def _consistency_fingerprint(self) -> Dict[str, float]:
+        """A few scalars that must be IDENTICAL on every host of a
+        healthy SPMD run: global reductions over params + opt_state
+        (computed in-graph, replicated — on multihost the all-reduce
+        itself is part of the check), plus the step counter, a PRNG-key
+        hash and any trainer cursors. Cheap by construction: one tiny
+        jitted reduction and one small host fetch per check."""
+        if self._fingerprint_fn is None:
+
+            def fp(params, opt_state):
+                def reduce_tree(tree):
+                    tot = jnp.float32(0.0)
+                    l1 = jnp.float32(0.0)
+                    for leaf in jax.tree_util.tree_leaves(tree):
+                        x = jnp.asarray(leaf)
+                        if not jnp.issubdtype(x.dtype, jnp.floating):
+                            continue
+                        x = x.astype(jnp.float32)
+                        tot = tot + jnp.sum(x)
+                        l1 = l1 + jnp.sum(jnp.abs(x))
+                    return tot, l1
+
+                p_sum, p_l1 = reduce_tree(params)
+                o_sum, o_l1 = reduce_tree(opt_state)
+                return jnp.stack([p_sum, p_l1, o_sum, o_l1])
+
+            from trlx_tpu.parallel.mesh import replicated_sharding
+
+            self._fingerprint_fn = jax.jit(
+                fp, out_shardings=replicated_sharding(self.mesh)
+            )
+        with self.mesh:
+            vec = np.asarray(self._fingerprint_fn(self.params, self.opt_state))
+        out = {
+            "params_sum": float(vec[0]),
+            "params_l1": float(vec[1]),
+            "opt_sum": float(vec[2]),
+            "opt_l1": float(vec[3]),
+            "iter": float(self.iter_count),
+            # key-data hash folded into float32's exact-integer range
+            "rng": float(
+                int(np.asarray(self._pack_rng(), np.uint64).sum()) % (1 << 20)
+            ),
+        }
+        out.update(self._extra_fingerprint())
+        # values ride the consensus gather as float32: fold everything
+        # through it up front so local-vs-reference compares are exact
+        return {k: float(np.float32(v)) for k, v in out.items()}
+
+    def _maybe_check_consistency(self) -> None:
+        """Every ``guardrails.consistency_every`` cycles: fingerprint
+        the local state and compare it against the fleet consensus
+        (``multihost.consensus``). Divergence — one host's values
+        departing the agreed reference — trips the escalation ladder
+        like any other health signal instead of letting the host drift
+        until a shape error or silent reward collapse. The chaos
+        ``host_divergence`` fault perturbs THIS host's view after the
+        gather, so the single-host simulation detects it the same way a
+        peer would in a real fleet."""
+        every = self.guardrails.cfg.consistency_every
+        if not self.guardrails.enabled or every <= 0:
+            return
+        self._consistency_counter += 1
+        if self._consistency_counter % every:
+            return
+        local = self._consistency_fingerprint()
+        result = mh.consensus(local, atol=self.guardrails.cfg.consistency_atol)
+        if self.chaos is not None and self.chaos.consult("host_divergence"):
+            local = self.chaos.perturb_fingerprint(local)
+        detail = result.detail
+        if result.agree:
+            # same agreement predicate as the cross-host row compare
+            # (mh.values_agree): identical-NaN state is a fleet-wide
+            # health problem for the loss guards, not a divergence
+            atol = self.guardrails.cfg.consistency_atol
+            drifted = [
+                f"{k}={local[k]!r} != consensus {result.reference[k]!r}"
+                for k in sorted(local)
+                if not mh.values_agree(
+                    local[k], result.reference.get(k, float("nan")), atol
+                )
+            ]
+            detail = "; ".join(drifted[:8])
+        if not result.agree or detail:
+            self.guardrails.trip(
+                "consistency",
+                f"cross-host state fingerprint diverged at step "
+                f"{self.iter_count}: {detail or 'rows disagree'}",
+            )
 
     def _requeue_poisoned_batch(self) -> bool:
         """Hook: discard the current (poisoned) training batch and
@@ -1741,26 +1863,61 @@ class TPUBaseTrainer(BaseRLTrainer):
         prompts replay) — exactly as a process relaunch would, but
         in-process, losing at most checkpoint_interval steps. Commits
         are health-gated, so "latest resumable" is also "last good"."""
-        path = self.ckpt_manager.latest_resumable()
-        if mh.is_multihost():
-            # stale shared-filesystem views must not pick different
-            # checkpoints per host: process 0's discovery wins
-            path = mh.allgather_object(path)[0]
+        def discover():
+            path = self.ckpt_manager.latest_resumable()
+            if mh.is_multihost():
+                # stale shared-filesystem views must not pick different
+                # checkpoints per host: process 0's discovery wins
+                path = mh.allgather_object(path)[0]
+            return path
+
+        path = discover()
         if path is None:
+            # nothing to restore: leave the live data stream UNTOUCHED
+            # (resetting it here would clobber the prompt cursor of a
+            # run that keeps training)
             logger.error(
                 "guardrails: rollback requested but no resumable "
-                "checkpoint exists under %s — continuing without rollback "
-                "(the ladder will escalate if the run stays unhealthy)",
-                self.config.train.checkpoint_dir,
+                "checkpoint exists under %s — continuing without "
+                "rollback (the ladder will escalate if the run stays "
+                "unhealthy)", self.config.train.checkpoint_dir,
             )
             return False
-        logger.warning(
-            "guardrails: auto-rollback to %s (discarding the diverged "
-            "live state at step %d)", path, self.iter_count,
-        )
         self._abandon_prefetch()
         self._reset_data_stream()
-        self.load(path)
+        while True:
+            logger.warning(
+                "guardrails: auto-rollback to %s (discarding the diverged "
+                "live state at step %d)", path, self.iter_count,
+            )
+            try:
+                self.load(path)
+                break
+            except CheckpointCorruptError as e:
+                # load() already quarantined the directory (renamed
+                # *.corrupt), so re-discovery cannot hand it back:
+                # fall back to the previous committed step instead of
+                # aborting on poison
+                logger.error(
+                    "guardrails: rollback target was corrupt and has "
+                    "been quarantined (%s); falling back to the "
+                    "previous committed checkpoint", e,
+                )
+                path = discover()
+                if path is None:
+                    # every candidate was poison: nothing restorable.
+                    # The data stream was already rebuilt from zero (a
+                    # load was expected to fast-forward it), so the
+                    # continuing run replays prompts from the stream
+                    # start — cursor and stream stay self-consistent,
+                    # and the alternative was crashing on poison.
+                    logger.error(
+                        "guardrails: no earlier resumable checkpoint "
+                        "remains after quarantine — continuing without "
+                        "rollback; the prompt stream was rebuilt from "
+                        "zero, so subsequent cycles replay prompts",
+                    )
+                    return False
         # the restored arrays are fresh buffers: drop the jitted steps
         # whose output shardings were pinned to the donated originals
         self._train_step = None
@@ -2059,21 +2216,151 @@ class TPUBaseTrainer(BaseRLTrainer):
                 ),
             }
             state.update(self._extra_state())
-            state_fp = os.path.join(directory, "state.json")
-            tmp_fp = state_fp + ".tmp"
-            with open(tmp_fp, "w") as f:
-                json.dump(state, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp_fp, state_fp)
+            atomic_json_write(os.path.join(directory, "state.json"), state)
+            self._write_topology_manifest(directory)
 
-    def load(self, directory: Optional[str] = None) -> None:
+    def _topology_manifest(self) -> Dict[str, Any]:
+        """The world that saved this checkpoint: mesh axis sizes, host
+        and data-group counts, the global batch, and every state leaf's
+        GLOBAL shape + dtype. Global shapes are mesh-independent, so a
+        resume onto a different topology validates architecture against
+        them (a shape mismatch is a model change, not a topology
+        change) and reshards everything else onto the current mesh."""
+        leaves = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            self._state_tree()
+        )[0]:
+            key = jax.tree_util.keystr(path)
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = str(np.asarray(leaf).dtype if not hasattr(leaf, "dtype")
+                        else leaf.dtype)
+            leaves[key] = {"shape": list(shape), "dtype": dtype}
+        return {
+            "format": 1,
+            "mesh": {ax: int(s) for ax, s in self.mesh.shape.items()},
+            "process_count": mh.process_count(),
+            "data_group_count": mh.data_group_count(self.mesh),
+            "global_batch_size": int(self.config.train.batch_size),
+            "leaves": leaves,
+        }
+
+    def _write_topology_manifest(self, directory: str) -> None:
+        atomic_json_write(
+            os.path.join(directory, TOPOLOGY_MANIFEST),
+            self._topology_manifest(),
+        )
+
+    def _validate_topology(self, directory: str) -> None:
+        """Elastic-resume gate, run BEFORE the orbax restore: compare
+        the checkpoint's topology manifest against the live run.
+
+        - per-leaf GLOBAL shape/dtype mismatches are an ARCHITECTURE
+          change and always a hard error (restoring would silently
+          broadcast/garble leaves);
+        - mesh / host-count / data-group differences are a TOPOLOGY
+          change: logged and allowed (the restore reshards onto the
+          current mesh; the global PRNG key restores unchanged — it is
+          host-independent by construction — and the PPO prompt stream
+          is re-split via the group-invariant chunk schedule) unless
+          ``train.elastic.allow_topology_change`` is false.
+        Pre-elastic checkpoints (no manifest) restore as before, with a
+        note."""
+        fp = os.path.join(directory, TOPOLOGY_MANIFEST)
+        if not os.path.isfile(fp):
+            logger.info(
+                "checkpoint %s has no topology manifest (pre-elastic "
+                "save): resuming without topology validation", directory,
+            )
+            return
+        with open(fp) as f:
+            saved = json.load(f)
+        live = self._topology_manifest()
+        mismatched = []
+        saved_leaves = saved.get("leaves", {})
+        for key, meta in live["leaves"].items():
+            got = saved_leaves.get(key)
+            if got is None:
+                mismatched.append(f"{key}: missing from checkpoint")
+            elif list(got["shape"]) != meta["shape"] or got["dtype"] != meta["dtype"]:
+                mismatched.append(
+                    f"{key}: checkpoint {got['shape']}/{got['dtype']} vs "
+                    f"live {meta['shape']}/{meta['dtype']}"
+                )
+        extra = set(saved_leaves) - set(live["leaves"])
+        if extra:
+            mismatched.append(f"checkpoint-only leaves: {sorted(extra)[:4]}")
+        if mismatched:
+            raise ValueError(
+                f"checkpoint {directory} does not match the live model "
+                f"ARCHITECTURE ({len(mismatched)} leaf mismatches; first: "
+                f"{mismatched[0]}) — topology-change resume reshards the "
+                "same global arrays onto a new mesh, it cannot convert "
+                "between different models/optimizers"
+            )
+        topo_keys = ("mesh", "process_count", "data_group_count")
+        changed = {
+            k: (saved.get(k), live[k])
+            for k in topo_keys
+            if saved.get(k) != live[k]
+        }
+        if changed:
+            if not self.elastic.allow_topology_change:
+                raise ValueError(
+                    f"checkpoint {directory} was saved under a different "
+                    f"topology ({changed}) and "
+                    "train.elastic.allow_topology_change is false"
+                )
+            logger.warning(
+                "elastic resume: checkpoint %s was saved under a "
+                "different topology (%s) — restoring onto the current "
+                "mesh (params/opt-state resharded; PRNG key restored "
+                "unchanged; data cursors re-split)", directory,
+                "; ".join(
+                    f"{k}: {old} -> {new}" for k, (old, new) in changed.items()
+                ),
+            )
+        if saved.get("global_batch_size") != live["global_batch_size"]:
+            logger.warning(
+                "elastic resume: global batch size changed (%s -> %s); "
+                "iter_count-derived schedules (LR, shuffles) keep their "
+                "step semantics but cover different sample counts",
+                saved.get("global_batch_size"), live["global_batch_size"],
+            )
+
+    def load(
+        self,
+        directory: Optional[str] = None,
+        quarantine_corrupt: bool = True,
+    ) -> None:
         import orbax.checkpoint as ocp
 
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
+        # integrity gate FIRST (before any state mutation): a shard
+        # flipped by bad storage must never reach params. On mismatch
+        # the checkpoint is quarantined (*.corrupt) and
+        # CheckpointCorruptError propagates — the auto-resume and
+        # auto-rollback paths catch it and fall back to the previous
+        # committed step. ``quarantine_corrupt=False`` (user-pinned
+        # explicit paths) raises without the rename.
+        if self.elastic.verify_integrity:
+            verify_or_quarantine(directory, do_quarantine=quarantine_corrupt)
+        # then the elastic-resume gate: global-shape/dtype (architecture)
+        # validation and the topology-change decision, also pre-mutation
+        self._validate_topology(directory)
         ckptr = ocp.PyTreeCheckpointer()
         template = self._state_tree()
-        restored = ckptr.restore(os.path.join(directory, "state"), item=template)
+        # restore WITH the live template's shardings (RestoreArgs):
+        # orbax then materializes each leaf directly onto the CURRENT
+        # mesh — the topology-change path — instead of reading the
+        # saved run's sharding file (which references a mesh that may
+        # no longer exist) and deferring the reshard to us
+        from orbax.checkpoint import checkpoint_utils
+
+        restore_args = checkpoint_utils.construct_restore_args(template)
+        restored = ckptr.restore(
+            os.path.join(directory, "state"), item=template,
+            restore_args=restore_args,
+        )
 
         # Re-materialize the restored leaves as fresh XLA-ALLOCATED
         # buffers on the live arrays' shardings. The train step DONATES
@@ -2091,6 +2378,11 @@ class TPUBaseTrainer(BaseRLTrainer):
 
         def placed(tmpl, value):
             if isinstance(tmpl, jax.Array):
+                if isinstance(value, jax.Array):
+                    # already device-resident (restore_args placed it on
+                    # the live mesh); the jitted copy below still
+                    # re-materializes it into XLA-owned buffers
+                    return value
                 return jax.device_put(np.asarray(value), tmpl.sharding)
             return value
 

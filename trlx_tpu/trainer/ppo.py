@@ -41,6 +41,7 @@ from trlx_tpu.ops.ppo import gae_advantages_and_returns, ppo_loss
 from trlx_tpu.parallel import data_sharding, shard_params
 from trlx_tpu.parallel import multihost as mh
 from trlx_tpu.parallel.mesh import replicated_sharding, vector_sharding
+from trlx_tpu.pipeline import DataLoader
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base import TPUBaseTrainer
@@ -63,6 +64,46 @@ def _masked_kl_stats(kl, row_valid):
     mean_kl = (kl.sum(axis=1) * row_valid).sum() / n_valid
     mean_kl_per_token = (kl * row_valid[:, None]).sum() / (n_valid * kl.shape[1])
     return mean_kl, mean_kl_per_token
+
+
+class _GroupChunkLoader(DataLoader):
+    """Per-data-group view of the GLOBAL prompt-chunk order: every
+    process draws the SAME shuffle stream a plain ``DataLoader`` over
+    the full prompt list would (one shuffle of the global index order
+    per epoch, same RNG consumption), chunks it at the global chunk
+    size, then collates ONLY this group's strided rows of each chunk.
+
+    This is what makes the prompt stream topology-invariant: the chunk
+    composition is fixed by (seed, chunk_size) alone, so a checkpoint
+    cursor saved under G data groups replays the exact same prompts
+    under G' groups — while each host still pays only 1/G of the
+    per-pull collation (the index slice happens BEFORE collate).
+    Groups are padded to equal row counts by wrapping within the chunk
+    (SPMD lockstep needs equal-shape pulls; the repeated row is the
+    same compromise `shard_list` made)."""
+
+    def __init__(
+        self, dataset, batch_size, collate_fn, group, group_count,
+        seed, shuffle=True, drop_last=True,
+    ):
+        super().__init__(
+            dataset, batch_size, collate_fn=collate_fn, shuffle=shuffle,
+            drop_last=drop_last, seed=seed,
+        )
+        self.group = group
+        self.group_count = group_count
+
+    def _select_rows(self, idxs) -> List[int]:
+        # DataLoader.__iter__ hook: shuffle/chunking stay the base
+        # class's (the parity-critical RNG stream is written ONCE);
+        # only the row selection differs
+        local = [int(i) for i in idxs[self.group :: self.group_count]]
+        want = (len(idxs) + self.group_count - 1) // self.group_count
+        i = 0
+        while len(local) < want:
+            local.append(int(idxs[(self.group + i * self.group_count) % len(idxs)]))
+            i += 1
+        return local
 
 
 class AdaptiveKLController:
@@ -876,21 +917,37 @@ class TPUPPOTrainer(TPUBaseTrainer):
         draws its shuffles from the config seed, so a rebuild replays
         the exact chunk sequence — fast-forwarding then restores any
         cursor, including one BEHIND the live position (streams only
-        advance; rewind = rebuild + replay)."""
-        # multi-host: each process iterates its own strided slice of the
-        # prompts at chunk_size/P rows; generation reassembles the global
-        # chunk (the reference scatters from rank 0 instead —
-        # accelerate_ppo_trainer.py:292-341)
-        pipeline = mh.shard_pipeline(self._prompt_pipeline, self.mesh)
-        chunk = max(self.config.method.chunk_size // mh.data_group_count(self.mesh), 1)
-        # drop_last keeps chunk shapes static: one compiled sampler
-        loader = pipeline.create_loader(
-            chunk, shuffle=True, drop_last=True,
-            seed=self.config.train.seed,
-        )
-        if len(loader) == 0:
+        advance; rewind = rebuild + replay).
+
+        TOPOLOGY-INVARIANT: the stream is one GLOBAL shuffle over the
+        full prompt list, chunked at the global chunk_size; each data
+        group then collates only its own rows of every global chunk
+        (`_GroupChunkLoader`). The chunk sequence — and therefore the
+        saved `prompt_batches_consumed` cursor — means the SAME prompts
+        regardless of how many hosts/data groups the run has, so an
+        elastic resume onto a different topology neither drops nor
+        double-trains a prompt. (The previous scheme shuffled each
+        group's strided slice independently, which re-partitioned the
+        stream whenever the group count changed.) Single-group runs are
+        byte-identical to the old behavior: same loader, same RNG
+        stream, no slicing."""
+        pipeline = self._prompt_pipeline
+        # drop_last keeps chunk shapes static: one compiled sampler;
+        # a prompt list smaller than one chunk degrades to a single
+        # kept-ragged chunk (the historical len(loader)==0 fallback)
+        chunk, drop_last = self.config.method.chunk_size, True
+        if len(pipeline) < chunk:
+            chunk, drop_last = len(pipeline), False
+        group, group_count = mh.data_group_info(self.mesh)
+        if group_count > 1:
+            loader = _GroupChunkLoader(
+                pipeline, chunk, pipeline.collate, group, group_count,
+                seed=self.config.train.seed, drop_last=drop_last,
+            )
+        else:
             loader = pipeline.create_loader(
-                len(pipeline), shuffle=True, seed=self.config.train.seed
+                chunk, shuffle=True, drop_last=drop_last,
+                seed=self.config.train.seed,
             )
         self.prompt_iterator = infinite_loader(loader)
         self._prompt_batches_consumed = 0
@@ -995,6 +1052,16 @@ class TPUPPOTrainer(TPUBaseTrainer):
             next(self.prompt_iterator)
         self._prompt_batches_consumed += skip
 
+    def _extra_fingerprint(self):
+        """Consistency-watchdog extras: the rollout-data cursor and the
+        KL controller — the two pieces of host-side PPO state that MUST
+        advance in lockstep across hosts (a drifted cursor silently
+        trains different prompts per host)."""
+        return {
+            "prompt_cursor": float(self._prompt_batches_consumed),
+            "kl_ctl": float(self.kl_ctl.value),
+        }
+
     # -- resumable state -------------------------------------------------
 
     def _extra_state(self):
@@ -1016,6 +1083,10 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 if self._prefetched_gen is not None
                 else self._prompt_batches_consumed
             ),
+            # the cursor counts GLOBAL chunks of the topology-invariant
+            # stream (this marker lets a restore distinguish cursors
+            # saved under the old per-group-shuffle scheme)
+            "prompt_stream": "global-chunks-v1",
         }
 
     def _restore_extra_state(self, state) -> None:
@@ -1033,6 +1104,21 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 std=jnp.float32(rm["std"]), count=jnp.float32(rm["count"]),
             )
         self._resume_prompt_cursor = state.get("prompt_batches_consumed", 0)
+        if (
+            self._resume_prompt_cursor
+            and state.get("prompt_stream") != "global-chunks-v1"
+            and mh.data_group_count(self.mesh) > 1
+        ):
+            # pre-elastic multihost checkpoints counted chunks of
+            # per-group shuffled streams; the invariant stream replays
+            # a (deterministic) different partitioning from the same
+            # cursor — continue, but say so
+            logger.warning(
+                "restored prompt cursor %d predates the "
+                "topology-invariant stream: the replayed chunk "
+                "composition differs from the saving run's on multi-"
+                "group meshes", self._resume_prompt_cursor,
+            )
         self._fast_forward_prompts()
 
     def prepare_learning(self) -> None:

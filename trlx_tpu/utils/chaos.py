@@ -24,6 +24,15 @@ to an exact cycle/call):
                   reward_fn invocation, retries included)
   ckpt_fail       raise ``ChaosFault`` from the checkpoint write
                   function; consulted once per commit attempt.
+  ckpt_corrupt    bit-flip one byte of a committed shard AFTER the
+                  commit published (the silent-DCN-write failure mode);
+                  consulted once per successful commit. Recovery is the
+                  integrity manifest's job: the next load quarantines
+                  the checkpoint and falls back.
+  host_divergence perturb THIS host's consistency fingerprint before
+                  the ``multihost.consensus`` compare (simulates one
+                  host's state silently drifting); consulted once per
+                  consistency check (train.guardrails.consistency_every).
 
 Schedule entries select by count: ``{"fault": "nan_loss", "at": 2}``
 fires on the 2nd consult (1-based), ``{"fault": ..., "at": 2, "span": 3}``
@@ -35,6 +44,7 @@ consult order (which is fixed by the trainer's control flow).
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -53,6 +63,8 @@ FAULT_SITES = (
     "reward_timeout",
     "reward_error",
     "ckpt_fail",
+    "ckpt_corrupt",
+    "host_divergence",
 )
 
 
@@ -163,6 +175,40 @@ class ChaosMonkey:
             raise ChaosFault("chaos: injected reward exception")
         if self.consult("reward_timeout"):
             sleep(self.reward_delay)
+
+    def corrupt_checkpoint(self, directory: str) -> Optional[str]:
+        """``ckpt_corrupt`` body: flip one bit in the middle of the
+        first (sorted) non-empty file under the committed checkpoint's
+        ``state/`` tree — the smallest possible silent storage
+        corruption. Deterministic given the directory contents. Returns
+        the path flipped (None when nothing qualified)."""
+        state_dir = os.path.join(directory, "state")
+        roots = [state_dir if os.path.isdir(state_dir) else directory]
+        victims = []
+        for root in roots:
+            for r, _d, names in os.walk(root):
+                for name in sorted(names):
+                    fp = os.path.join(r, name)
+                    if os.path.getsize(fp) > 0:
+                        victims.append(fp)
+        if not victims:
+            return None
+        victim = sorted(victims)[0]
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([byte[0] ^ 0x01]))
+        logger.warning("chaos: bit-flipped committed shard %s", victim)
+        return victim
+
+    def perturb_fingerprint(self, fingerprint):
+        """``host_divergence`` body: return a copy of this host's
+        consistency fingerprint with every value deterministically
+        shifted — what a silently drifted host's state looks like to
+        the consensus compare."""
+        return {k: float(v) + 1.0 + abs(float(v)) for k, v in fingerprint.items()}
 
     def reward_fault_post(self, out):
         """Consulted with the reward call's result: substitutes NaNs for

@@ -20,6 +20,15 @@ module owns the host-side half of that story:
   retry_call          exponential backoff (cap + jitter) around the two
                       external calls in the loop — ``tracker.log`` and
                       the user reward function.
+  integrity manifest  per-file sha256 (``integrity.json``) written
+                      inside the atomic commit; ``verify_or_quarantine``
+                      checks it before a load and QUARANTINES a
+                      mismatching checkpoint (rename to ``*.corrupt``,
+                      never delete) so auto-resume/rollback fall back
+                      to the previous committed step.
+  ElasticConfig       parsed ``train.elastic`` section: the knobs for
+                      integrity manifests and topology-change resume
+                      (docs/robustness.md "Elastic recovery").
 
 The device-side half (what goes *into* a checkpoint: params, opt_state,
 ``iter_count``, ``best_reward``, the trainer PRNG key and per-trainer
@@ -28,21 +37,225 @@ cursors) lives in ``trainer/base.py save()/load()``.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import json
 import os
 import re
 import shutil
 import signal
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
 
 COMMIT_MARKER = "COMMIT"
+INTEGRITY_MANIFEST = "integrity.json"
+TOPOLOGY_MANIFEST = "topology.json"
+QUARANTINE_SUFFIX = ".corrupt"
 _TMP_PREFIX = "tmp_"
 _STEP_RE = re.compile(r"^checkpoint_(\d+)$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification. The directory has
+    been QUARANTINED (renamed ``*.corrupt``, never deleted) so discovery
+    skips it and a human can postmortem; callers on the auto-resume /
+    auto-rollback paths fall back to the previous committed step."""
+
+    def __init__(self, directory: str, problems: List[str]):
+        self.directory = directory
+        self.problems = problems
+        super().__init__(
+            f"checkpoint {directory} failed integrity verification "
+            f"({len(problems)} problems; first: {problems[0] if problems else '?'})"
+        )
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    """Parsed ``train.elastic`` section (plain dict in YAML).
+
+    integrity               write a per-file sha256 manifest
+                            (``integrity.json``) inside every atomic
+                            checkpoint commit.
+    verify_integrity        verify the manifest before trainer.load()
+                            touches the orbax tree; a mismatch
+                            quarantines the checkpoint (``*.corrupt``)
+                            and auto-resume/auto-rollback fall back to
+                            the previous committed step.
+    allow_topology_change   permit resuming a checkpoint whose topology
+                            manifest (mesh axes / host count / data
+                            groups) differs from the current run —
+                            the elastic-recovery path. False makes a
+                            topology mismatch a hard error.
+    """
+
+    integrity: bool = True
+    verify_integrity: bool = True
+    allow_topology_change: bool = True
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ElasticConfig":
+        d = dict(d or {})
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"train.elastic: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        return cls(**d)
+
+
+# -- integrity manifest ------------------------------------------------
+
+# files that can't be covered by the manifest: the manifest itself, and
+# the commit marker (written after the manifest, outside the hash set)
+_MANIFEST_EXCLUDE = (INTEGRITY_MANIFEST, COMMIT_MARKER, COMMIT_MARKER + ".tmp")
+
+
+def _hash_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def compute_integrity_manifest(directory: str) -> Dict[str, Any]:
+    """Per-file sha256 over everything under ``directory`` (relative
+    paths, sorted), excluding the manifest and the commit marker."""
+    files: Dict[str, str] = {}
+    directory = os.path.abspath(directory)
+    for root, _dirs, names in os.walk(directory):
+        for name in names:
+            fp = os.path.join(root, name)
+            rel = os.path.relpath(fp, directory)
+            if rel in _MANIFEST_EXCLUDE:
+                continue
+            files[rel] = _hash_file(fp)
+    return {
+        "format": 1,
+        "algo": "sha256",
+        "files": dict(sorted(files.items())),
+    }
+
+
+def write_integrity_manifest(directory: str) -> None:
+    """Compute + write ``integrity.json`` into ``directory``."""
+    atomic_json_write(
+        os.path.join(directory, INTEGRITY_MANIFEST),
+        compute_integrity_manifest(directory),
+    )
+
+
+# what trainer.load() actually reads: verifying only these on the load
+# path keeps a resume/rollback from re-hashing the (potentially
+# many-GB) hf_model/ deploy export it never touches — the offline
+# validator (scripts/verify_ckpt.py) still checks everything
+LOAD_SCOPE = ("state/", "state.json", TOPOLOGY_MANIFEST)
+
+
+def verify_integrity(
+    directory: str, scope: Optional[Tuple[str, ...]] = None
+) -> Tuple[str, List[str]]:
+    """Check ``directory`` against its integrity manifest.
+
+    Returns ``(status, problems)`` with status one of:
+      "ok"           every hashed file matches,
+      "no-manifest"  pre-elastic checkpoint (nothing to check against),
+      "corrupt"      at least one mismatch/missing file (listed).
+    Files absent from the manifest are NOT checked (a later tool may
+    legitimately add sidecars — e.g. a backfilled manifest itself);
+    only manifest-covered content decides corruption. ``scope`` limits
+    the check to manifest entries equal to or under the given relative
+    prefixes (e.g. :data:`LOAD_SCOPE` on the resume path)."""
+    fp = os.path.join(directory, INTEGRITY_MANIFEST)
+    if not os.path.isfile(fp):
+        return "no-manifest", []
+    try:
+        with open(fp) as f:
+            manifest = json.load(f)
+        expected = manifest["files"]
+    except Exception as e:
+        return "corrupt", [f"{fp}: manifest unreadable ({e})"]
+    if scope is not None:
+        expected = {
+            rel: want
+            for rel, want in expected.items()
+            if any(rel == p or rel.startswith(p) for p in scope)
+        }
+    problems = []
+    for rel, want in expected.items():
+        target = os.path.join(directory, rel)
+        if not os.path.isfile(target):
+            problems.append(f"{rel}: missing (manifest expects {want[:12]}…)")
+            continue
+        got = _hash_file(target)
+        if got != want:
+            problems.append(
+                f"{rel}: sha256 mismatch (expected {want[:12]}…, "
+                f"got {got[:12]}…)"
+            )
+    return ("corrupt" if problems else "ok"), problems
+
+
+def quarantine(directory: str) -> str:
+    """Rename a corrupt checkpoint to ``<dir>.corrupt`` (unique suffix
+    on collision). NEVER deletes: the quarantined tree is postmortem
+    evidence. Discovery skips it (the step-name regex no longer
+    matches), so auto-resume/rollback fall back to the previous
+    committed step. Returns the quarantine path."""
+    directory = os.path.abspath(directory.rstrip(os.sep))
+    target = directory + QUARANTINE_SUFFIX
+    if os.path.exists(target):
+        import uuid
+
+        target = f"{directory}{QUARANTINE_SUFFIX}.{uuid.uuid4().hex[:8]}"
+    os.rename(directory, target)
+    _fsync_path(os.path.dirname(directory))
+    logger.error(
+        "quarantined corrupt checkpoint: %s -> %s (kept for postmortem; "
+        "discovery will skip it)", directory, target,
+    )
+    return target
+
+
+def verify_or_quarantine(
+    directory: str, do_quarantine: bool = True
+) -> None:
+    """Multihost-safe integrity gate for trainer.load(): the primary
+    verifies the manifest (load-relevant files only — :data:`LOAD_SCOPE`)
+    and on mismatch quarantines; every process agrees on the verdict
+    and raises :class:`CheckpointCorruptError` together. Pre-elastic
+    checkpoints (no manifest) pass with a note.
+
+    ``do_quarantine=False`` raises WITHOUT renaming — for a checkpoint
+    the user pinned explicitly, where a destructive rename would turn a
+    possibly-transient storage mismatch into a permanently broken
+    path (the auto-resume/rollback fallback paths keep the rename: it
+    is what lets re-discovery fall back a step)."""
+    from trlx_tpu.parallel import multihost as mh
+
+    problems: List[str] = []
+    if mh.is_main():
+        status, problems = verify_integrity(directory, scope=LOAD_SCOPE)
+        if status == "no-manifest":
+            logger.info(
+                "checkpoint %s has no integrity manifest (pre-elastic "
+                "save); skipping verification — backfill one with "
+                "`scripts/verify_ckpt.py --deep --write-manifest`",
+                directory,
+            )
+        elif status == "corrupt" and do_quarantine:
+            quarantine(directory)
+    if mh.is_multihost():
+        problems = mh.allgather_object(problems)[0]
+    if problems:
+        raise CheckpointCorruptError(directory, problems)
 
 
 
@@ -66,6 +279,21 @@ def fsync_tree(directory: str) -> None:
         for name in files:
             _fsync_path(os.path.join(root, name))
         _fsync_path(root)
+
+
+def atomic_json_write(path: str, obj) -> None:
+    """Write JSON via tmp-file + fsync + ``os.replace`` + parent-dir
+    fsync: a crash at any point leaves either the previous file or the
+    complete new one, never a truncation. The ONE implementation of the
+    pattern — state.json, the commit marker and both manifests all go
+    through here so their crash-safety cannot drift apart."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_path(os.path.dirname(path))
 
 
 def is_committed(directory: str) -> bool:
@@ -97,10 +325,14 @@ class CheckpointManager:
         checkpoint_dir: str,
         keep_last_n: Optional[int] = None,
         best_subdir: str = "best_checkpoint",
+        integrity: bool = True,
     ):
         self.root = os.path.abspath(checkpoint_dir)
         self.keep_last_n = keep_last_n
         self.best_subdir = best_subdir
+        # write a per-file sha256 manifest inside every commit (the
+        # load-time half — verify + quarantine — is the trainer's call)
+        self.integrity = integrity
 
     # -- commit ----------------------------------------------------------
 
@@ -163,6 +395,20 @@ class CheckpointManager:
         pub_err: Optional[BaseException] = None
         if mh.is_main():
             try:
+                if self.integrity:
+                    # the manifest hashes EVERY file the writers
+                    # produced (orbax shards included — on multi-host
+                    # the write agreement above guarantees they have
+                    # all landed on shared storage) and rides inside
+                    # the same atomic commit: a checkpoint is either
+                    # fully verifiable or not discoverable. Full
+                    # coverage (incl. hf_model/) is deliberate even
+                    # though the LOAD path only verifies LOAD_SCOPE:
+                    # the bytes were just written, so the hash runs
+                    # over page-cached data, and the offline validator
+                    # needs the export covered to certify a deploy
+                    # artifact. Set integrity=False to skip.
+                    write_integrity_manifest(tmp)
                 fsync_tree(tmp)
                 # re-commit of the same name (best_checkpoint, a
                 # preemption right after an interval save): move the old
@@ -203,13 +449,10 @@ class CheckpointManager:
 
     @staticmethod
     def _write_marker(directory: str, name: str) -> None:
-        marker_tmp = os.path.join(directory, COMMIT_MARKER + ".tmp")
-        with open(marker_tmp, "w") as f:
-            json.dump({"name": name, "time": time.time()}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(marker_tmp, os.path.join(directory, COMMIT_MARKER))
-        _fsync_path(directory)
+        atomic_json_write(
+            os.path.join(directory, COMMIT_MARKER),
+            {"name": name, "time": time.time()},
+        )
 
     # -- discovery -------------------------------------------------------
 

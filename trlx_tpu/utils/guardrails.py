@@ -76,6 +76,16 @@ class GuardrailConfig:
     cycle_time_factor  trip when a cycle's wall time exceeds factor *
                        the rolling median (0 disables) — a stuck host /
                        degraded interconnect shows up here first.
+    consistency_every  compare a cheap cross-host state fingerprint
+                       (param/opt-state reductions + iter/PRNG/cursor
+                       hashes, via ``multihost.consensus``) every N
+                       cycles; a disagreeing host trips the ladder
+                       instead of drifting until a shape error or
+                       silent reward collapse (0 disables).
+    consistency_atol   absolute tolerance for the fingerprint compare
+                       (0 = exact; the device reductions are
+                       deterministic in lockstep SPMD, so exact is the
+                       sound default).
     ladder             escalation rungs, a subset of
                        ``("log","requeue","lr_cut","rollback","abort")``
                        in order; consecutive unhealthy cycles walk up.
@@ -97,6 +107,8 @@ class GuardrailConfig:
     reward_sigma: float = 6.0
     grad_norm_max: float = 0.0
     cycle_time_factor: float = 0.0
+    consistency_every: int = 0
+    consistency_atol: float = 0.0
     ladder: Tuple[str, ...] = LADDER_ACTIONS
     lr_cut_factor: float = 0.5
     cooldown_cycles: int = 3
@@ -188,6 +200,10 @@ class GuardrailMonitor:
         self._cooldown = 0
         self.rollbacks = 0
         self.actions_taken: List[str] = []
+        # every trip signal ever raised, in order (tiny strings; lets
+        # tests/smokes assert e.g. that a consistency divergence was
+        # actually detected without scraping logs)
+        self.trip_history: List[str] = []
         # step of the last observation that tripped, for log context
         self._last_trip_step: Optional[int] = None
 
@@ -203,6 +219,16 @@ class GuardrailMonitor:
 
     def _trip(self, signal: str, detail: str) -> None:
         self._trips.append(Trip(signal, detail))
+        self.trip_history.append(signal)
+
+    def trip(self, signal: str, detail: str) -> None:
+        """Record an externally-detected trip (e.g. the trainer's
+        cross-host consistency check) so it escalates the ladder at the
+        next :meth:`pending_action` alongside the built-in signals."""
+        if not self.enabled:
+            return
+        self._observed += 1
+        self._trip(signal, detail)
 
     def observe_train(
         self,
