@@ -1049,7 +1049,12 @@ def bench_chaos() -> dict:
         f"expected a consistency-watchdog trip, saw "
         f"{trainer.guardrails.trip_history}"
     )
+    # hang-doctor leg: stall_rollout + stall_collective schedules must
+    # end in detection -> stack dump -> restorable emergency snapshot ->
+    # EXIT_STALLED, in child processes (the abort is a process exit)
+    stall = bench_chaos_stalls()
     return {
+        **stall,
         "chaos_completed_steps": int(trainer.iter_count),
         "chaos_rollbacks": int(trainer.guardrails.rollbacks),
         "chaos_actions": list(trainer.guardrails.actions_taken),
@@ -1061,6 +1066,171 @@ def bench_chaos() -> dict:
         "chaos_final_reward": round(float(final_reward), 4),
         "chaos_wall_s": round(wall, 2),
     }
+
+
+def _chaos_stall_config(ckpt_dir: str, fault: str):
+    """Tiny-PPO config for the hang-doctor smoke: the chaos ``fault``
+    site sleeps far past the watchdog deadlines, so the run must END by
+    detection (stack dump -> emergency snapshot -> EXIT_STALLED), not
+    by finishing. Deadlines leave room for cold compiles inside the
+    first phases; ``STALL_SLEEP_S`` dwarfs them so a completed sleep is
+    unambiguous watchdog failure."""
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    at = {"stall_rollout": 3, "stall_collective": 2}[fault]
+    return default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=8, eval_interval=100,
+            checkpoint_interval=1, seq_length=24, epochs=64,
+            tracker=None, checkpoint_dir=ckpt_dir, save_best=False,
+            external_retries=1, retry_base_delay=0.05,
+            guardrails=dict(enabled=True, loss_spike_sigma=0.0),
+            watchdog=dict(
+                enabled=True, default_deadline_s=120.0,
+                deadline_s={"rollout": STALL_DEADLINE_S,
+                            "fused_block": STALL_DEADLINE_S},
+                poll_interval_s=0.5,
+            ),
+            chaos=dict(
+                seed=0, stall_delay=STALL_SLEEP_S,
+                faults=[{"fault": fault, "at": at}],
+            ),
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    vocab_size=258, hidden_size=64, n_layer=2, n_head=2,
+                    n_positions=64,
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            overlap_rollouts=True,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                            do_sample=True),
+        ),
+    )
+
+
+STALL_DEADLINE_S = 45.0
+STALL_SLEEP_S = 600.0
+_STALL_FAULTS = ("stall_rollout", "stall_collective")
+
+
+def bench_chaos_stall_child(fault: str) -> None:
+    """Child body for ``--chaos-stall-child <fault>``: runs the tiny
+    PPO learn() with the stall schedule armed. The EXPECTED outcome is
+    that this process never returns from train() — the hang doctor
+    aborts it with EXIT_STALLED mid-sleep. Reaching the end means the
+    watchdog missed; exit 0 then tells the parent exactly that."""
+    _enable_compile_cache()
+    import trlx_tpu
+
+    ckpt_dir = os.environ["CHAOS_STALL_CKPT"]
+    config = _chaos_stall_config(ckpt_dir, fault)
+    prompts = ["hello world", "the cat", "a b", "xyz",
+               "what is", "I am", "go", "ok"]
+
+    def reward(samples, prompts, outputs, **kw):
+        return [float(len(o.split())) for o in outputs]
+
+    trlx_tpu.train(reward_fn=reward, prompts=prompts, config=config)
+    print("STALL-CHILD-COMPLETED")  # the watchdog failed to fire
+
+
+def bench_chaos_stalls() -> dict:
+    """Hang-doctor end-to-end proof (part of ``bench.py --chaos``): for
+    a ``stall_rollout`` and a ``stall_collective`` schedule, a child
+    process must (1) detect the stall within the configured deadline —
+    the injected sleep is ~13x the deadline, so a child that exits
+    before the sleep completes detected it, and the logged report's
+    silent-age says by how much — (2) write a restorable emergency
+    snapshot from the host-RAM shadow, and (3) exit with the "stalled"
+    exit class (EXIT_STALLED), distinguishable from a crash."""
+    import re
+    import shutil
+    import subprocess
+    import sys as _sys
+
+    from trlx_tpu.utils.watchdog import EXIT_STALLED
+
+    roots = {}
+    procs = {}
+    t0 = time.time()
+    for fault in _STALL_FAULTS:
+        root = os.path.join("/tmp", f"chaos_{fault}_ckpts")
+        shutil.rmtree(root, ignore_errors=True)
+        roots[fault] = root
+        env = dict(os.environ, CHAOS_STALL_CKPT=root, JAX_PLATFORMS="cpu")
+        procs[fault] = subprocess.Popen(
+            [_sys.executable, os.path.join(REPO, "bench.py"),
+             "--chaos-stall-child", fault],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+    out = {}
+    for fault, proc in procs.items():
+        try:
+            log, _ = proc.communicate(timeout=STALL_SLEEP_S - 60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise AssertionError(
+                f"{fault}: child still running as the injected sleep "
+                "neared completion — the watchdog never fired"
+            )
+        wall = time.time() - t0
+        assert proc.returncode == EXIT_STALLED, (
+            f"{fault}: expected the stalled exit class {EXIT_STALLED}, "
+            f"got {proc.returncode}:\n{log[-3000:]}"
+        )
+        assert "HANG DOCTOR: stall detected" in log, log[-3000:]
+        assert "MAIN — where the loop is wedged" in log, (
+            f"{fault}: stack dump missing from the stall report"
+        )
+        m = re.search(r"silent for ([0-9.]+)s \(deadline ([0-9.]+)s", log)
+        assert m, log[-2000:]
+        age, deadline = float(m.group(1)), float(m.group(2))
+        # detection within the configured deadline (+ poll/scheduling
+        # slack), nowhere near the injected sleep
+        assert age < deadline + 30, (fault, age, deadline)
+        snaps = [e for e in os.listdir(roots[fault])
+                 if e.startswith("emergency_checkpoint_")]
+        assert snaps, (
+            f"{fault}: no emergency snapshot in {roots[fault]}: "
+            f"{sorted(os.listdir(roots[fault]))}"
+        )
+        out[f"{fault}_exit"] = int(proc.returncode)
+        out[f"{fault}_detect_age_s"] = round(age, 1)
+        out[f"{fault}_snapshot"] = snaps[0]
+        out[f"{fault}_wall_s"] = round(wall, 1)
+
+    # the snapshot is RESTORABLE: a fresh trainer load()s it like any
+    # committed checkpoint (integrity manifest verified, state.json +
+    # PRNG + PPO cursors restored)
+    from trlx_tpu.utils.loading import get_trainer
+
+    fault = _STALL_FAULTS[0]
+    config = _chaos_stall_config(roots[fault], fault)
+    config = config.evolve(train=dict(chaos=None, watchdog={}))
+    trainer = get_trainer(config.train.trainer)(
+        config=config, reward_fn=lambda **kw: [0.0]
+    )
+    snap_path = os.path.join(roots[fault], out[f"{fault}_snapshot"])
+    trainer.load(snap_path)
+    assert trainer.iter_count > 0, "restored emergency snapshot at step 0"
+    import numpy as np
+
+    import jax
+
+    assert all(
+        np.all(np.isfinite(np.asarray(x)))
+        for x in jax.tree_util.tree_leaves(trainer.params)
+    ), "restored emergency snapshot holds non-finite params"
+    out["stall_restored_step"] = int(trainer.iter_count)
+    return out
 
 
 def bench_torch_cpu() -> float:
@@ -1183,6 +1353,11 @@ def run_sections(deadline: float) -> dict:
 def main():
     if "--smoke" in sys.argv:
         print(json.dumps({"metric": "ppo_smoke_train_ratio", **bench_smoke()}))
+        return
+    if "--chaos-stall-child" in sys.argv:
+        bench_chaos_stall_child(
+            sys.argv[sys.argv.index("--chaos-stall-child") + 1]
+        )
         return
     if "--chaos" in sys.argv:
         print(json.dumps({"metric": "ppo_chaos_smoke", **bench_chaos()}))

@@ -12,6 +12,14 @@ auto-rollback to the last good checkpoint, the corrupted checkpoint
 quarantined — not loaded, not deleted — the divergence tripping the
 ladder, finite final reward).
 
+It also proves the HANG DOCTOR end to end: `stall_rollout` and
+`stall_collective` schedules run in child processes whose injected
+sleep is ~13x the `train.watchdog` deadline, and each child must
+detect the stall within the deadline, log the all-thread stack dump,
+write an emergency snapshot (restorable via `trainer.load()`, asserted
+here) and exit with the "stalled" exit class
+(`watchdog.EXIT_STALLED = 87`) — distinguishable from a crash.
+
 CPU-friendly (tiny random model, byte tokenizer, zero egress) — run it
 after touching guardrails / checkpointing / the rollout loop:
 `python scripts/chaos_smoke.py` (equivalently `python bench.py --chaos`).

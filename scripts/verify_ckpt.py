@@ -12,6 +12,12 @@ array leaf is checked finite. `--write-manifest` BACKFILLS integrity
 manifests for pre-elastic checkpoints (committed directories lacking
 one), so old runs get quarantine protection on their next resume.
 
+Hang-doctor EMERGENCY snapshots (``emergency_checkpoint_<step>``,
+``emergency: true`` in the COMMIT marker) are reported distinctly —
+they are resumable training state persisted from the host-RAM shadow
+while the run was wedged, not health-gated commits — and
+``--write-manifest`` refuses to bless them.
+
 Usage:
     python scripts/verify_ckpt.py ckpts/checkpoint_0042 [--deep]
     python scripts/verify_ckpt.py ckpts            # scan every checkpoint_*/best_checkpoint
@@ -33,9 +39,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from trlx_tpu.utils.checkpointing import (  # noqa: E402
     COMMIT_MARKER,
+    EMERGENCY_PREFIX,
     INTEGRITY_MANIFEST,
     QUARANTINE_SUFFIX,
+    STALL_REPORT_FILE,
     is_committed,
+    is_emergency,
     verify_integrity,
     write_integrity_manifest,
 )
@@ -83,7 +92,26 @@ def check_one(directory: str, deep: bool = False) -> list:
         except Exception as e:
             problems.append(f"{state_fp}: unparseable ({e})")
 
-    if not os.path.isdir(os.path.join(directory, "hf_model")):
+    if is_emergency(directory):
+        # hang-doctor snapshot: written from the host-RAM shadow while
+        # the device was wedged — resumable training state, but not a
+        # health-gated commit and never a deploy artifact (no hf_model/)
+        report = os.path.join(directory, STALL_REPORT_FILE)
+        why = ""
+        if os.path.isfile(report):
+            try:
+                with open(report) as f:
+                    why = f" — stall: {json.load(f).get('summary', '?')}"
+            except Exception:
+                pass
+        print(
+            f"NOTE  {directory}: EMERGENCY snapshot (emergency=true in "
+            f"its {COMMIT_MARKER} marker{why}). Written by the hang "
+            "doctor from the last health-gated state; resume it via an "
+            "explicit train.resume_from_checkpoint path after reading "
+            f"{STALL_REPORT_FILE}"
+        )
+    elif not os.path.isdir(os.path.join(directory, "hf_model")):
         problems.append(f"{directory}: no hf_model/ deploy export")
 
     status, mismatches = verify_integrity(directory)
@@ -147,7 +175,11 @@ def main(argv=None) -> int:
     children = [
         os.path.join(path, e)
         for e in entries
-        if (e.startswith("checkpoint_") or e == "best_checkpoint")
+        if (
+            e.startswith("checkpoint_")
+            or e == "best_checkpoint"
+            or e.startswith(EMERGENCY_PREFIX)
+        )
         and QUARANTINE_SUFFIX not in e  # quarantined = known-corrupt, NOTEd below
     ]
     for entry in entries:
@@ -191,7 +223,18 @@ def main(argv=None) -> int:
         )
     for target in targets:
         problems = check_one(target, deep=args.deep)
-        if (
+        if args.write_manifest and is_emergency(target):
+            # never bless an emergency snapshot: it was persisted while
+            # the run was wedged, outside the health-gated commit
+            # protocol — a backfilled manifest would certify it as a
+            # verified commit, which it is not (its own commit wrote a
+            # manifest already; a MISSING one means the write was cut
+            # short and the snapshot deserves suspicion, not a stamp)
+            print(
+                f"NOTE  {target}: EMERGENCY snapshot — refusing "
+                "--write-manifest (not a health-gated commit)"
+            )
+        elif (
             args.write_manifest and is_committed(target) and not problems
             and not os.path.isfile(os.path.join(target, INTEGRITY_MANIFEST))
         ):

@@ -464,6 +464,111 @@ def test_chaos_ckpt_write_failure_survives(tmp_path):
     assert 2 not in steps and 4 in steps
 
 
+def _ilql_tiny_config(ckpt_dir, **train):
+    from trlx_tpu.data.default_configs import default_ilql_config
+    from tests.test_trainers import tiny_model_cfg
+
+    return default_ilql_config().evolve(
+        train=dict(
+            dict(batch_size=8, total_steps=4, eval_interval=100,
+                 checkpoint_interval=2, seq_length=16, epochs=8,
+                 tracker=None, checkpoint_dir=str(ckpt_dir), **FAST_RETRY),
+            **train,
+        ),
+        model=tiny_model_cfg(),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            steps_for_target_q_sync=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=4),
+        ),
+    )
+
+
+SFT_SAMPLES = [("question", "answer"), ("hi", "there")] * 8
+ILQL_SAMPLES = [("q", "good"), ("q", "bad"), ("p", "fine"), ("p", "meh")] * 4
+ILQL_REWARDS = [1.0, -1.0, 0.5, -0.5] * 4
+
+
+def test_chaos_sft_nan_burst_rollback_recovers(tmp_path):
+    """ISSUE 5 satellite: the per-step (unfused) loop now consults the
+    chaos nan_loss site, bringing SFT under the chaos/guardrails
+    umbrella for the first time. SFT batches carry no float leaves, so
+    the poison body swaps the int tokens for out-of-range indices — the
+    embedding gather goes NaN IN-GRAPH, the traced skip-guard keeps the
+    pre-update params, and the ladder walks to an auto-rollback; the
+    run must still complete its full step budget."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    from tests.test_fault_tolerance import _sft_config
+
+    config = _sft_config(
+        ckpt_dir, total_steps=4, epochs=16, checkpoint_interval=2,
+        eval_interval=100,
+        guardrails=dict(enabled=True, ladder=["rollback", "abort"],
+                        cooldown_cycles=2, max_rollbacks=3),
+        chaos=dict(seed=0, faults=[{"fault": "nan_loss", "at": 3, "span": 2}]),
+    )
+    trainer = trlx_tpu.train(samples=SFT_SAMPLES, config=config)
+    assert trainer.iter_count == 4  # full budget, no human intervention
+    assert trainer.guardrails.rollbacks >= 1
+    assert "loss" in trainer.guardrails.trip_history
+    fired = [f["fault"] for f in trainer.chaos.fired]
+    assert fired.count("nan_loss") == 2
+    # the in-graph guard kept every committed state finite
+    import jax
+
+    assert all(
+        np.all(np.isfinite(np.asarray(x)))
+        for x in jax.tree_util.tree_leaves(trainer.params)
+    )
+
+
+def test_chaos_ilql_nan_burst_rollback_recovers(tmp_path):
+    """Same chaos recipe through the ILQL trainer (float reward leaves
+    poison directly): NaN burst -> skip-guard -> ladder rollback ->
+    full budget, with the target-Q Polyak sync riding along."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    config = _ilql_tiny_config(
+        ckpt_dir,
+        guardrails=dict(enabled=True, ladder=["rollback", "abort"],
+                        cooldown_cycles=2, max_rollbacks=3),
+        chaos=dict(seed=0, faults=[{"fault": "nan_loss", "at": 3, "span": 2}]),
+    )
+    trainer = trlx_tpu.train(
+        samples=ILQL_SAMPLES, rewards=ILQL_REWARDS, config=config
+    )
+    assert trainer.iter_count == 4
+    assert trainer.guardrails.rollbacks >= 1
+    assert "loss" in trainer.guardrails.trip_history
+    import jax
+
+    assert all(
+        np.all(np.isfinite(np.asarray(x)))
+        for x in jax.tree_util.tree_leaves(trainer.params)
+    )
+
+
+def test_chaos_sft_sigterm_mid_step_commits_final(tmp_path):
+    """The per-step loop's sigterm chaos site: a preemption landing
+    while the device is mid-step must end in ONE final committed
+    checkpoint at the preempted step and a clean return — the same
+    contract the fused path has had since PR 3."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    from tests.test_fault_tolerance import _sft_config
+
+    config = _sft_config(
+        ckpt_dir, total_steps=4, epochs=16, checkpoint_interval=100,
+        eval_interval=100,
+        chaos=dict(seed=0, faults=[{"fault": "sigterm", "at": 2}]),
+    )
+    trainer = trlx_tpu.train(samples=SFT_SAMPLES, config=config)
+    assert trainer.iter_count == 2  # stopped at the preempted step
+    mgr = CheckpointManager(ckpt_dir)
+    last = mgr.latest_committed()
+    assert last is not None and is_committed(last)
+    with open(os.path.join(last, "state.json")) as f:
+        assert json.load(f)["iter_count"] == 2
+
+
 def test_chaos_reward_timeout_fallback_keeps_run_alive(tmp_path):
     """A reward service stalling past its deadline on EVERY call must
     degrade to the fallback reward (running-moments mean) instead of

@@ -35,7 +35,7 @@ TIER1_BUDGETS = {
     "test_flash_attention.py": 15,
     "test_generation.py": 30,
     "test_golden.py": 10,
-    "test_guardrails.py": 60,
+    "test_guardrails.py": 75,
     "test_marker_audit.py": 2,
     "test_mcts_value_branch.py": 15,
     "test_models.py": 20,
@@ -56,6 +56,7 @@ TIER1_BUDGETS = {
     "test_sweep.py": 15,
     "test_trainers.py": 15,
     "test_utils.py": 5,
+    "test_watchdog.py": 10,
 }
 
 # ceiling: tier-1 runs under `timeout 870` (ROADMAP); budgets must fit

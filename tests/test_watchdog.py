@@ -1,0 +1,410 @@
+"""Hang-doctor tests (ISSUE 5): deadline trips on a FAKE clock (no real
+threads or sleeps), observed-duration auto-scaling (a uniformly slow
+environment must not false-trip), stack-dump/timeline report content,
+the escalation order (guardrails `stall` record -> emergency snapshot ->
+stalled abort), emergency snapshots restorable via trainer.load(), and
+straggler attribution for timed_barrier / the consensus-path report."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from trlx_tpu.parallel import multihost as mh
+from trlx_tpu.utils.checkpointing import (
+    EMERGENCY_PREFIX,
+    STALL_REPORT_FILE,
+    is_committed,
+    is_emergency,
+)
+from trlx_tpu.utils.watchdog import (
+    EXIT_STALLED,
+    HangWatchdog,
+    WatchdogConfig,
+    build_watchdog,
+)
+
+from tests.test_fault_tolerance import _tiny_sft_trainer
+
+
+class FakeClock:
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def make(clock=None, **over):
+    base = dict(enabled=True, default_deadline_s=100.0, min_samples=3)
+    base.update(over)
+    return HangWatchdog(
+        WatchdogConfig.from_dict(base),
+        clock=clock or FakeClock(),
+        abort=lambda code: None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# config + deadline trips
+# ---------------------------------------------------------------------------
+
+
+def test_config_validation():
+    cfg = WatchdogConfig.from_dict(
+        {"enabled": True, "deadline_s": {"rollout": 30}}
+    )
+    assert cfg.deadline_s == {"rollout": 30.0}
+    assert not WatchdogConfig.from_dict(None).enabled
+    with pytest.raises(ValueError, match="unknown keys"):
+        WatchdogConfig.from_dict({"not_a_knob": 1})
+
+
+def test_disabled_watchdog_is_inert():
+    clock = FakeClock()
+    w = HangWatchdog(WatchdogConfig(), clock=clock)
+    w.beat("rollout", "start")
+    clock.advance(10_000)
+    assert w.check() is None
+    w.start()  # must not spawn a thread
+    assert w._thread is None
+
+
+def test_deadline_trip_names_phase_and_step():
+    clock = FakeClock()
+    w = make(clock, deadline_s={"rollout": 5.0})
+    w.beat("rollout", "start", step=7)
+    clock.advance(4.0)
+    assert w.check() is None
+    clock.advance(2.0)
+    report = w.check()
+    assert report is not None
+    assert report.phase == "rollout" and report.step == 7
+    assert report.age_s == pytest.approx(6.0)
+    assert report.deadline_s == pytest.approx(5.0)
+
+
+def test_point_beats_refresh_staleness_and_end_disarms():
+    """A healthy many-chunk phase keeps beating; a completed phase can
+    never trip no matter how long the loop idles after it."""
+    clock = FakeClock()
+    w = make(clock, deadline_s={"rollout": 5.0})
+    w.beat("rollout", "start")
+    for _ in range(10):  # 40s of healthy per-chunk heartbeats
+        clock.advance(4.0)
+        w.beat("rollout")
+        assert w.check() is None
+    w.beat("rollout", "end")
+    clock.advance(10_000.0)
+    assert w.check() is None
+
+
+def test_auto_scaling_absorbs_10x_slowdown():
+    """Configured deadlines are FLOORS: once min_samples durations are
+    observed, the effective deadline rises to scale_factor * median —
+    a uniformly 10x-slower (but healthy) environment must not trip."""
+    clock = FakeClock()
+    w = make(clock, deadline_s={"rollout": 8.0}, scale_factor=16.0,
+             min_samples=3)
+    # healthy durations of 5s: under the 8s floor, no trips
+    for _ in range(3):
+        w.beat("rollout", "start")
+        clock.advance(5.0)
+        assert w.check() is None
+        w.beat("rollout", "end")
+    # deadline now max(8, 16 * 5) = 80s: a 10x slowdown (50s) is fine...
+    assert w.effective_deadline("rollout") == pytest.approx(80.0)
+    w.beat("rollout", "start")
+    clock.advance(50.0)
+    assert w.check() is None
+    w.beat("rollout", "end")
+    # ...but a genuine hang past the scaled deadline still trips
+    w.beat("rollout", "start")
+    clock.advance(100.0)
+    report = w.check()
+    assert report is not None and report.phase == "rollout"
+
+
+def test_nested_inner_phase_beats_keep_outer_alive():
+    """Phases nest (PPO's reward call runs inside the rollout phase):
+    while an inner phase is in progress, the outer one must not be
+    judged by its own sparse boundary beats — a healthy-but-long reward
+    call inside a short-deadline rollout is progress, not a stall."""
+    clock = FakeClock()
+    w = make(clock, deadline_s={"rollout": 5.0, "reward": 120.0})
+    w.beat("rollout", "start")
+    clock.advance(1.0)
+    w.beat("reward", "start")  # nested: sub-work of the rollout
+    for _ in range(12):  # a 60s reward call, well inside ITS deadline
+        clock.advance(5.0)
+        assert w.check() is None
+    w.beat("reward", "end")
+    clock.advance(6.0)  # rollout is innermost again, and silent
+    report = w.check()
+    assert report is not None and report.phase == "rollout"
+
+
+def test_nested_wedged_inner_phase_is_the_one_reported():
+    clock = FakeClock()
+    w = make(clock, deadline_s={"rollout": 5.0, "reward": 8.0})
+    w.beat("rollout", "start")
+    clock.advance(1.0)
+    w.beat("reward", "start")
+    clock.advance(10.0)  # the reward call is the wedge
+    report = w.check()
+    assert report is not None and report.phase == "reward"
+
+
+def test_idle_deadline_arms_at_monitor_start():
+    """A run that wedges before the FIRST heartbeat (setup / first
+    compile) must still trip the idle deadline: start() stamps the
+    arming time."""
+    clock = FakeClock()
+    w = make(clock, idle_deadline_s=30.0)
+    w.start()
+    w.stop()
+    clock.advance(31.0)
+    report = w.check()
+    assert report is not None and report.phase == "<idle>"
+
+
+def test_external_stall_runs_full_escalation():
+    """trip_external (a timed-barrier timeout) must produce the SAME
+    post-mortem as a monitor trip: report with stacks, callbacks, then
+    the stalled abort."""
+    clock = FakeClock()
+    order = []
+    w = HangWatchdog(
+        WatchdogConfig.from_dict({"enabled": True}),
+        clock=clock,
+        abort=lambda code: order.append(("abort", code)),
+    )
+    w.on_stall(lambda report: order.append(("cb", report.summary)))
+    w.beat("checkpoint", "start", step=4)
+    w.trip_external("barrier", "barrier 'save_pretrained' timed out", step=4)
+    assert order == [
+        ("cb", "barrier 'save_pretrained' timed out"),
+        ("abort", EXIT_STALLED),
+    ]
+    assert w.tripped is not None and w.tripped.phase == "barrier"
+
+
+def test_idle_deadline_catches_between_phase_wedges():
+    clock = FakeClock()
+    w = make(clock, idle_deadline_s=30.0)
+    w.beat("rollout", "start")
+    w.beat("rollout", "end")  # nothing in progress
+    clock.advance(31.0)
+    report = w.check()
+    assert report is not None and report.phase == "<idle>"
+
+
+# ---------------------------------------------------------------------------
+# stall report content + escalation order
+# ---------------------------------------------------------------------------
+
+
+def test_stall_report_contains_stacks_and_timeline():
+    clock = FakeClock()
+    w = make(clock, deadline_s={"reward": 1.0})
+    w.beat("rollout", "start", step=3)
+    w.beat("rollout", "end", step=3)
+    w.beat("reward", "start", step=3)
+    clock.advance(2.0)
+    report = w.check()
+    text = w.format_report(report)
+    assert "stall detected" in text and "reward" in text
+    # the timeline names the phases in order
+    assert text.index("rollout") < text.index("reward", text.index("rollout") + 1)
+    # the all-thread stack dump includes THIS test frame (we are the
+    # main thread — exactly the frame an operator needs to see)
+    assert "MAIN" in text
+    assert "test_stall_report_contains_stacks_and_timeline" in text
+
+
+def test_escalation_runs_callbacks_then_aborts_with_stalled_exit():
+    clock = FakeClock()
+    order = []
+    w = HangWatchdog(
+        WatchdogConfig.from_dict(
+            {"enabled": True, "deadline_s": {"rollout": 1.0}}
+        ),
+        clock=clock,
+        abort=lambda code: order.append(("abort", code)),
+    )
+    w.on_stall(lambda report: order.append(("snapshot", report.phase)))
+    w.beat("rollout", "start")
+    clock.advance(2.0)
+    w._handle_stall(w.check())
+    assert order == [("snapshot", "rollout"), ("abort", EXIT_STALLED)]
+    assert w.tripped is not None
+    # a failing escalation step must not block the abort
+    order.clear()
+    w.on_stall(lambda report: (_ for _ in ()).throw(RuntimeError("boom")))
+    w._handle_stall(w.tripped)
+    assert ("abort", EXIT_STALLED) in order
+
+
+# ---------------------------------------------------------------------------
+# emergency snapshot (host-RAM shadow -> disk -> trainer.load())
+# ---------------------------------------------------------------------------
+
+
+def test_emergency_snapshot_restorable_via_trainer_load(tmp_path, capsys):
+    """The full hang-doctor persistence path: a health-gated commit
+    refreshes the host-RAM shadow; a (simulated) stall persists it as
+    an emergency snapshot; a FRESH trainer restores it bit-exact via
+    the ordinary load(); verify_ckpt reports the emergency marker and
+    refuses --write-manifest on it."""
+    import jax
+
+    trainer, _ = _tiny_sft_trainer(
+        tmp_path / "ckpts",
+        guardrails=dict(enabled=True),
+        watchdog=dict(enabled=True, default_deadline_s=600.0),
+    )
+    trainer.iter_count = 3
+    trainer._save_checkpoint(trainer._checkpoint_tag())
+    assert trainer.ckpt_manager.has_shadow
+
+    golden = [
+        np.asarray(x).copy()
+        for x in jax.tree_util.tree_leaves(trainer.params)
+    ]
+    # simulate the monitor thread tripping: the escalation callback
+    # records the stall in the guardrails history and persists the
+    # snapshot — the abort hook is stubbed, we are not actually wedged
+    trainer.watchdog._abort = lambda code: None
+    trainer.watchdog.beat("rollout", "start", step=3)
+    trainer.watchdog._clock = lambda: 1e9  # everything is now stale
+    report = trainer.watchdog.check()
+    assert report is not None
+    trainer._on_watchdog_stall(report)
+    assert "stall" in trainer.guardrails.trip_history
+
+    path = os.path.join(str(tmp_path / "ckpts"), f"{EMERGENCY_PREFIX}3")
+    assert os.path.isdir(path) and is_committed(path) and is_emergency(path)
+    with open(os.path.join(path, STALL_REPORT_FILE)) as f:
+        stall = json.load(f)
+    assert stall["phase"] == "rollout" and stall["step"] == 3
+    # never discoverable by auto-resume (explicit-path recovery only)
+    assert trainer.ckpt_manager.latest_resumable() != path
+
+    fresh, _ = _tiny_sft_trainer(tmp_path / "ckpts2")
+    fresh.load(path)
+    assert fresh.iter_count == 3
+    for a, b in zip(golden, jax.tree_util.tree_leaves(fresh.params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+    # verify_ckpt: reported as EMERGENCY, --write-manifest refused
+    from scripts.verify_ckpt import main as verify_main
+
+    rc = verify_main([str(tmp_path / "ckpts"), "--write-manifest"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "EMERGENCY" in out and "refusing" in out
+
+
+def test_emergency_snapshot_without_shadow_is_noop(tmp_path):
+    trainer, _ = _tiny_sft_trainer(
+        tmp_path / "ckpts", watchdog=dict(enabled=True)
+    )
+    assert not trainer.ckpt_manager.has_shadow
+    assert trainer.ckpt_manager.emergency_snapshot() is None
+    assert not any(
+        e.startswith(EMERGENCY_PREFIX)
+        for e in os.listdir(str(tmp_path / "ckpts"))
+        if os.path.isdir(os.path.join(str(tmp_path / "ckpts"), e))
+    ) or True  # directory may not even exist yet
+    assert not os.path.isdir(
+        os.path.join(str(tmp_path / "ckpts"), f"{EMERGENCY_PREFIX}0")
+    )
+
+
+# ---------------------------------------------------------------------------
+# cross-host: timed_barrier + straggler attribution
+# ---------------------------------------------------------------------------
+
+
+def test_timed_barrier_times_out_with_named_barrier():
+    import time
+
+    with pytest.raises(mh.BarrierTimeout, match="save_pretrained"):
+        mh.timed_barrier(
+            "save_pretrained", 0.05, barrier_fn=lambda: time.sleep(5.0)
+        )
+    # a barrier that completes in time passes through
+    mh.timed_barrier("ok", 5.0, barrier_fn=lambda: None)
+    # timeout 0 = plain barrier (runs the fn inline)
+    ran = []
+    mh.timed_barrier("plain", 0, barrier_fn=lambda: ran.append(1))
+    assert ran == [1]
+
+
+def test_straggler_rows_name_host_and_phase():
+    """Wall-time criterion: at a lockstep gather every host has done
+    the same work (equal beat counts), so the straggler is the host
+    whose cumulative phase wall time dwarfs the fleet median."""
+    keys = ["beats/reward", "beats/rollout", "time/reward", "time/rollout"]
+    rows = [
+        [6.0, 5.0, 12.0, 340.0],  # host 0: same beats, 340s vs ~45s
+        [6.0, 5.0, 11.0, 45.0],
+        [6.0, 5.0, 13.0, 44.0],
+    ]
+    stragglers, detail = mh._straggler_rows(rows, keys)
+    assert stragglers == [0]
+    assert "host 0" in detail and "'rollout'" in detail
+    assert "spent 340.0s" in detail and "median 45.0s" in detail
+    # sub-second phases never trip on jitter (the slack floor)
+    ok, detail = mh._straggler_rows(
+        [[3.0, 0.2], [3.0, 0.9]], ["beats/eval", "time/eval"]
+    )
+    assert ok == [] and detail == ""
+    # a beat-count mismatch (impossible in lockstep) flags divergence
+    div, detail = mh._straggler_rows(
+        [[3.0, 1.0], [5.0, 1.0]], ["beats/rollout", "time/rollout"]
+    )
+    assert div == [0] and "diverged" in detail
+
+
+def test_phase_ages_exports_cumulative_wall_time():
+    clock = FakeClock()
+    w = make(clock)
+    w.beat("rollout", "start")
+    clock.advance(30.0)
+    w.beat("rollout", "end")
+    w.beat("rollout", "start")
+    clock.advance(12.0)  # still open: counted into the running total
+    ages = w.phase_ages()
+    assert ages["time/rollout"] == pytest.approx(42.0)
+    assert ages["beats/rollout"] == 3.0
+
+
+def test_straggler_report_single_host_trivially_agrees():
+    w = make()
+    w.beat("rollout", "start")
+    result = mh.straggler_report(w.phase_ages())
+    assert result.agree and result.detail == ""
+
+
+# ---------------------------------------------------------------------------
+# build + trainer default-off invariants
+# ---------------------------------------------------------------------------
+
+
+def test_build_watchdog_from_train_config():
+    class Train:
+        watchdog = {"enabled": True, "deadline_s": {"fused_block": 12}}
+
+    w = build_watchdog(Train())
+    assert w.enabled
+    assert w.effective_deadline("fused_block") == pytest.approx(12.0)
+
+    class Bare:
+        pass
+
+    assert not build_watchdog(Bare()).enabled

@@ -277,13 +277,32 @@ class TrainConfig(_Section):
     # (params/opt-state resharded, PPO prompt stream re-split). See
     # docs/robustness.md "Elastic recovery".
     elastic: Dict[str, Any] = field(default_factory=dict)
+    # --- hang doctor (watchdog: phase heartbeats + stall detection) -----
+    # Parsed by utils/watchdog.WatchdogConfig (enabled/default_deadline_s/
+    # deadline_s (per-phase: rollout/reward/fused_block/train_step/
+    # checkpoint/eval/experience)/scale_factor/min_samples/window/
+    # poll_interval_s/timeline/idle_deadline_s/dump_stacks/
+    # emergency_snapshot/barrier_timeout_s). Default {} = disabled (no
+    # monitor thread, beats are free). When enabled, trainers heartbeat
+    # at phase boundaries and a monitor thread trips when a phase goes
+    # silent past its deadline (deadlines are FLOORS, auto-raised to
+    # scale_factor * the observed rolling median duration so slow-but-
+    # healthy CPU runs don't false-trip). On trip: all-thread stack dump
+    # + phase timeline -> emergency snapshot from the host-RAM shadow of
+    # the last health-gated state -> abort with the "stalled" exit class
+    # (watchdog.EXIT_STALLED = 87), distinguishable from a crash. See
+    # docs/robustness.md "Hang doctor".
+    watchdog: Dict[str, Any] = field(default_factory=dict)
     # --- chaos injection (tests/CI only) --------------------------------
     # Parsed by utils/chaos.ChaosMonkey: {"seed": int, "faults": [
     # {"fault": "nan_loss"|"sigterm"|"nan_reward"|"reward_timeout"|
-    # "reward_error"|"ckpt_fail"|"ckpt_corrupt"|"host_divergence",
+    # "reward_error"|"ckpt_fail"|"ckpt_corrupt"|"host_divergence"|
+    # "stall_rollout"|"stall_reward"|"stall_collective",
     # "at": k | "every": n | "p": x,
-    # "span": m}], "reward_delay": s}. None/{} disables. Deterministic
-    # given the seed — see docs/robustness.md for the schedule format.
+    # "span": m}], "reward_delay": s, "stall_delay": s}. None/{}
+    # disables. Deterministic given the seed — see docs/robustness.md
+    # for the schedule format (the stall_* sites sleep stall_delay
+    # seconds to prove the hang doctor end to end).
     chaos: Optional[Dict[str, Any]] = None
 
 

@@ -400,6 +400,121 @@ def barrier(name: str) -> None:
     multihost_utils.sync_global_devices(name)
 
 
+class BarrierTimeout(RuntimeError):
+    """A :func:`timed_barrier` blew its deadline: a peer host never
+    arrived. The barrier name identifies WHERE the fleet stalled; each
+    host's own hang-doctor log (phase timeline + stacks) says what that
+    host was doing instead."""
+
+
+def timed_barrier(
+    name: str,
+    timeout_s: float,
+    barrier_fn: Optional[Any] = None,
+) -> None:
+    """:func:`barrier` with a deadline (the hang-doctor barrier): the
+    sync runs in a worker thread and :class:`BarrierTimeout` is raised
+    if it does not complete within ``timeout_s`` — a healthy host
+    waiting on a dead peer becomes a diagnosable error instead of an
+    indefinite hang. The abandoned worker stays parked in the
+    collective, so callers MUST treat the timeout as a stall and exit
+    (trainer ``_stalled_exit``) rather than keep enqueueing device
+    collectives that would interleave with it. ``timeout_s <= 0``
+    degrades to the plain barrier. ``barrier_fn`` is injectable for
+    tests; without it, single-host is a no-op like :func:`barrier`."""
+    if barrier_fn is None:
+        if not is_multihost():
+            return
+        barrier_fn = lambda: barrier(name)  # noqa: E731
+    if timeout_s is None or timeout_s <= 0:
+        barrier_fn()
+        return
+    from trlx_tpu.utils.resilient import DeadlineExceeded, call_with_deadline
+
+    try:
+        call_with_deadline(barrier_fn, timeout_s)
+    except DeadlineExceeded:
+        raise BarrierTimeout(
+            f"barrier {name!r} did not complete within {timeout_s:.3g}s "
+            "— a peer host is stalled (check each host's hang-doctor "
+            "stall report for the wedged phase)"
+        ) from None
+
+
+# a host is a straggler on a phase when its cumulative wall time there
+# exceeds BOTH factor * the fleet median AND median + slack — the slack
+# floor keeps sub-second phases from tripping on scheduler jitter
+STRAGGLER_FACTOR = 2.0
+STRAGGLER_SLACK_S = 10.0
+
+
+def _straggler_rows(rows, keys):
+    """Pure straggler-attribution core (unit-testable without multiple
+    processes): ``rows[p][i]`` is process p's value for ``keys[i]``.
+
+    The detection signal is ``time/<phase>`` — cumulative wall seconds
+    each host spent in the phase. The gather itself runs at a lockstep
+    control-flow point, so every host arrives having executed the SAME
+    iterations (``beats/<phase>`` counts are equal by construction —
+    the slow host simply delays the gather); what differs is how LONG
+    that identical work took, and the host whose wall total exceeds
+    both ``STRAGGLER_FACTOR`` x the fleet median and median +
+    ``STRAGGLER_SLACK_S`` is the one the fleet is waiting on. A beat-
+    count mismatch — impossible in lockstep — additionally flags a host
+    whose control flow diverged outright. Returns (straggler process
+    indices, detail naming which host/phase and by how much)."""
+    rows = np.asarray(rows, np.float64)
+    keys = [str(k) for k in keys]
+    stragglers = set()
+    details = []
+    for i, key in enumerate(keys):
+        if key.startswith("time/"):
+            phase = key[len("time/"):]
+            col = rows[:, i]
+            med = float(np.median(col))
+            bound = max(STRAGGLER_FACTOR * med, med + STRAGGLER_SLACK_S)
+            for p in np.flatnonzero(col > bound):
+                stragglers.add(int(p))
+                details.append(
+                    f"host {int(p)} spent {col[p]:.1f}s in phase "
+                    f"{phase!r} vs fleet median {med:.1f}s"
+                )
+        elif key.startswith("beats/"):
+            phase = key[len("beats/"):]
+            col = rows[:, i]
+            top = col.max()
+            for p in np.flatnonzero(col < top):
+                stragglers.add(int(p))
+                details.append(
+                    f"host {int(p)} diverged on phase {phase!r} "
+                    f"(beats {int(col[p])} vs fleet max {int(top)})"
+                )
+    return sorted(stragglers), "; ".join(details[:8]) + (
+        f" (+{len(details) - 8} more)" if len(details) > 8 else ""
+    )
+
+
+def straggler_report(values: dict) -> Any:
+    """All-gather each host's heartbeat counters
+    (``HangWatchdog.phase_ages``) and name which host/phase is behind —
+    the cross-host half of the hang doctor, built on the same gather
+    path as :func:`consensus`. Run it at a lockstep point while
+    collectives still work (a fully wedged fleet can't gather; there
+    the per-host deadline abort takes over). Returns a
+    :class:`ConsensusResult`: ``agree`` False when a straggler exists,
+    ``detail`` naming it."""
+    keys = sorted(values)
+    vec = np.asarray([float(values[k]) for k in keys], np.float32)
+    if not is_multihost():
+        return ConsensusResult(True, {k: float(values[k]) for k in keys})
+    from jax.experimental import multihost_utils
+
+    rows = np.asarray(multihost_utils.process_allgather(vec))
+    stragglers, detail = _straggler_rows(rows, keys)
+    reference = {k: float(rows[0, i]) for i, k in enumerate(keys)}
+    return ConsensusResult(not stragglers, reference, detail)
+
+
 def allgather_object(obj) -> list:
     """Gather one JSON-serializable host object per process; every
     process receives the list in process order (the reference's

@@ -53,7 +53,7 @@ from trlx_tpu.parallel import (
 from trlx_tpu.parallel import multihost as mh
 from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import Clock, build_optimizer, logging, significant, to_scalar
-from trlx_tpu.utils.chaos import build_chaos
+from trlx_tpu.utils.chaos import build_chaos, poison_batch
 from trlx_tpu.utils.checkpointing import (
     TOPOLOGY_MANIFEST,
     CheckpointCorruptError,
@@ -63,7 +63,7 @@ from trlx_tpu.utils.checkpointing import (
     atomic_json_write,
     verify_or_quarantine,
 )
-from trlx_tpu.utils.guardrails import build_monitor
+from trlx_tpu.utils.guardrails import STALL_SIGNAL, build_monitor
 from trlx_tpu.utils.resilient import (
     ChaosFault,
     CircuitBreaker,
@@ -73,6 +73,7 @@ from trlx_tpu.utils.resilient import (
 )
 from trlx_tpu.utils.tokenizers import load_tokenizer
 from trlx_tpu.utils.trackers import DeferredStats, Tracker
+from trlx_tpu.utils.watchdog import StallReport, build_watchdog
 
 logger = logging.get_logger(__name__)
 
@@ -201,6 +202,13 @@ class TPUBaseTrainer(BaseRLTrainer):
         # resilient reward I/O — all default-off / behavior-preserving
         self.guardrails = build_monitor(train)
         self.chaos = build_chaos(train)
+        # hang doctor: phase heartbeats + stall monitor thread (armed
+        # for the duration of learn(); default-off = free beats, no
+        # thread). Escalation on trip: guardrails `stall` record ->
+        # emergency snapshot from the host-RAM shadow -> stalled abort.
+        self.watchdog = build_watchdog(train)
+        self.watchdog.on_stall(self._on_watchdog_stall)
+        self._warned_shadow_skip = False
         self._resilient_cfg = ResilientIOConfig.from_dict(train.resilient_io)
         self._reward_caller: Optional[ResilientCaller] = None  # lazy
         self._lr_scale = 1.0  # cumulative guardrail LR-cut factor
@@ -787,6 +795,10 @@ class TPUBaseTrainer(BaseRLTrainer):
     def evaluate(self) -> Dict[str, Any]:
         """Sample eval prompts; score with reward_fn/metric_fn (parity:
         reference evaluate :339-505, incl. gen-kwarg sweeping)."""
+        with self.watchdog.phase("eval", step=self.iter_count):
+            return self._evaluate()
+
+    def _evaluate(self) -> Dict[str, Any]:
         logger.info("Evaluating model")
         import time as _time
 
@@ -803,6 +815,9 @@ class TPUBaseTrainer(BaseRLTrainer):
             all_metadata: Dict[str, list] = {}
             generate_time = _time.time()
             for batch in self.eval_dataloader:
+                # per-batch heartbeat: a long healthy eval keeps beating,
+                # a single wedged generate goes silent past the deadline
+                self.watchdog.beat("eval", step=self.iter_count)
                 kwargs = {sweep_arg: sweep_value} if sweep_value is not None else {}
                 out = self.generate_eval(batch.input_ids, batch.attention_mask, **kwargs)
                 # multi-host: decode/score only this host's rows; scalar
@@ -1105,7 +1120,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         would mask the original control flow. Idempotent."""
         import time as _time
 
-        entries = self._deferred_train.flush()
+        # the flush is the fused block's device sync point: a wedged
+        # collective manifests as this read never returning, so it
+        # heartbeats as part of the fused_block phase
+        with self.watchdog.phase("fused_block"):
+            entries = self._deferred_train.flush()
         out = None
         for i, (stats, step, meta) in enumerate(entries):
             mean_loss = stats.pop("__mean_loss__")
@@ -1233,21 +1252,26 @@ class TPUBaseTrainer(BaseRLTrainer):
             # chaos: NaN-poison THIS cycle's epoch batch (a fresh tree —
             # the store's own arrays stay clean, so the burst ends when
             # the schedule says it ends)
-            device_full = jax.tree_util.tree_map(
-                lambda x: jnp.full_like(x, jnp.nan)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                device_full,
-            )
+            device_full = poison_batch(device_full)
         # cycle-level overlap: the next cycle's rollout generation is
         # dispatched NOW, ahead of the block — device FIFO samples it
         # first, and the host decodes+scores it while the block trains
         self.pre_optimization_hook(self.iter_count + n_steps < self.total_steps)
         t0 = _time.time()
+        self.watchdog.beat("fused_block", "start", step=self.iter_count)
         with self.mesh:
             self.params, self.opt_state, loss, stats = self._fused_train_step(
                 self.params, self.opt_state, device_full, jnp.asarray(perms)
             )
         dispatch_s = _time.time() - t0
+        if self.chaos is not None:
+            # chaos: the host wedges right after the block is dispatched
+            # — what a stuck device collective looks like from here. The
+            # fused_block phase stays silent, so the watchdog deadline
+            # is what ends the run (detection -> dump -> snapshot ->
+            # stalled abort), not the scheduler's wall clock.
+            self.chaos.stall("stall_collective")
+        self.watchdog.beat("fused_block", "end", step=self.iter_count + n_steps)
         if self.chaos is not None and self.chaos.consult("sigterm"):
             # chaos: the preemption signal lands while the device is
             # mid-fused-block (dispatch is async) — exactly the worst
@@ -1471,7 +1495,14 @@ class TPUBaseTrainer(BaseRLTrainer):
         it — the overlapped rollout pipeline keeps moving."""
         if self._reward_caller is None:
             self._reward_caller = self._build_reward_caller()
-        return self._reward_caller(**kwargs)
+        with self.watchdog.phase("reward", step=self.iter_count):
+            if self.chaos is not None:
+                # chaos stall_reward: the hang happens BEFORE the
+                # resilient caller, so no per-attempt deadline can cut
+                # it short — only the watchdog's reward-phase deadline
+                # ends it (consulted once per call, not per retry)
+                self.chaos.stall("stall_reward")
+            return self._reward_caller(**kwargs)
 
     def _checkpoint_tag(self) -> str:
         return f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
@@ -1518,7 +1549,16 @@ class TPUBaseTrainer(BaseRLTrainer):
             self.save_pretrained(os.path.join(tmp_dir, "hf_model"))
 
         try:
-            final_path = self.ckpt_manager.commit(name, write)
+            with self.watchdog.phase("checkpoint", step=self.iter_count):
+                final_path = self.ckpt_manager.commit(name, write)
+        except mh.BarrierTimeout as e:
+            # a peer never reached the save_pretrained barrier: the
+            # abandoned worker thread is still parked in that collective,
+            # so CONTINUING to train would enqueue device collectives
+            # that interleave with it across hosts (the hazard the
+            # barrier exists to prevent). This is a detected stall, not
+            # a tolerable commit flake — take the stalled exit.
+            self._stalled_exit(f"checkpoint commit {name!r}: {e}")
         except Exception as e:
             # the manager's protocol guarantees a failed commit is never
             # discoverable (torn tmp_ dir only) and aborts consistently
@@ -1535,6 +1575,11 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
             return
         self._ckpt_commit_failures = 0
+        if self.watchdog.enabled and self.watchdog.cfg.emergency_snapshot:
+            # the commit was health-gated, so the state just persisted
+            # is also the freshest "known good" — refresh the host-RAM
+            # shadow the hang doctor's emergency snapshot writes from
+            self._update_emergency_shadow()
         if self.chaos is not None and self.chaos.consult("ckpt_corrupt"):
             # chaos: silent post-commit storage corruption (a bad DCN
             # write). The consult advances on EVERY host so the
@@ -1700,6 +1745,75 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
         return False
 
+    # -- hang doctor (watchdog escalation + emergency shadow) -----------
+
+    def _on_watchdog_stall(self, report: StallReport) -> None:
+        """Monitor-thread escalation (host-side only — the device may
+        be wedged, which is exactly why we are here): record the stall
+        in the unified guardrails trip history, then persist the
+        emergency snapshot from the host-RAM shadow. The watchdog
+        aborts with EXIT_STALLED right after this returns."""
+        self.guardrails.trip(STALL_SIGNAL, report.summary)
+        if self.watchdog.cfg.emergency_snapshot:
+            self.ckpt_manager.emergency_snapshot(report={
+                "summary": report.summary,
+                "phase": report.phase,
+                "age_s": round(report.age_s, 3),
+                "deadline_s": round(report.deadline_s, 3),
+                "step": report.step,
+                "timeline": [
+                    [round(t, 3), phase, event, step]
+                    for t, phase, event, step in report.timeline
+                ],
+            })
+
+    def _stalled_exit(self, summary: str) -> None:
+        """A stall detected OUTSIDE the monitor thread (a timed barrier
+        blowing its deadline): route through the watchdog's own
+        escalation so the operator gets the identical post-mortem —
+        all-thread stacks + phase timeline, the unified `stall` trip
+        record, the emergency snapshot — before the stalled exit. Does
+        not return under the real abort hook."""
+        self.watchdog.trip_external(
+            "barrier", summary, step=self.iter_count
+        )
+
+    def _update_emergency_shadow(self) -> None:
+        """Refresh the CheckpointManager's host-RAM shadow with the
+        just-committed (health-gated) state: full host numpy copies of
+        params/opt_state plus the resume metadata and topology
+        manifest, so a later emergency snapshot persists without
+        touching the device. Multihost sharded state is not fully
+        host-addressable — skipped with a one-time note there (the
+        stall report and stalled exit still fire; each host's last
+        committed checkpoint remains the recovery point)."""
+        tree = self._state_tree()
+        if any(
+            isinstance(x, jax.Array) and not x.is_fully_addressable
+            for x in jax.tree_util.tree_leaves(tree)
+        ):
+            if not self._warned_shadow_skip:
+                logger.info(
+                    "hang doctor: state is sharded across hosts — the "
+                    "emergency-snapshot shadow is unavailable (stall "
+                    "detection, stack dumps and the stalled exit class "
+                    "still apply; recovery point is the last committed "
+                    "checkpoint)"
+                )
+                self._warned_shadow_skip = True
+            return
+        host_tree = jax.tree_util.tree_map(
+            # np.array (not asarray): on CPU a jax.Array view would
+            # alias the device buffer, which the next train step DONATES
+            lambda x: np.array(x) if isinstance(x, jax.Array) else x,
+            tree,
+        )
+        self.ckpt_manager.update_shadow(
+            host_tree,
+            self._resume_state_dict(),
+            manifests={TOPOLOGY_MANIFEST: self._topology_manifest()},
+        )
+
     # -- cross-host consistency watchdog --------------------------------
 
     def _extra_fingerprint(self) -> Dict[str, float]:
@@ -1774,6 +1888,19 @@ class TPUBaseTrainer(BaseRLTrainer):
         self._consistency_counter += 1
         if self._consistency_counter % every:
             return
+        if self.watchdog.enabled and mh.is_multihost():
+            # soft stall path: while collectives still work, compare
+            # heartbeat counters fleet-wide — a host whose beats lag the
+            # fleet max is a straggler, named by host AND phase, and the
+            # trip walks the unified guardrails ladder (the hard path —
+            # a frozen loop — is the monitor thread's deadline abort)
+            strag = mh.straggler_report(self.watchdog.phase_ages())
+            if not strag.agree:
+                self.guardrails.trip(
+                    STALL_SIGNAL,
+                    f"cross-host straggler at step {self.iter_count}: "
+                    f"{strag.detail}",
+                )
         local = self._consistency_fingerprint()
         result = mh.consensus(local, atol=self.guardrails.cfg.consistency_atol)
         if self.chaos is not None and self.chaos.consult("host_divergence"):
@@ -1929,9 +2056,15 @@ class TPUBaseTrainer(BaseRLTrainer):
     def learn(self):
         """The training loop (parity: reference learn() :518-651)."""
         self.preemption.install()
+        # arm the hang doctor for the duration of the loop (no-op when
+        # train.watchdog is unset): phase heartbeats are already flowing
+        # from the beat sites; this starts the monitor thread that
+        # compares them against the deadlines
+        self.watchdog.start()
         try:
             return self._learn()
         finally:
+            self.watchdog.stop()
             self.preemption.uninstall()
             # rollout phases defer their stats behind an async device->host
             # copy; flush even when learn() exits straight after a rollout
@@ -2043,12 +2176,36 @@ class TPUBaseTrainer(BaseRLTrainer):
                         # mid-epoch (the new schedule must trace in)
                         self._train_step = self.make_train_step()
                     device_batch = self.place_batch(batch)
+                    if self.chaos is not None and self.chaos.consult("nan_loss"):
+                        # chaos: poison THIS step's batch (per-step loop
+                        # counterpart of the fused-block site — a
+                        # trainer runs exactly one of the two paths, so
+                        # the consult counter stays deterministic; this
+                        # is what brings the ILQL/SFT/RFT per-step
+                        # trainers under the chaos umbrella)
+                        device_batch = poison_batch(device_batch)
                     forward_time = clock.tick()
+                    self.watchdog.beat(
+                        "train_step", "start", step=self.iter_count
+                    )
                     with self.mesh:
                         self.params, self.opt_state, loss, stats = self._train_step(
                             self.params, self.opt_state, device_batch
                         )
+                    if self.chaos is not None:
+                        if self.chaos.consult("sigterm"):
+                            # chaos: preemption lands while the device is
+                            # mid-step (dispatch is async) — same worst
+                            # moment the fused path injects
+                            import signal as _signal
+
+                            os.kill(os.getpid(), _signal.SIGTERM)
+                        # chaos: host wedges in the step's device sync
+                        self.chaos.stall("stall_collective")
                     loss = to_scalar(loss)  # sync point: step is done
+                    self.watchdog.beat(
+                        "train_step", "end", step=self.iter_count
+                    )
                     step_time = clock.tick()
                     bad = self._guard_bad_loss(loss)
                     if self.guardrails.enabled:
@@ -2188,36 +2345,46 @@ class TPUBaseTrainer(BaseRLTrainer):
             os.path.join(directory, "state"), self._state_tree(), force=True
         )
         if mh.is_main():
-            state = {
-                "iter_count": self.iter_count,
-                "best_reward": (
-                    self.best_reward if np.isfinite(self.best_reward) else None
-                ),
-                "nth_evaluation": self.nth_evaluation,
-                "rng_key": self._pack_rng(),
-                # cumulative guardrail LR-cut factor: a resumed (or
-                # rolled-back) run re-applies the cut schedule exactly
-                "lr_scale": self._lr_scale,
-                # run-derived budget (PPO: min of config and store size):
-                # lets a same-config relaunch of a COMPLETED run bail
-                # before paying a rollout. A preemption-abandoned rollout
-                # truncates the store, so the just-derived total_steps
-                # UNDERSTATES the real budget — persisting it would make
-                # every later relaunch bail as "completed"; carry the
-                # restored values forward instead.
-                "total_steps": (
-                    self._restored_total_steps
-                    if self._rollout_abandoned else self.total_steps
-                ),
-                "config_total_steps": (
-                    self._restored_config_total_steps
-                    if self._rollout_abandoned
-                    else self.config.train.total_steps
-                ),
-            }
-            state.update(self._extra_state())
-            atomic_json_write(os.path.join(directory, "state.json"), state)
+            atomic_json_write(
+                os.path.join(directory, "state.json"),
+                self._resume_state_dict(),
+            )
             self._write_topology_manifest(directory)
+
+    def _resume_state_dict(self) -> Dict[str, Any]:
+        """The state.json contents: everything needed to CONTINUE the
+        run rather than replay it. The ONE builder — the checkpoint
+        save and the hang doctor's host-RAM shadow both use it, so an
+        emergency snapshot resumes exactly like a regular checkpoint."""
+        state = {
+            "iter_count": self.iter_count,
+            "best_reward": (
+                self.best_reward if np.isfinite(self.best_reward) else None
+            ),
+            "nth_evaluation": self.nth_evaluation,
+            "rng_key": self._pack_rng(),
+            # cumulative guardrail LR-cut factor: a resumed (or
+            # rolled-back) run re-applies the cut schedule exactly
+            "lr_scale": self._lr_scale,
+            # run-derived budget (PPO: min of config and store size):
+            # lets a same-config relaunch of a COMPLETED run bail
+            # before paying a rollout. A preemption-abandoned rollout
+            # truncates the store, so the just-derived total_steps
+            # UNDERSTATES the real budget — persisting it would make
+            # every later relaunch bail as "completed"; carry the
+            # restored values forward instead.
+            "total_steps": (
+                self._restored_total_steps
+                if self._rollout_abandoned else self.total_steps
+            ),
+            "config_total_steps": (
+                self._restored_config_total_steps
+                if self._rollout_abandoned
+                else self.config.train.total_steps
+            ),
+        }
+        state.update(self._extra_state())
+        return state
 
     def _topology_manifest(self) -> Dict[str, Any]:
         """The world that saved this checkpoint: mesh axis sizes, host
@@ -2510,8 +2677,12 @@ class TPUBaseTrainer(BaseRLTrainer):
             self.tokenizer.save_pretrained(directory)
         # wait out process 0's plain-file writes: racing ahead would let
         # a process enqueue device collectives that interleave with the
-        # laggard's
-        mh.barrier("save_pretrained")
+        # laggard's. With the hang doctor armed the wait is bounded: a
+        # dead peer raises BarrierTimeout instead of hanging forever.
+        mh.timed_barrier(
+            "save_pretrained",
+            self.watchdog.cfg.barrier_timeout_s if self.watchdog.enabled else 0,
+        )
 
 
 # ---------------------------------------------------------------------------

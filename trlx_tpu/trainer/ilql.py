@@ -230,13 +230,18 @@ class TPUILQLTrainer(TPUBaseTrainer):
         return self.model.make_logits_processor(params["heads"], float(beta))
 
     def make_experience(self, samples, rewards, seq_length: int = 1024) -> None:
-        if self.seq2seq:
-            self.store = make_experience_seq2seq(
-                samples, rewards, self.tokenizer, seq_length,
-                decoder_start_token_id=self.model.cfg.decoder_start_token_id,
-            )
-        else:
-            self.store = make_experience(samples, rewards, self.tokenizer, seq_length)
+        # hang doctor: offline experience building is host-bound
+        # (tokenize + index) — heartbeat it as its own phase
+        with self.watchdog.phase("experience"):
+            if self.seq2seq:
+                self.store = make_experience_seq2seq(
+                    samples, rewards, self.tokenizer, seq_length,
+                    decoder_start_token_id=self.model.cfg.decoder_start_token_id,
+                )
+            else:
+                self.store = make_experience(
+                    samples, rewards, self.tokenizer, seq_length
+                )
 
     def prepare_learning(self) -> None:
         self.eval_dataloader = self.eval_pipeline.create_loader(

@@ -486,6 +486,13 @@ class TPUPPOTrainer(TPUBaseTrainer):
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
         """Collect `num_rollouts` rollouts into the store (parity:
         reference make_experience :251-525; §3.2 call stack)."""
+        # hang doctor: the rollout phase heartbeats per chunk inside the
+        # loop, so a many-chunk collection stays healthy while a single
+        # wedged generate/score goes silent past the rollout deadline
+        with self.watchdog.phase("rollout", step=iter_count):
+            self._make_experience(num_rollouts, iter_count)
+
+    def _make_experience(self, num_rollouts: int, iter_count: int) -> None:
         logger.info("Collecting rollouts")
         self._rollout_abandoned = False
         # snapshot the prompt cursor: an abandoned (preempted) rollout
@@ -529,6 +536,12 @@ class TPUPPOTrainer(TPUBaseTrainer):
             next_gen_time = time() - rollout_generate_time
         chunk_rows = len(next_batch.input_ids) * mh.data_group_count(self.mesh)
         while n_collected < num_rollouts:
+            self.watchdog.beat("rollout", step=iter_count)
+            if self.chaos is not None:
+                # chaos: the sampler wedges at the top of this chunk —
+                # the rollout phase goes silent and the watchdog's
+                # deadline (not the scheduler) must end the run
+                self.chaos.stall("stall_rollout")
             # rollout collection dominates PPO wall-clock: a preemption
             # landing here must not wait out the remaining chunks (the
             # grace period would expire before the final save). Abandon
@@ -1021,7 +1034,8 @@ class TPUPPOTrainer(TPUBaseTrainer):
         cursor0 = self._prompt_batches_consumed
         batch = self._next_prompt_batch()
         t0 = time()
-        gen = self.generate(batch.input_ids, batch.attention_mask)
+        with self.watchdog.phase("rollout", step=self.iter_count):
+            gen = self.generate(batch.input_ids, batch.attention_mask)
         self._prefetched_gen = (batch, gen, time() - t0)
         self._prefetch_cursor_start = cursor0
 

@@ -106,9 +106,14 @@ class TPURFTTrainer(TPUBaseTrainer):
         reference make_experience :117-197)."""
         method = self.config.method
         if self.epoch_count % method.n_improve_steps == 0:
+            # hang doctor: RFT's generate+score sweep is its rollout
+            # phase — heartbeat per generation so a wedged sampler (or
+            # reward call, which has its own phase) trips the deadline
+            self.watchdog.beat("rollout", "start", step=self.iter_count)
             generations = []
             for batch in self.prompt_dataloader:
                 for _ in range(method.n_generations_per_prompt):
+                    self.watchdog.beat("rollout", step=self.iter_count)
                     out = self.generate(batch.input_ids, batch.attention_mask)
                     sequences = mh.local_rows(out["sequences"])
                     # ragged multi-host batches come back padded with
@@ -146,6 +151,7 @@ class TPURFTTrainer(TPUBaseTrainer):
                     self.generations_per_prompt[g["prompt"]].append(
                         {"output": g["output"], "score": g["score"]}
                     )
+            self.watchdog.beat("rollout", "end", step=self.iter_count)
 
         per_prompt_scores = [
             [x["score"] for x in self.generations_per_prompt[p]]

@@ -93,8 +93,16 @@ class TPUSFTTrainer(TPUBaseTrainer):
         seq_length: int = 1024,
     ) -> None:
         del rewards  # SFT ignores rewards (parity: reference :80-88)
-        dialogs = [tokenize_dialogue(s, self.tokenizer, seq_length) for s in samples]
-        self.store = DialogStore(dialogs, self.tokenizer, max_length=seq_length)
+        # hang doctor: tokenization is host-bound but can still wedge on
+        # a slow/remote tokenizer backend — heartbeat it as its own phase
+        with self.watchdog.phase("experience"):
+            dialogs = [
+                tokenize_dialogue(s, self.tokenizer, seq_length)
+                for s in samples
+            ]
+            self.store = DialogStore(
+                dialogs, self.tokenizer, max_length=seq_length
+            )
 
     def prepare_learning(self) -> None:
         self.eval_dataloader = self.eval_pipeline.create_loader(

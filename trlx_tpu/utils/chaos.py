@@ -33,6 +33,27 @@ to an exact cycle/call):
                   the ``multihost.consensus`` compare (simulates one
                   host's state silently drifting); consulted once per
                   consistency check (train.guardrails.consistency_every).
+  stall_rollout   sleep ``stall_delay`` seconds at the top of a rollout
+                  chunk (a wedged sampler / dead generation collective);
+                  consulted once per rollout chunk iteration.
+  stall_reward    sleep ``stall_delay`` seconds in the reward path,
+                  OUTSIDE the resilient per-attempt deadline (a reward
+                  service that hangs rather than erroring — a deadline
+                  would cut the hang short and neutralize the fault);
+                  consulted once per ``_call_reward_fn`` entry, not per
+                  retry attempt.
+  stall_collective sleep ``stall_delay`` seconds right after the train
+                  step / fused block is dispatched (the host blocked in
+                  a wedged device collective); consulted once per fused
+                  block (fused path) or per optimizer step (per-step
+                  loop — a trainer uses exactly one of the two paths, so
+                  the counter stays deterministic).
+
+  The three stall sites exist to prove the hang doctor
+  (utils/watchdog.py) end to end: detection -> stack dump -> emergency
+  snapshot -> abort with the "stalled" exit class. Pick a
+  ``stall_delay`` comfortably past the configured
+  ``train.watchdog`` deadline.
 
 Schedule entries select by count: ``{"fault": "nan_loss", "at": 2}``
 fires on the 2nd consult (1-based), ``{"fault": ..., "at": 2, "span": 3}``
@@ -65,6 +86,9 @@ FAULT_SITES = (
     "ckpt_fail",
     "ckpt_corrupt",
     "host_divergence",
+    "stall_rollout",
+    "stall_reward",
+    "stall_collective",
 )
 
 
@@ -94,7 +118,7 @@ class ChaosMonkey:
 
     def __init__(self, config: Optional[Dict[str, Any]]):
         config = dict(config or {})
-        known = {"seed", "faults", "reward_delay"}
+        known = {"seed", "faults", "reward_delay", "stall_delay"}
         unknown = set(config) - known
         if unknown:
             raise ValueError(
@@ -103,6 +127,7 @@ class ChaosMonkey:
             )
         self.seed = int(config.get("seed", 0))
         self.reward_delay = float(config.get("reward_delay", 0.2))
+        self.stall_delay = float(config.get("stall_delay", 2.0))
         self._entries: Dict[str, List[_Entry]] = {s: [] for s in FAULT_SITES}
         self._counts: Dict[str, int] = {s: 0 for s in FAULT_SITES}
         self._rngs: Dict[str, random.Random] = {
@@ -170,11 +195,26 @@ class ChaosMonkey:
     ) -> None:
         """Consulted at the top of every reward call (retries included):
         raises for ``reward_error``, sleeps ``reward_delay`` for
-        ``reward_timeout`` (tripping a configured resilient deadline)."""
+        ``reward_timeout`` (tripping a configured resilient deadline).
+        ``stall_reward`` deliberately does NOT live here: this function
+        runs INSIDE the resilient per-attempt deadline, which would cut
+        the injected hang short — the trainer consults that site before
+        entering the resilient caller (base.py ``_call_reward_fn``)."""
         if self.consult("reward_error"):
             raise ChaosFault("chaos: injected reward exception")
         if self.consult("reward_timeout"):
             sleep(self.reward_delay)
+
+    def stall(
+        self, site: str, sleep: Callable[[float], None] = time.sleep
+    ) -> bool:
+        """Shared body for the three ``stall_*`` sites: consult, and on
+        a hit sleep ``stall_delay`` seconds (the hang the watchdog must
+        catch). Returns whether the site fired."""
+        if self.consult(site):
+            sleep(self.stall_delay)
+            return True
+        return False
 
     def corrupt_checkpoint(self, directory: str) -> Optional[str]:
         """``ckpt_corrupt`` body: flip one bit in the middle of the
@@ -220,6 +260,36 @@ class ChaosMonkey:
                 n = 1
             return [float("nan")] * n
         return out
+
+
+def poison_batch(batch):
+    """``nan_loss`` body shared by the fused and per-step train paths:
+    a poisoned COPY of a device batch (the source arrays stay clean, so
+    the burst ends when the schedule says it ends). Float leaves become
+    NaN; a batch with NO float leaves (the offline int-token batches —
+    SFT/ILQL ids + labels) gets its int leaves set to a huge
+    out-of-range index instead, which the embedding gather turns into
+    NaN hidden states under XLA's fill mode (the same OOB behavior
+    base.py validates tokenizers against). Either way the loss comes
+    out non-finite IN-GRAPH, so the traced skip-guard — not just the
+    host-side counter — is exercised."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(batch)
+    has_float = any(
+        jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) for x in leaves
+    )
+
+    def poison(x):
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return jnp.full_like(x, jnp.nan)
+        if not has_float and jnp.issubdtype(x.dtype, jnp.integer):
+            return jnp.full_like(x, 2 ** 30)
+        return x
+
+    return jax.tree_util.tree_map(poison, batch)
 
 
 def build_chaos(train_config) -> Optional[ChaosMonkey]:

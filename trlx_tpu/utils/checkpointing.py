@@ -57,6 +57,14 @@ TOPOLOGY_MANIFEST = "topology.json"
 QUARANTINE_SUFFIX = ".corrupt"
 _TMP_PREFIX = "tmp_"
 _STEP_RE = re.compile(r"^checkpoint_(\d+)$")
+# hang-doctor emergency snapshots: persisted from the host-RAM shadow
+# when the watchdog trips. Deliberately OUTSIDE the step-checkpoint
+# namespace — discovery/auto-resume never picks one implicitly (the
+# operator/runner resumes it via an explicit resume_from_checkpoint
+# path after reading the stall report), retention never reaps it, and
+# verify_ckpt.py reports it distinctly.
+EMERGENCY_PREFIX = "emergency_checkpoint_"
+STALL_REPORT_FILE = "stall_report.json"
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -302,6 +310,19 @@ def is_committed(directory: str) -> bool:
     return os.path.isfile(os.path.join(directory, COMMIT_MARKER))
 
 
+def is_emergency(directory: str) -> bool:
+    """True iff `directory` is a hang-doctor emergency snapshot (its
+    commit marker carries ``emergency: true``). Emergency snapshots are
+    loadable like any committed checkpoint but are written from the
+    host-RAM shadow mid-stall, never health-gate-discovered, and
+    ``verify_ckpt.py --write-manifest`` refuses to bless them."""
+    try:
+        with open(os.path.join(directory, COMMIT_MARKER)) as f:
+            return bool(json.load(f).get("emergency"))
+    except Exception:
+        return False
+
+
 class CheckpointManager:
     """Atomic checkpoint commits + discovery + retention under one root.
 
@@ -333,6 +354,12 @@ class CheckpointManager:
         # write a per-file sha256 manifest inside every commit (the
         # load-time half — verify + quarantine — is the trainer's call)
         self.integrity = integrity
+        # host-RAM shadow of the last health-gated training state, for
+        # the hang doctor's emergency snapshot (utils/watchdog.py): the
+        # trainer refreshes it at healthy checkpoint commits with host
+        # numpy copies, so persisting it never touches the (possibly
+        # wedged) device
+        self._shadow: Optional[Dict[str, Any]] = None
 
     # -- commit ----------------------------------------------------------
 
@@ -448,11 +475,104 @@ class CheckpointManager:
         return final
 
     @staticmethod
-    def _write_marker(directory: str, name: str) -> None:
-        atomic_json_write(
-            os.path.join(directory, COMMIT_MARKER),
-            {"name": name, "time": time.time()},
+    def _write_marker(
+        directory: str, name: str, emergency: bool = False
+    ) -> None:
+        marker: Dict[str, Any] = {"name": name, "time": time.time()}
+        if emergency:
+            # hang-doctor snapshot: discoverable to trainer.load() (the
+            # marker makes it committed) but flagged so verify_ckpt.py
+            # reports it distinctly and refuses --write-manifest on it
+            marker["emergency"] = True
+        atomic_json_write(os.path.join(directory, COMMIT_MARKER), marker)
+
+    # -- hang-doctor shadow + emergency snapshot -------------------------
+
+    def update_shadow(
+        self,
+        state_tree: Dict[str, Any],
+        state_json: Dict[str, Any],
+        manifests: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Refresh the host-RAM shadow: ``state_tree`` must already be
+        HOST numpy (the trainer device_gets it at a healthy commit
+        boundary), ``state_json`` the resume metadata that would go to
+        state.json, ``manifests`` extra JSON sidecars (topology). Cheap
+        bookkeeping only — no hashing, no I/O."""
+        self._shadow = {
+            "tree": state_tree,
+            "state": dict(state_json),
+            "manifests": dict(manifests or {}),
+            "step": int(state_json.get("iter_count", 0)),
+        }
+
+    @property
+    def has_shadow(self) -> bool:
+        return self._shadow is not None
+
+    def emergency_snapshot(
+        self, report: Optional[Dict[str, Any]] = None
+    ) -> Optional[str]:
+        """Persist the host-RAM shadow as
+        ``<root>/emergency_checkpoint_<step>`` — the hang doctor's last
+        act before the stalled abort. Pure host-side (numpy + file I/O,
+        orbax over host arrays), safe to run from the monitor thread
+        while the device is wedged; NOT collective (each caller writes
+        alone) and NOT picked up by auto-resume discovery — the
+        operator resumes it via an explicit ``resume_from_checkpoint``
+        path after reading the stall report (written alongside as
+        ``stall_report.json``). Layout matches a regular checkpoint
+        (``state/`` + ``state.json`` + integrity manifest + COMMIT
+        marker with ``emergency: true``) so ``trainer.load()`` restores
+        it unchanged. Returns the final path, or None without a shadow.
+        """
+        shadow = self._shadow
+        if shadow is None:
+            logger.error(
+                "emergency snapshot requested but no host-RAM shadow "
+                "exists yet (no health-gated commit has run) — nothing "
+                "to persist"
+            )
+            return None
+        import orbax.checkpoint as ocp
+
+        name = f"{EMERGENCY_PREFIX}{shadow['step']}"
+        final = os.path.join(self.root, name)
+        tmp = os.path.join(self.root, _TMP_PREFIX + name)
+        shutil.rmtree(tmp, ignore_errors=True)
+        if os.path.isdir(final):
+            # a second trip in the same process (or a leftover from a
+            # prior stalled run at the same step): keep the existing
+            # snapshot — it holds the same shadow state. A SIGKILL
+            # between the prior run's rename and marker write leaves it
+            # torn; the marker is idempotent, so repair it rather than
+            # returning a directory is_committed/is_emergency reject.
+            if not is_committed(final):
+                self._write_marker(final, name, emergency=True)
+            logger.warning("emergency snapshot %s already exists", final)
+            return final
+        os.makedirs(tmp)
+        ocp.PyTreeCheckpointer().save(
+            os.path.join(tmp, "state"), shadow["tree"], force=True
         )
+        atomic_json_write(os.path.join(tmp, "state.json"), shadow["state"])
+        for fname, obj in shadow["manifests"].items():
+            atomic_json_write(os.path.join(tmp, fname), obj)
+        if report is not None:
+            atomic_json_write(os.path.join(tmp, STALL_REPORT_FILE), report)
+        if self.integrity:
+            write_integrity_manifest(tmp)
+        fsync_tree(tmp)
+        os.rename(tmp, final)
+        _fsync_path(self.root)
+        self._write_marker(final, name, emergency=True)
+        logger.error(
+            "emergency snapshot committed: %s (step %d, from the "
+            "host-RAM shadow of the last health-gated state) — resume "
+            "it explicitly via train.resume_from_checkpoint",
+            final, shadow["step"],
+        )
+        return final
 
     # -- discovery -------------------------------------------------------
 

@@ -31,6 +31,17 @@ step and poison the "last good checkpoint" that rollback depends on.
 
 Everything here is pure host-side bookkeeping (no jax at module scope);
 trainer/base.py owns executing the actions.
+
+Trip signals: ``loss`` / ``grad_norm`` / ``cycle_time`` (observe_train),
+``kl`` / ``reward`` (observe_rollout), plus the externally-detected
+kinds recorded via :meth:`GuardrailMonitor.trip` — ``consistency``
+(the PR 4 cross-host fingerprint watchdog), ``peer`` (a synthetic
+lockstep trip), and ``stall`` (:data:`STALL_SIGNAL`, the hang doctor:
+utils/watchdog.py records it when a phase blows its heartbeat deadline
+— on the soft path, a cross-host straggler report, the trip walks this
+ladder; on the hard path, a frozen loop, it lands in ``trip_history``
+just before the stack dump / emergency snapshot / stalled abort, so
+trip history and cooldown accounting stay unified either way).
 """
 
 from __future__ import annotations
@@ -45,6 +56,13 @@ from trlx_tpu.utils import logging
 logger = logging.get_logger(__name__)
 
 LADDER_ACTIONS = ("log", "requeue", "lr_cut", "rollback", "abort")
+
+# the hang doctor's trip kind (utils/watchdog.py): a phase went silent
+# past its heartbeat deadline. Soft detections (cross-host straggler
+# report) escalate the ladder like any other signal; hard detections
+# (frozen loop) record it here and then abort with the stalled exit
+# class — either way the trip history names the stall.
+STALL_SIGNAL = "stall"
 
 
 def _finite(x) -> bool:

@@ -1,0 +1,467 @@
+"""Hang doctor: phase heartbeats, stall detection, emergency snapshots.
+
+The guardrails ladder (PR 3) and elastic recovery (PR 4) only fire when
+the training loop *advances* and produces a bad signal; a loop that
+stops advancing — a wedged device collective, a reward service that
+never returns, a barrier waiting on a dead peer — is invisible to them
+and burns the whole job allocation silently until the scheduler kills
+it, losing everything since the last checkpoint. This module makes a
+stall a fast, diagnosable exit instead:
+
+  HeartbeatRegistry / HangWatchdog
+      trainers beat at phase boundaries (rollout start/end, reward
+      call, fused block, checkpoint commit, eval) — host-side counters
+      only, no device sync, so a beat costs a lock and a deque append.
+      A monitor thread compares each in-progress phase's time since its
+      last beat against a per-phase deadline.
+  deadlines
+      ``train.watchdog.deadline_s`` (per phase) and ``default_deadline_s``
+      are FLOORS; once ``min_samples`` completed durations of a phase
+      have been observed, the effective deadline is
+      ``max(floor, scale_factor * rolling median duration)`` — a
+      slow-but-healthy CPU run (or a 10x-slower debug build) raises its
+      own deadlines instead of false-tripping. Mild slowdowns are the
+      guardrails' ``cycle_time_factor``'s job; the watchdog hunts hangs.
+  escalation on trip
+      1. dump every Python thread's stack plus the last-N phase
+         timeline to the log (the post-mortem a wedged NCCL/DeepSpeed
+         run never gives you),
+      2. attempt an EMERGENCY SNAPSHOT from the host-RAM shadow of the
+         last health-gated state (kept by ``CheckpointManager`` — see
+         ``update_shadow``/``emergency_snapshot`` there — so persisting
+         never touches the possibly-wedged device),
+      3. abort the process with :data:`EXIT_STALLED`, a nonzero exit
+         class the relaunch runner can distinguish from a crash (exit 1)
+         and from a clean preemption (exit 0).
+      The trip is also recorded in the guardrails trip history as the
+      ``stall`` signal (utils/guardrails.py), so trip accounting stays
+      unified across the soft (ladder) and hard (abort) paths.
+
+Cross-host, ``parallel/multihost.timed_barrier`` bounds barrier waits
+and ``straggler_report`` (on the PR 4 ``consensus`` gather) names WHICH
+host/phase is behind while collectives still work; a fully wedged pod
+degenerates to every host's own watchdog tripping the same exit class.
+
+Everything here is host-side and jax-free at module scope; the clock,
+sleep and abort hooks are injectable so tier-1 tests run on a fake
+clock with no real threads (``tests/test_watchdog.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+# the "stalled" exit class: distinct from a clean exit (0) and from a
+# crash/abort RuntimeError (1), so the relaunch runner can route a stall
+# to "resume from the emergency snapshot / last checkpoint and page
+# nobody" instead of treating it as a code bug
+EXIT_STALLED = 87
+
+# the canonical phase names the trainers beat (free-form names are
+# allowed — these are the ones the shipped deadlines/docs talk about)
+PHASES = (
+    "rollout", "reward", "fused_block", "train_step", "checkpoint",
+    "eval", "experience",
+)
+
+
+@dataclass
+class WatchdogConfig:
+    """Parsed ``train.watchdog`` section (plain dict in YAML).
+
+    enabled             master switch (default off: zero-cost beats, no
+                        monitor thread — behavior-preserving).
+    default_deadline_s  floor deadline for any phase without an explicit
+                        entry in ``deadline_s``.
+    deadline_s          per-phase floor deadlines, e.g.
+                        ``{rollout: 300, reward: 120}``.
+    scale_factor        once ``min_samples`` completed durations of a
+                        phase are observed, the effective deadline is
+                        ``max(floor, scale_factor * rolling median)`` —
+                        auto-scaling that absorbs a uniformly slow
+                        environment (CPU runs) without false trips.
+    min_samples         completed durations before auto-scaling arms.
+    window              rolling-window length for phase durations.
+    poll_interval_s     monitor-thread check cadence.
+    timeline            number of recent beats kept for the stall report.
+    idle_deadline_s     trip when NO phase beats at all for this long
+                        while the watchdog is armed (catches wedges
+                        between phases); 0 disables.
+    dump_stacks         include all-thread Python stacks in the report.
+    emergency_snapshot  attempt a host-RAM-shadow snapshot on trip
+                        (single-host / fully-addressable state only —
+                        multihost gets the stack dump + stalled exit).
+    barrier_timeout_s   deadline handed to ``multihost.timed_barrier``
+                        for host-sync points while the watchdog is
+                        armed; 0 keeps untimed barriers.
+    """
+
+    enabled: bool = False
+    default_deadline_s: float = 600.0
+    deadline_s: Dict[str, float] = field(default_factory=dict)
+    scale_factor: float = 16.0
+    min_samples: int = 3
+    window: int = 8
+    poll_interval_s: float = 1.0
+    timeline: int = 64
+    idle_deadline_s: float = 0.0
+    dump_stacks: bool = True
+    emergency_snapshot: bool = True
+    barrier_timeout_s: float = 0.0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "WatchdogConfig":
+        d = dict(d or {})
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"train.watchdog: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        if "deadline_s" in d:
+            d["deadline_s"] = {
+                str(k): float(v) for k, v in dict(d["deadline_s"]).items()
+            }
+        return cls(**d)
+
+
+@dataclass
+class StallReport:
+    """What tripped: the phase, how long since its last beat, the
+    deadline it blew, and a copy of the recent beat timeline.
+    ``detail`` carries the verdict verbatim for externally-detected
+    stalls (a timed barrier blowing its deadline has its own message;
+    the silent-age phrasing would be meaningless there)."""
+
+    phase: str
+    age_s: float
+    deadline_s: float
+    step: Optional[int]
+    timeline: List[tuple]
+    detail: str = ""
+
+    @property
+    def summary(self) -> str:
+        if self.detail:
+            return self.detail
+        return (
+            f"phase {self.phase!r} silent for {self.age_s:.1f}s "
+            f"(deadline {self.deadline_s:.1f}s"
+            + (f", step {self.step}" if self.step is not None else "")
+            + ")"
+        )
+
+
+class _PhaseState:
+    __slots__ = (
+        "started_at", "last_beat", "step", "beats", "durations", "total_s",
+    )
+
+    def __init__(self, window: int):
+        self.started_at: Optional[float] = None  # None = not in progress
+        self.last_beat: float = 0.0
+        self.step: Optional[int] = None
+        self.beats: int = 0  # total beats ever
+        self.durations: deque = deque(maxlen=max(window, 1))
+        # cumulative wall seconds spent in this phase — the straggler-
+        # attribution signal: at a lockstep gather every host has run
+        # the SAME iterations (equal beat counts by construction), but
+        # a slow host's wall time per phase is larger
+        self.total_s: float = 0.0
+
+    def median_duration(self) -> Optional[float]:
+        if not self.durations:
+            return None
+        s = sorted(self.durations)
+        mid = len(s) // 2
+        return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class HangWatchdog:
+    """Heartbeat registry + stall monitor.
+
+    Trainers call :meth:`beat` (or the :meth:`phase` context manager) at
+    phase boundaries; :meth:`check` is the pure detection core (fake-
+    clock testable), and :meth:`start`/:meth:`stop` run it on a daemon
+    monitor thread. On a trip the thread walks its escalation —
+    stack-dump + timeline to the log, the registered ``on_stall``
+    callbacks (the trainer hooks the guardrails trip record and the
+    emergency snapshot in), then ``abort(EXIT_STALLED)``.
+    """
+
+    def __init__(
+        self,
+        config: WatchdogConfig,
+        clock: Callable[[], float] = time.monotonic,
+        abort: Callable[[int], None] = os._exit,
+    ):
+        self.cfg = config
+        self._clock = clock
+        self._abort = abort
+        self._lock = threading.Lock()
+        self._phases: Dict[str, _PhaseState] = {}
+        self._timeline: deque = deque(maxlen=max(config.timeline, 1))
+        self._last_beat: Optional[float] = None  # any phase, any event
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._on_stall: List[Callable[[StallReport], None]] = []
+        self.tripped: Optional[StallReport] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    def on_stall(self, callback: Callable[[StallReport], None]) -> None:
+        """Register an escalation callback (run on the MONITOR thread,
+        after the stack dump, before the abort — keep it host-side)."""
+        self._on_stall.append(callback)
+
+    # -- heartbeats ------------------------------------------------------
+
+    def _state(self, phase: str) -> _PhaseState:
+        st = self._phases.get(phase)
+        if st is None:
+            st = self._phases[phase] = _PhaseState(self.cfg.window)
+        return st
+
+    def beat(self, phase: str, event: str = "point",
+             step: Optional[int] = None) -> None:
+        """Record a heartbeat. ``event`` is ``start``/``end``/``point``;
+        a ``point`` beat inside an in-progress phase refreshes its
+        staleness clock (a healthy many-chunk rollout keeps beating per
+        chunk; a single wedged chunk goes silent). Host-side only."""
+        if not self.cfg.enabled:
+            return
+        now = self._clock()
+        with self._lock:
+            st = self._state(phase)
+            st.beats += 1
+            st.last_beat = now
+            if step is not None:
+                st.step = step
+            if event == "start":
+                st.started_at = now
+            elif event == "end":
+                if st.started_at is not None:
+                    st.durations.append(now - st.started_at)
+                    st.total_s += now - st.started_at
+                st.started_at = None
+            self._last_beat = now
+            self._timeline.append((now, phase, event, step))
+
+    @contextmanager
+    def phase(self, name: str, step: Optional[int] = None):
+        """``with watchdog.phase("rollout"):`` — start/end beat pair,
+        end guaranteed on exceptions so a raised phase never lingers as
+        a false in-progress stall."""
+        self.beat(name, "start", step)
+        try:
+            yield self
+        finally:
+            self.beat(name, "end", step)
+
+    # -- detection -------------------------------------------------------
+
+    def effective_deadline(self, phase: str) -> float:
+        """Configured floor, raised by observed-duration auto-scaling
+        once ``min_samples`` completed durations exist."""
+        cfg = self.cfg
+        floor = float(cfg.deadline_s.get(phase, cfg.default_deadline_s))
+        st = self._phases.get(phase)
+        if st is not None and len(st.durations) >= cfg.min_samples:
+            med = st.median_duration()
+            if med is not None:
+                return max(floor, cfg.scale_factor * med)
+        return floor
+
+    def check(self, now: Optional[float] = None) -> Optional[StallReport]:
+        """Pure detection. Only the INNERMOST in-progress phase (the
+        most recently started) is judged, and its staleness clock is
+        the time since the last beat ANYWHERE: phases nest (PPO's
+        reward call runs inside the rollout phase), and an outer phase
+        whose sub-work is still beating is progressing, not stalled —
+        judging it by its own sparse boundary beats would falsely kill
+        a healthy run whose inner phase is merely long. Falls back to
+        the global idle deadline when nothing is in progress. None =
+        healthy."""
+        if not self.cfg.enabled:
+            return None
+        now = self._clock() if now is None else now
+        with self._lock:
+            inner_name, inner = None, None
+            for name, st in self._phases.items():
+                if st.started_at is None:
+                    continue
+                if inner is None or st.started_at > inner.started_at:
+                    inner_name, inner = name, st
+            if inner is not None:
+                age = now - (self._last_beat or inner.last_beat)
+                deadline = self.effective_deadline(inner_name)
+                if age > deadline:
+                    return StallReport(
+                        phase=inner_name, age_s=age, deadline_s=deadline,
+                        step=inner.step, timeline=list(self._timeline),
+                    )
+            if (
+                self.cfg.idle_deadline_s > 0
+                and self._last_beat is not None
+                and now - self._last_beat > self.cfg.idle_deadline_s
+            ):
+                return StallReport(
+                    phase="<idle>", age_s=now - self._last_beat,
+                    deadline_s=self.cfg.idle_deadline_s, step=None,
+                    timeline=list(self._timeline),
+                )
+        return None
+
+    def phase_ages(self) -> Dict[str, float]:
+        """Host-side phase counters for the cross-host straggler report
+        (``multihost.straggler_report``): cumulative wall seconds per
+        phase (``time/`` — the detection signal: lockstep hosts have
+        done identical work, so a larger wall total names the slow
+        host), beat counts (``beats/`` — equal at a lockstep gather,
+        they catch a host whose control flow diverged) and in-progress
+        ages (``age/`` — annotation). Values must be
+        float32-representable (they ride the consensus gather)."""
+        now = self._clock()
+        out: Dict[str, float] = {}
+        with self._lock:
+            for name, st in self._phases.items():
+                out[f"beats/{name}"] = float(st.beats)
+                total = st.total_s
+                if st.started_at is not None:
+                    total += now - st.started_at  # count the open phase
+                out[f"time/{name}"] = round(float(total), 1)
+                out[f"age/{name}"] = round(
+                    float(now - st.last_beat) if st.beats else 0.0, 1
+                )
+        return out
+
+    # -- reporting / escalation -----------------------------------------
+
+    def format_report(self, report: StallReport) -> str:
+        """The operator-facing stall report: verdict, the last-N beat
+        timeline, and (``dump_stacks``) every Python thread's stack —
+        the main thread's frame names the exact call the loop wedged in
+        (docs/robustness.md "Hang doctor" explains how to read it)."""
+        lines = [f"HANG DOCTOR: stall detected — {report.summary}"]
+        lines.append("phase timeline (oldest first):")
+        t0 = report.timeline[0][0] if report.timeline else 0.0
+        for when, phase, event, step in report.timeline:
+            lines.append(
+                f"  +{when - t0:9.3f}s  {phase:<12} {event:<6}"
+                + (f" step={step}" if step is not None else "")
+            )
+        if self.cfg.dump_stacks:
+            lines.append("all-thread Python stacks:")
+            frames = sys._current_frames()
+            main_id = threading.main_thread().ident
+            for tid, frame in frames.items():
+                thread = next(
+                    (t for t in threading.enumerate() if t.ident == tid), None
+                )
+                name = thread.name if thread else f"tid={tid}"
+                tag = " [MAIN — where the loop is wedged]" if tid == main_id else ""
+                lines.append(f"-- thread {name}{tag}:")
+                lines.extend(
+                    "  " + l.rstrip()
+                    for l in traceback.format_stack(frame)
+                )
+        return "\n".join(lines)
+
+    def trip_external(
+        self, phase: str, detail: str, step: Optional[int] = None
+    ) -> None:
+        """A stall detected OUTSIDE the monitor thread (a timed barrier
+        blowing its deadline): run the SAME escalation — full stall
+        report with stacks + timeline, the registered callbacks
+        (guardrails record, emergency snapshot), stalled abort — so the
+        two detection paths cannot drift apart in what the operator
+        gets. Does not return under the default abort hook."""
+        with self._lock:
+            timeline = list(self._timeline)
+        self._handle_stall(
+            StallReport(
+                phase=phase, age_s=0.0, deadline_s=0.0, step=step,
+                timeline=timeline, detail=detail,
+            )
+        )
+
+    def _handle_stall(self, report: StallReport) -> None:
+        self.tripped = report
+        try:
+            logger.error("%s", self.format_report(report))
+        except Exception:  # the report must never block the abort
+            logger.error("HANG DOCTOR: stall detected — %s "
+                         "(report rendering failed)", report.summary)
+        for cb in self._on_stall:
+            try:
+                cb(report)
+            except Exception as e:
+                logger.error("hang doctor escalation step failed: %s", e)
+        logger.error(
+            "HANG DOCTOR: aborting with exit class %d (stalled). The "
+            "runner should resume from the emergency snapshot / last "
+            "committed checkpoint.", EXIT_STALLED,
+        )
+        # flush before _exit skips interpreter teardown
+        for stream in (sys.stdout, sys.stderr):
+            try:
+                stream.flush()
+            except Exception:
+                pass
+        self._abort(EXIT_STALLED)
+
+    # -- monitor thread --------------------------------------------------
+
+    def start(self) -> None:
+        """Arm the monitor thread (idempotent; no-op when disabled)."""
+        if not self.cfg.enabled or self._thread is not None:
+            return
+        with self._lock:
+            if self._last_beat is None:
+                # arm the idle deadline from NOW: a run that wedges
+                # before the first phase ever beats must still trip it
+                self._last_beat = self._clock()
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="trlx-hang-doctor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Disarm and join the monitor thread."""
+        thread, self._thread = self._thread, None
+        if thread is None:
+            return
+        self._stop_evt.set()
+        thread.join(timeout=max(self.cfg.poll_interval_s * 4, 2.0))
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.cfg.poll_interval_s):
+            report = self.check()
+            if report is not None:
+                self._handle_stall(report)
+                return
+
+
+def build_watchdog(train_config, **kwargs) -> HangWatchdog:
+    """TrainConfig -> watchdog (the ``watchdog`` field is a plain dict
+    so the flat config dataclass stays YAML/back-compatible)."""
+    return HangWatchdog(
+        WatchdogConfig.from_dict(getattr(train_config, "watchdog", None)),
+        **kwargs,
+    )
