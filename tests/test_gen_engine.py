@@ -1,0 +1,429 @@
+"""Decode-engine golden equivalence (ISSUE 6 tentpole).
+
+The serving-grade rollout engine (models/gen_engine.py) must be a
+drop-in for the static sampler on every correctness axis:
+
+  * continuous batching: responses are token-for-token the static
+    sampler's under greedy, and invariant to slot count / page size /
+    paging mode / batch composition,
+  * paged int8 KV: tracks the unquantized pool closely (same greedy
+    tokens on a tiny model; bounded attention error at the op level),
+  * speculative decoding: bit-identical to the non-speculative engine
+    stream when the draft equals the policy (greedy AND fixed-seed
+    sampling — rejection sampling leaves the distribution exactly the
+    policy's), and exact-greedy even under a disagreeing draft,
+  * the page allocator conserves pages and reuses freed ones.
+
+Everything here is CPU-sized (2-layer / 16-hidden / 64-vocab model);
+the perf claims live in bench.py's decode section.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.gen_engine import (
+    EngineSpec,
+    GenEngineConfig,
+    engine_generate,
+)
+from trlx_tpu.models.generation import SamplerSettings, generate
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.ops import paged_kv
+
+EOS, PAD = 7, 9
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=16, n_layer=2, n_head=2, n_positions=64,
+        dtype=jnp.float32,
+    )
+    lm = TransformerLM(cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    return lm, params
+
+
+@pytest.fixture(scope="module")
+def queue():
+    Q, P = 5, 6
+    ids = jax.random.randint(jax.random.PRNGKey(1), (Q, P), 0, 64)
+    mask = jnp.ones((Q, P), jnp.int32).at[0, :2].set(0).at[3, :1].set(0)
+    return ids, mask
+
+
+def _settings(do_sample, n=8):
+    return SamplerSettings(
+        max_new_tokens=n, do_sample=do_sample, eos_token_id=EOS,
+        pad_token_id=PAD,
+    )
+
+
+def _run(lm, params, ids, mask, settings, spec, draft=None, budget=None):
+    fn = jax.jit(
+        lambda p, d, i, m, r, b: engine_generate(
+            lm, p, i, m, r, settings, spec, draft_params=d, row_budget=b
+        )
+    )
+    return fn(params, draft, ids, mask, jax.random.PRNGKey(2), budget)
+
+
+@pytest.fixture(scope="module")
+def greedy_dense(tiny_lm, queue):
+    lm, params = tiny_lm
+    ids, mask = queue
+    return generate(
+        lm, params, ids, mask, jax.random.PRNGKey(2), _settings(False)
+    )
+
+
+@pytest.mark.parametrize(
+    "paged,quant", [(True, "int8"), (True, None), (False, None)]
+)
+def test_engine_greedy_matches_static_sampler(
+    tiny_lm, queue, greedy_dense, paged, quant
+):
+    """Continuous batching (slots < queue, refills mid-run) + paging +
+    int8 pools change NOTHING about greedy output vs the static
+    whole-batch sampler."""
+    lm, params = tiny_lm
+    ids, mask = queue
+    out = _run(
+        lm, params, ids, mask, _settings(False),
+        EngineSpec(slots=2, page_size=4, paged=paged, kv_quant=quant),
+    )
+    assert np.array_equal(
+        np.asarray(out["response_ids"]), np.asarray(greedy_dense["response_ids"])
+    )
+    assert np.array_equal(
+        np.asarray(out["response_mask"]),
+        np.asarray(greedy_dense["response_mask"]),
+    )
+    g = out["gen_stats"]
+    assert int(g["unserved"]) == 0
+    assert int(g["refills"]) >= ids.shape[0]  # every prompt got a slot
+    assert int(g["real_tokens"]) == int(
+        np.asarray(greedy_dense["response_mask"]).sum()
+    )
+
+
+def test_engine_stream_invariant_to_slot_geometry(tiny_lm, queue):
+    """The sampled stream is keyed per (prompt, position): slot count,
+    page size, and paging mode must not change a single token — this is
+    what makes engine rollouts reproducible across geometry changes
+    (and batch composition) by construction."""
+    lm, params = tiny_lm
+    ids, mask = queue
+    st = _settings(True)
+    a = _run(lm, params, ids, mask, st, EngineSpec(slots=1, page_size=4))
+    b = _run(
+        lm, params, ids, mask, st,
+        EngineSpec(slots=4, page_size=8, paged=False),
+    )
+    assert np.array_equal(
+        np.asarray(a["response_ids"]), np.asarray(b["response_ids"])
+    )
+    # batch composition: the first 3 prompts alone sample the same
+    # continuations they sample inside the 5-prompt queue
+    c = _run(
+        lm, params, ids[:3], mask[:3], st, EngineSpec(slots=2, page_size=4)
+    )
+    assert np.array_equal(
+        np.asarray(c["response_ids"]), np.asarray(a["response_ids"])[:3]
+    )
+
+
+@pytest.mark.parametrize("do_sample", [False, True])
+def test_spec_decode_matches_nonspec_bit_exact(tiny_lm, queue, do_sample):
+    """Draft == policy: every draft is accepted and the speculative
+    stream must be BIT-IDENTICAL to the non-speculative engine stream —
+    greedy and fixed-seed sampling both (the RNG contract keys draws on
+    (prompt, position), not on the decode schedule)."""
+    lm, params = tiny_lm
+    ids, mask = queue
+    st = _settings(do_sample, n=9)
+    budget = jnp.asarray([3, 9, 5, 1, 7], jnp.int32)
+    base = _run(
+        lm, params, ids, mask, st, EngineSpec(slots=2, page_size=4),
+        budget=budget,
+    )
+    spec = _run(
+        lm, params, ids, mask, st,
+        EngineSpec(slots=2, page_size=4, spec_decode=True, draft_k=3),
+        draft=params, budget=budget,
+    )
+    assert np.array_equal(
+        np.asarray(base["response_ids"]), np.asarray(spec["response_ids"])
+    )
+    assert np.array_equal(
+        np.asarray(base["response_mask"]), np.asarray(spec["response_mask"])
+    )
+    g = spec["gen_stats"]
+    assert int(g["accepted"]) == int(g["drafted"])  # p == q accepts all
+    # per-row budgets honored exactly
+    assert np.asarray(base["response_mask"]).sum(1).tolist() == budget.tolist()
+
+
+def test_spec_decode_greedy_exact_under_disagreeing_draft(tiny_lm, queue):
+    """Greedy rejection accepts iff the draft token IS the policy
+    argmax and emits the policy argmax otherwise, so the output equals
+    the policy's greedy stream for ANY draft — even one that never
+    agrees. (This is the guarantee that makes drafting with a stale /
+    quantized reference safe.)"""
+    lm, params = tiny_lm
+    ids, mask = queue
+    draft = jax.tree_util.tree_map(
+        lambda x: x
+        + 0.02 * jax.random.normal(jax.random.PRNGKey(9), x.shape, x.dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        params,
+    )
+    st = _settings(False)
+    base = _run(lm, params, ids, mask, st, EngineSpec(slots=2, page_size=4))
+    spec = _run(
+        lm, params, ids, mask, st,
+        EngineSpec(slots=2, page_size=4, spec_decode=True, draft_k=3),
+        draft=draft,
+    )
+    assert np.array_equal(
+        np.asarray(base["response_ids"]), np.asarray(spec["response_ids"])
+    )
+
+
+def test_engine_early_finish_frees_slot_and_refills(tiny_lm, queue):
+    """Early lane finishes (deterministic per-row budgets stand in for
+    EOS on this random-init model) free slots for the rest of the
+    queue; refill and truncation accounting match exactly."""
+    lm, params = tiny_lm
+    ids, mask = queue
+    st = dataclasses.replace(_settings(False), eos_token_id=-1)
+    budget = jnp.asarray([2, 1, 4, 1, 3], jnp.int32)
+    out = _run(
+        lm, params, ids, mask, st, EngineSpec(slots=2, page_size=4),
+        budget=budget,
+    )
+    lens = np.asarray(out["response_mask"]).sum(1)
+    assert lens.tolist() == budget.tolist()
+    g = out["gen_stats"]
+    assert int(g["refills"]) == ids.shape[0]
+    assert int(g["truncated"]) == ids.shape[0]  # no EOS: all budget-capped
+
+
+def test_paged_int8_attention_matches_reference():
+    """Op-level bound: paged_attention_step over an int8 pool matches a
+    dense float attention reference within quantization tolerance, and
+    exactly (fp32) with an unquantized pool."""
+    from trlx_tpu.ops.decode_attention import paged_attention_step
+
+    L, NP, PS, Hkv, D, B, T = 2, 7, 4, 2, 8, 3, 2
+    MP = 2
+    S = MP * PS
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(k1, (B, T, Hkv, D), jnp.float32)
+    k_new = jax.random.normal(k2, (B, T, Hkv, D), jnp.float32)
+    v_new = jax.random.normal(k3, (B, T, Hkv, D), jnp.float32)
+    # each lane owns 2 distinct pages; lane b starts its writes at slot 3
+    table = jnp.asarray([[1, 2], [3, 4], [5, 6]], jnp.int32)
+    slot_pos = jnp.asarray([3, 3, 3], jnp.int32)
+    # pre-existing context: 3 tokens per lane, written via the same op
+    ctx = jax.random.normal(k4, (B, 3, Hkv, D), jnp.float32)
+    key_mask = (jnp.arange(S)[None, :] < (3 + T)).astype(jnp.int32)
+    q_slots = slot_pos[:, None] + jnp.arange(T)[None, :]
+    causal = q_slots[:, :, None] >= jnp.arange(S)[None, None, :]
+    bias = jnp.where(
+        causal & (key_mask[:, None, :] > 0), 0.0, -1e9
+    )[:, None].astype(jnp.float32)
+
+    outs = {}
+    for quant in (None, "int8"):
+        pools = paged_kv.init_pool(L, NP, PS, Hkv, D, quant, jnp.float32)
+        # write the 3-token context at slots 0..2 through the write path
+        _, pools = paged_attention_step(
+            jnp.zeros((B, 3, Hkv, D), jnp.float32), ctx, ctx, pools,
+            jnp.int32(0), table, jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B, 1, 3, S), jnp.float32), 1.0,
+        )
+        out, _ = paged_attention_step(
+            q, k_new, v_new, pools, jnp.int32(0), table, slot_pos, bias,
+            sm_scale=1.0 / np.sqrt(D),
+        )
+        outs[quant] = np.asarray(out)
+
+    # dense reference over the logical sequences
+    k_all = jnp.concatenate([ctx, k_new], axis=1)
+    v_all = jnp.concatenate([ctx, v_new], axis=1)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k_all) / np.sqrt(D)
+    cmask = (3 + jnp.arange(T))[None, :, None] >= jnp.arange(3 + T)[None, None, :]
+    scores = jnp.where(cmask[:, None], scores, -1e9)
+    ref = np.asarray(
+        jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(scores, -1), v_all)
+    )
+    assert np.allclose(outs[None], ref, atol=1e-5)
+    assert np.abs(outs["int8"] - ref).max() <= 0.05 * (np.abs(ref).max() + 1e-6)
+
+
+def test_page_allocator_conserves_and_reuses():
+    free, ntop = paged_kv.init_alloc(9)  # pages 1..8 free
+    assert int(ntop) == 8
+    ids, free, ntop = paged_kv.pop_pages(
+        free, ntop, jnp.asarray([True, True, False, True])
+    )
+    got = np.asarray(ids)
+    assert int(ntop) == 5
+    assert got[2] == 0 and (got[[0, 1, 3]] > 0).all()
+    assert len(set(got[[0, 1, 3]].tolist())) == 3  # distinct pages
+    # return two of them (plus a null and a masked entry: both dropped)
+    free, ntop = paged_kv.push_free(
+        free, ntop,
+        jnp.asarray([got[0], 0, got[1], got[3]]),
+        jnp.asarray([True, True, True, False]),
+    )
+    assert int(ntop) == 7
+    # a fresh pop hands the returned pages back out (top of stack)
+    ids2, free, ntop = paged_kv.pop_pages(
+        free, ntop, jnp.asarray([True, True])
+    )
+    assert set(np.asarray(ids2).tolist()) == {int(got[1]), int(got[0])}
+    # exhaustion: wanting more than available serves in order, nulls rest
+    ids3, free, ntop = paged_kv.pop_pages(
+        free, ntop, jnp.ones((9,), bool)
+    )
+    got3 = np.asarray(ids3)
+    assert (got3[:5] > 0).all() and (got3[5:] == 0).all()
+    assert int(ntop) == 0
+
+
+def test_undersized_pool_truncates_but_terminates(tiny_lm, queue):
+    """A deliberately undersized page pool must degrade (lanes force-
+    finished, counted in oom_truncated) — never deadlock or corrupt
+    other lanes' output."""
+    lm, params = tiny_lm
+    ids, mask = queue
+    st = _settings(False)
+    # P=6, PS=4 -> 2 prompt pages/slot; 2 slots need 5 pages minimum;
+    # 6 pages leave almost no response headroom
+    out = _run(
+        lm, params, ids, mask, st,
+        EngineSpec(slots=2, page_size=4, pool_pages=6),
+    )
+    g = out["gen_stats"]
+    assert int(g["oom_truncated"]) > 0
+    # every served row still emitted at least its first token
+    served = np.asarray(out["response_mask"]).sum(1)
+    assert (served[: int(g["refills"])] >= 1).all()
+
+
+def test_instant_finish_releases_pages(tiny_lm):
+    """Lanes that finish AT refill time (instant EOS / budget 1 — the
+    EOS-degenerate regime) must release their pages immediately: with a
+    prompt-heavy shape the refill gate would otherwise see every page
+    parked on idle lanes and wedge the queue closed (review finding,
+    round 6). The whole queue must be served from a worst-case pool."""
+    lm, params = tiny_lm
+    Q, P = 5, 12
+    ids = jax.random.randint(jax.random.PRNGKey(4), (Q, P), 0, 64)
+    mask = jnp.ones((Q, P), jnp.int32)
+    # P=12/PS=4 -> 3 prompt pages; MP=4; 2 slots hold 2 spare pages —
+    # fewer than one refill needs, so recycling is load-bearing
+    out = _run(
+        lm, params, ids, mask, _settings(False, n=2),
+        EngineSpec(slots=2, page_size=4),
+        budget=jnp.ones((Q,), jnp.int32),
+    )
+    g = out["gen_stats"]
+    assert int(g["unserved"]) == 0
+    assert int(g["oom_truncated"]) == 0
+    assert np.asarray(out["response_mask"]).sum(1).tolist() == [1] * Q
+
+
+def test_gen_engine_config_validation():
+    cfg = GenEngineConfig.from_dict(
+        {"enabled": True, "slots": 4, "spec_decode": True, "draft_k": 2}
+    )
+    assert cfg.enabled and cfg.draft_k == 2
+    with pytest.raises(ValueError, match="unknown keys"):
+        GenEngineConfig.from_dict({"slotz": 4})
+    with pytest.raises(ValueError, match="draft_k"):
+        GenEngineConfig.from_dict({"draft_k": 0})
+    with pytest.raises(ValueError, match="kv_quant"):
+        GenEngineConfig.from_dict({"kv_quant": "fp4"})
+    # resolve follows the model's kv cache quant when unset
+    mcfg = TransformerConfig(
+        vocab_size=8, hidden_size=8, n_layer=1, n_head=1,
+        kv_cache_quant="int8",
+    )
+    assert GenEngineConfig.from_dict({}).resolve(8, mcfg).kv_quant == "int8"
+    assert (
+        GenEngineConfig.from_dict({"kv_quant": "none"}).resolve(8, mcfg).kv_quant
+        is None
+    )
+
+
+def _tiny_ppo_config(**method_over):
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    return default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=2, eval_interval=100,
+            checkpoint_interval=100, seq_length=24, epochs=2, tracker=None,
+            checkpoint_dir="/tmp/gen_engine_test_ckpts",
+            guardrails=dict(enabled=True, truncation_max=0.5, ladder=["log"]),
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=2,
+            model_extra_configs={
+                "transformer": dict(
+                    vocab_size=258, hidden_size=64, n_layer=4, n_head=2,
+                    n_positions=64,
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=16, chunk_size=16, ppo_epochs=1,
+            overlap_rollouts=True,
+            gen_kwargs=dict(
+                max_new_tokens=8, top_k=0, top_p=1.0, do_sample=True
+            ),
+            **method_over,
+        ),
+    )
+
+
+def test_ppo_rollouts_through_engine_with_spec_and_overlap():
+    """Integration: PPO rollout collection through the engine — hydra
+    reference composed as the speculative draft, overlap_rollouts'
+    prefetch riding the same generate() seam, per-refill watchdog beats,
+    and the truncation-rate guardrail tripping on an EOS-free policy
+    (random init barely ever samples EOS)."""
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = _tiny_ppo_config(
+        gen_engine=dict(
+            enabled=True, slots=4, page_size=8, spec_decode=True, draft_k=2
+        )
+    )
+
+    def reward_fn(samples, prompts, outputs, **kw):
+        return [float(len(o)) for o in outputs]
+
+    trainer = get_trainer(config.train.trainer)(
+        config=config, reward_fn=reward_fn
+    )
+    prompts = ["hello world", "the cat sat", "a b c", "xyz",
+               "what is", "I am", "go", "ok now"] * 2
+    trainer.add_prompt_pipeline(PromptPipeline(prompts, 12, trainer.tokenizer))
+    trainer.make_experience(16)
+    trainer._finish_rollout_stats()
+    assert len(trainer.store) == 16
+    batch = trainer.store.history
+    assert np.isfinite(np.asarray(batch.logprobs)).all()
+    assert np.asarray(batch.response_mask).sum() > 0
+    # the EOS-free random policy truncates every row -> guardrail trip
+    assert "truncation" in trainer.guardrails.trip_history

@@ -33,6 +33,7 @@ TIER1_BUDGETS = {
     "test_examples.py": 20,
     "test_fault_tolerance.py": 90,
     "test_flash_attention.py": 15,
+    "test_gen_engine.py": 60,
     "test_generation.py": 30,
     "test_golden.py": 10,
     "test_guardrails.py": 75,
@@ -60,8 +61,11 @@ TIER1_BUDGETS = {
 }
 
 # ceiling: tier-1 runs under `timeout 870` (ROADMAP); budgets must fit
-# with scheduling headroom
-TIER1_BUDGET_CEILING_S = 700
+# with scheduling headroom (raised 700 -> 780 for the decode-engine
+# suite in round 6 — measured 33s, budgeted 60; ~90s of headroom left
+# under the 870s timeout, so the NEXT file to land must trim budgets
+# or slow-mark instead of raising this again)
+TIER1_BUDGET_CEILING_S = 780
 
 # test files allowed to run full learn() loops in tier-1 WITHOUT a slow
 # marker, because that loop IS the subject under test and the configs

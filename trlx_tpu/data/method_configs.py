@@ -98,6 +98,21 @@ class PPOConfig(MethodConfig):
     # rewinds if it never trains). Requires the scanned epoch path
     # (train.fused_inner_loop); off by default.
     overlap_rollouts: bool = False
+    # Serving-grade rollout decode engine (models/gen_engine.py):
+    # continuous batching over a paged int8 KV cache with optional
+    # reference-drafted speculative decoding. Parsed by
+    # gen_engine.GenEngineConfig (enabled/slots/page_size/paged/
+    # pool_pages/refill_width/spec_decode/draft_k/kv_quant). Default {}
+    # = disabled: rollouts keep the static whole-batch sampler. When
+    # enabled, each generate() chunk runs through slot-based decode
+    # (finished rows are refilled from the remaining prompts of the
+    # chunk), and the engine's RNG is keyed per (prompt, position) —
+    # sampled continuations differ from the static sampler's stream but
+    # are invariant to slot assignment/batch composition (golden-checked
+    # in tests/test_gen_engine.py). Composes with overlap_rollouts and
+    # the preemption/rewind cursors unchanged: the engine sits behind
+    # the same per-chunk generate() seam both already drive.
+    gen_engine: dict = field(default_factory=dict)
 
     def get_advantages_and_returns(self, values, rewards, response_length, use_whitening=True):
         from trlx_tpu.ops.ppo import gae_advantages_and_returns

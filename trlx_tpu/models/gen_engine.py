@@ -1,0 +1,761 @@
+"""Serving-grade rollout decode engine: continuous batching over a
+paged (optionally int8) KV cache, with reference-drafted speculative
+decoding.
+
+The static sampler (models/generation.py) steps the WHOLE batch until
+every row finishes: one long response stalls the batch, and by the tail
+of the loop a single live row pays a full-width decode step. This
+engine replaces that loop for rollout collection with a slot-based
+design:
+
+  * **Continuous batching** — a fixed set of `slots` decode lanes is
+    fed from a device-resident prompt queue. The whole queue is
+    processed by ONE jitted `lax.while_loop`: whenever a lane finishes
+    (EOS / its token budget), the next iteration's refill phase
+    (`lax.cond`, so it costs nothing on iterations with no refill)
+    prefills the next queued prompt INTO that slot and decoding
+    continues at full occupancy. `queue size >> slots` is the intended
+    shape: the step batch stays dense for the whole rollout phase
+    instead of decaying to one live row.
+  * **Paged int8 KV** (ops/paged_kv.py) — slots index fixed-size pages
+    through a page table; a refilled slot's pages return to a free
+    stack and are reused, and response pages are allocated lazily, so
+    short responses never pay max-length KV. `paged=False` keeps the
+    indirection out (a contiguous per-slot layout the gather collapses
+    through) so the two pillars are separable in benchmarks.
+  * **Speculative decoding** — a draft model (the frozen PPO reference:
+    the policy is one KL-constrained step away from it, so acceptance
+    is high) drafts `draft_k` tokens autoregressively; the policy
+    verifies all of them in ONE `T=draft_k` forward (one weight read
+    amortized over k tokens) with standard rejection sampling, which
+    leaves the sampled distribution exactly the policy's. Greedy mode
+    accepts iff the draft token equals the policy argmax, so greedy
+    output is token-for-token the non-speculative stream.
+
+RNG contract: every sampling event is keyed on (queue row, response
+index, event kind) folded into the call's base key — NOT on the slot or
+the step. A prompt therefore samples the same continuation regardless
+of batch composition, slot assignment, refill order, or whether
+speculative decoding is enabled (when draft == policy, acceptance is
+certain and the streams are bit-identical). tests/test_gen_engine.py
+pins all of these.
+
+Scope (v1): causal LMs, single data group (the rollout-worker geometry
+of the disaggregated actor–learner plan — ROADMAP item 1); no soft
+prompts / prefix tuning; multihost and seq2seq fall back to the static
+sampler in trainer/base.generate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.generation import (
+    SamplerSettings,
+    cast_params_for_decode,
+    categorical_lanes,
+    lane_keys,
+    process_logits,
+    sample_token_lanes,
+)
+from trlx_tpu.models.transformer import TransformerLM, logit_projection
+from trlx_tpu.ops import paged_kv
+
+Array = jnp.ndarray
+
+
+@dataclass(frozen=True)
+class GenEngineConfig:
+    """`ppo.gen_engine.*` — user-facing engine configuration (plain dict
+    in YAML; parsed here so unknown keys fail at config load).
+
+    enabled       route PPO rollout generation through the engine
+                  (default off: byte-identical rollouts to the static
+                  sampler's RNG stream are NOT preserved across the
+                  switch — the engine keys RNG per (prompt, position)).
+    slots         decode lanes per step; 0 = the generate() call's
+                  batch width (chunk size), i.e. refills only help a
+                  ragged tail. Real wins come from slots < chunk.
+    page_size     tokens per KV page.
+    paged         False = contiguous per-slot layout (no indirection,
+                  no lazy allocation — the continuous-batching-only
+                  configuration benchmarks attribute against).
+    pool_pages    total pages in the pool; 0 = worst case
+                  (slots * pages_per_slot + null page), which can only
+                  be undersized deliberately.
+    refill_width  prompts prefilled per refill event; 0 = slots.
+    spec_decode   draft with the frozen reference, verify with the
+                  policy (exact via rejection sampling).
+    draft_k       drafted tokens per speculative round.
+    kv_quant      "int8" | "none"; None follows the model's
+                  kv_cache_quant (the production rollout default).
+    """
+
+    enabled: bool = False
+    slots: int = 0
+    page_size: int = 128
+    paged: bool = True
+    pool_pages: int = 0
+    refill_width: int = 0
+    spec_decode: bool = False
+    draft_k: int = 4
+    kv_quant: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "GenEngineConfig":
+        d = dict(d or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"ppo.gen_engine: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        cfg = cls(**d)
+        if cfg.page_size < 1:
+            raise ValueError("ppo.gen_engine.page_size must be >= 1")
+        if cfg.draft_k < 1:
+            raise ValueError("ppo.gen_engine.draft_k must be >= 1")
+        if cfg.kv_quant not in (None, "none", "int8"):
+            raise ValueError(
+                f"ppo.gen_engine.kv_quant must be none/int8, got {cfg.kv_quant!r}"
+            )
+        return cfg
+
+    def resolve(self, batch: int, model_cfg) -> "EngineSpec":
+        """Concretize against a call's batch width and the model."""
+        quant = self.kv_quant
+        if quant is None:
+            quant = "int8" if model_cfg.kv_cache_quant in (
+                "int8", "int8_kernel"
+            ) else "none"
+        slots = self.slots or batch
+        if batch:
+            slots = min(slots, batch)
+        return EngineSpec(
+            slots=slots,
+            page_size=self.page_size,
+            paged=self.paged,
+            pool_pages=self.pool_pages,
+            refill_width=self.refill_width or slots,
+            spec_decode=self.spec_decode,
+            draft_k=self.draft_k,
+            kv_quant=None if quant == "none" else quant,
+        )
+
+
+@dataclass(frozen=True)
+class EngineSpec:
+    """Static engine geometry (hashable: keys the jit cache)."""
+
+    slots: int
+    page_size: int = 128
+    paged: bool = True
+    pool_pages: int = 0
+    refill_width: int = 0
+    spec_decode: bool = False
+    draft_k: int = 4
+    kv_quant: Optional[str] = None
+
+
+def _round_up(x: int, to: int) -> int:
+    return x + (-x) % to
+
+
+def compose_draft_params(cfg, policy_params: Dict, ref_params: Dict) -> Dict:
+    """The speculative draft model = the frozen PPO reference.
+
+    With a full-copy reference (num_layers_unfrozen=-1) the reference IS
+    a standalone model — return it. With a hydra branch the reference is
+    only the top-k layers; the draft composes the policy's trunk (the
+    bottom layers are shared and frozen-equivalent at the branch point)
+    with the frozen branch into a full stack. The concat materializes a
+    trunk copy inside the trace — acceptable per generate call at small
+    scale; at multi-GB scale prefer a full-copy reference when drafting.
+    """
+    k = jax.tree_util.tree_leaves(ref_params["blocks"])[0].shape[0]
+    if k == cfg.n_layer:
+        return ref_params
+    trunk = jax.tree_util.tree_map(
+        lambda x: x[: cfg.n_layer - k], policy_params["blocks"]
+    )
+    blocks = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b.astype(a.dtype)], axis=0),
+        trunk, ref_params["blocks"],
+    )
+    return dict(ref_params, blocks=blocks)
+
+
+def engine_generate(
+    model: TransformerLM,
+    params: Dict,
+    q_ids: Array,  # [Q, P] int32, LEFT-padded prompt queue
+    q_mask: Array,  # [Q, P] int32
+    rng: jax.Array,
+    settings: SamplerSettings,
+    spec: EngineSpec,
+    draft_params: Optional[Dict] = None,
+    row_budget: Optional[Array] = None,  # [Q] per-row max_new (<= N)
+) -> Dict[str, Array]:
+    """Generate a continuation for every queue row through the engine.
+
+    Returns the static sampler's output contract (sequences [Q, P+N],
+    response_ids [Q, N], response_mask [Q, N]) plus `gen_stats`, a dict
+    of device scalars: decode_steps, refills, real_tokens,
+    occupancy (real tokens / (decode_steps * slots)), truncated (rows
+    that hit their budget without EOS), oom_truncated (lanes killed by
+    page-pool exhaustion — 0 unless pool_pages was undersized), and in
+    speculative mode drafted / accepted / spec_rounds.
+    """
+    Q, P = q_ids.shape
+    N = settings.max_new_tokens
+    if N < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    cfg = model.cfg
+    SLOTS = max(1, min(spec.slots, Q))
+    PS = spec.page_size
+    K = spec.draft_k if spec.spec_decode else 0
+    # speculative rounds may draft past a lane's budget before the
+    # verifier truncates; give every slot K slack positions so those
+    # writes land in real (masked, later-cleared) slots
+    MP = paged_kv.pages_per_slot(P, N + K, PS)
+    S = MP * PS
+    PP = -(-P // PS)  # prompt pages per refill (pads included)
+    # contiguous layout needs its full static page range; only the
+    # paged layout can run on a deliberately undersized pool
+    NP = (spec.pool_pages or (1 + SLOTS * MP)) if spec.paged else (
+        1 + SLOTS * MP
+    )
+    if NP < 1 + SLOTS * PP:
+        raise ValueError(
+            f"pool_pages={NP} cannot hold {SLOTS} slots' prompts "
+            f"({PP} pages each + null page)"
+        )
+    R = max(1, min(spec.refill_width or SLOTS, SLOTS))
+    quant = spec.kv_quant
+    eos = jnp.int32(settings.eos_token_id)
+    pad = jnp.int32(settings.pad_token_id)
+    if spec.spec_decode and draft_params is None:
+        raise ValueError("spec_decode needs draft_params (the reference)")
+
+    params = cast_params_for_decode(params, cfg.dtype)
+    from trlx_tpu.parallel.sharding import unshard_for_decode
+
+    params = unshard_for_decode(params, getattr(model, "mesh", None))
+    if getattr(cfg, "decode_weights_quant", None) == "int8":
+        from trlx_tpu.models.transformer import quantize_decode_weights
+
+        params = quantize_decode_weights(params)
+    if draft_params is not None:
+        draft_params = cast_params_for_decode(draft_params, cfg.dtype)
+        draft_params = unshard_for_decode(
+            draft_params, getattr(model, "mesh", None)
+        )
+        if getattr(cfg, "decode_weights_quant", None) == "int8":
+            from trlx_tpu.models.transformer import quantize_decode_weights
+
+            draft_params = quantize_decode_weights(draft_params)
+
+    q_ids = q_ids.astype(jnp.int32)
+    q_mask = q_mask.astype(jnp.int32)
+    if row_budget is None:
+        row_budget = jnp.full((Q,), N, jnp.int32)
+    row_budget = jnp.clip(row_budget.astype(jnp.int32), 1, N)
+
+    # RNG id spaces: token draws at r*N + j; acceptance and residual
+    # draws in disjoint ranges above them
+    OFF_ACC = (Q + 1) * N
+    OFF_RES = 2 * (Q + 1) * N
+
+    # pallas prefill wants a 128-aligned temp cache + 8-row-aligned
+    # queries, mirroring generate()'s gate; otherwise it falls back to
+    # XLA inside the same code path
+    Pc = _round_up(P, 128) if (cfg.attention_impl == "pallas" and P % 8 == 0) else P
+
+    def _contig_table() -> Array:
+        base = 1 + jnp.arange(SLOTS * MP, dtype=jnp.int32).reshape(SLOTS, MP)
+        return base
+
+    def _init_state() -> Dict[str, Any]:
+        pool = paged_kv.init_pool(
+            cfg.n_layer, NP, PS, cfg.n_kv_head, cfg.head_dim, quant, cfg.dtype
+        )
+        state: Dict[str, Any] = {"pool": pool}
+        if spec.spec_decode:
+            state["dpool"] = paged_kv.init_pool(
+                cfg.n_layer, NP, PS, cfg.n_kv_head, cfg.head_dim, quant,
+                cfg.dtype,
+            )
+        if spec.paged:
+            free, ntop = paged_kv.init_alloc(NP)
+            state["free"], state["ntop"] = free, ntop
+            state["table"] = jnp.zeros((SLOTS, MP), jnp.int32)
+        else:
+            state["table"] = _contig_table()
+        state.update(
+            pos=jnp.zeros((SLOTS,), jnp.int32),
+            npad=jnp.zeros((SLOTS,), jnp.int32),
+            new=jnp.zeros((SLOTS,), jnp.int32),
+            budget=jnp.ones((SLOTS,), jnp.int32),
+            active=jnp.zeros((SLOTS,), bool),
+            pidx=jnp.zeros((SLOTS,), jnp.int32),
+            cur=jnp.zeros((SLOTS,), jnp.int32),
+            kmask=jnp.zeros((SLOTS, S), jnp.int32),
+            qnext=jnp.int32(0),
+            resp_ids=jnp.full((Q, N), pad, jnp.int32),
+            resp_mask=jnp.zeros((Q, N), jnp.int32),
+            decode_steps=jnp.int32(0),
+            lane_steps=jnp.int32(0),
+            refills=jnp.int32(0),
+            emitted=jnp.int32(0),
+            truncated=jnp.int32(0),
+            oom=jnp.int32(0),
+            rounds=jnp.int32(0),
+            drafted=jnp.int32(0),
+            accepted=jnp.int32(0),
+        )
+        return state
+
+    def _paged_cache(pool, state, slot_pos, key_mask):
+        cache = dict(
+            pool,
+            page_table=state["table"],
+            slot_pos=slot_pos,
+            key_mask=key_mask,
+            lane_valid=state["active"],
+        )
+        if not spec.paged:
+            cache["contiguous"] = True
+        return cache
+
+    def _prefill_into_slots(prms, pool, state, ids, mask, posns, slot, do):
+        """Dense prefill of [R, P] prompts, scattered into `slot`'s
+        pages. Returns (pool, last_hidden [R, E])."""
+        key_mask = jnp.concatenate(
+            [mask, jnp.zeros((R, Pc - P), jnp.int32)], axis=1
+        ) if Pc != P else mask
+        tmp = model.init_cache(R, Pc, key_mask)
+        out = model(
+            prms, ids, mask, positions=posns, cache=tmp, compute_logits=False
+        )
+        ck = out["cache"]["k"][:, :, :P]  # [L, R, P, Hkv, D]
+        cv = out["cache"]["v"][:, :, :P]
+        tbl = state["table"][jnp.clip(slot, 0, SLOTS - 1)]
+        prompt_pos = jnp.broadcast_to(
+            jnp.arange(P, dtype=jnp.int32)[None, :], (R, P)
+        )
+        pids, offs = paged_kv.write_positions(tbl, prompt_pos, PS, lane_valid=do)
+        if quant == "int8":
+            kq, ks = paged_kv.quantize_rows(ck)
+            vq, vs = paged_kv.quantize_rows(cv)
+            pool = dict(
+                pool,
+                pk=paged_kv.scatter_prefill(pool["pk"], pids, offs, kq),
+                pv=paged_kv.scatter_prefill(pool["pv"], pids, offs, vq),
+                pk_scale=paged_kv.scatter_prefill(
+                    pool["pk_scale"], pids, offs, ks
+                ),
+                pv_scale=paged_kv.scatter_prefill(
+                    pool["pv_scale"], pids, offs, vs
+                ),
+            )
+        else:
+            pool = dict(
+                pool,
+                pk=paged_kv.scatter_prefill(pool["pk"], pids, offs, ck),
+                pv=paged_kv.scatter_prefill(pool["pv"], pids, offs, cv),
+            )
+        return pool, out["hidden_states"][:, -1]
+
+    def _refill(state: Dict[str, Any]) -> Dict[str, Any]:
+        active, qnext = state["active"], state["qnext"]
+        order = jnp.argsort(active.astype(jnp.int32), stable=True)
+        cand = order[:R]
+        navail = jnp.minimum(
+            jnp.minimum((~active).sum().astype(jnp.int32), Q - qnext),
+            jnp.int32(R),
+        )
+        if spec.paged:
+            navail = jnp.minimum(navail, state["ntop"] // PP)
+        do = jnp.arange(R, dtype=jnp.int32) < navail
+        slot = jnp.where(do, cand, SLOTS)  # OOB -> scatter drops
+        qrow = jnp.where(do, qnext + jnp.arange(R, dtype=jnp.int32), Q)
+        qc = jnp.clip(qrow, 0, Q - 1)
+        ids = q_ids[qc]
+        mask = q_mask[qc]
+
+        if spec.paged:
+            # return the refilled slots' old pages, then allocate fresh
+            # prompt pages (often the very pages just freed)
+            old = state["table"][jnp.clip(slot, 0, SLOTS - 1)]
+            free, ntop = paged_kv.push_free(
+                state["free"], state["ntop"], old.reshape(-1),
+                jnp.repeat(do, MP),
+            )
+            table = state["table"].at[slot].set(0, mode="drop")
+            got, free, ntop = paged_kv.pop_pages(
+                free, ntop, jnp.repeat(do, PP)
+            )
+            table = table.at[
+                slot[:, None], jnp.arange(PP, dtype=jnp.int32)[None, :]
+            ].set(got.reshape(R, PP), mode="drop")
+            state = dict(state, free=free, ntop=ntop, table=table)
+
+        posns = jnp.maximum(jnp.cumsum(mask, axis=1) - 1, 0)
+        pool, h_last = _prefill_into_slots(
+            params, state["pool"], state, ids, mask, posns, slot, do
+        )
+        state = dict(state, pool=pool)
+        if spec.spec_decode:
+            dpool, _ = _prefill_into_slots(
+                draft_params, state["dpool"], state, ids, mask, posns, slot, do
+            )
+            state = dict(state, dpool=dpool)
+
+        logits0 = logit_projection(params)(h_last)
+        keys0 = lane_keys(rng, qc * N)
+        tok0 = sample_token_lanes(keys0, logits0, settings)
+        bud = row_budget[qc]
+        eos0 = tok0 == eos
+        fin0 = eos0 | (bud <= 1)
+
+        def upd(name, val):
+            return state[name].at[slot].set(val, mode="drop")
+
+        npad = P - mask.sum(axis=1).astype(jnp.int32)
+        state = dict(
+            state,
+            pos=upd("pos", jnp.full((R,), P, jnp.int32)),
+            npad=upd("npad", npad),
+            new=upd("new", jnp.ones((R,), jnp.int32)),
+            budget=upd("budget", bud),
+            active=upd("active", ~fin0),
+            pidx=upd("pidx", qc),
+            cur=upd("cur", tok0),
+            kmask=state["kmask"].at[slot].set(
+                jnp.concatenate(
+                    [mask, jnp.zeros((R, S - P), jnp.int32)], axis=1
+                ),
+                mode="drop",
+            ),
+            resp_ids=state["resp_ids"].at[qrow, 0].set(tok0, mode="drop"),
+            resp_mask=state["resp_mask"].at[qrow, 0].set(1, mode="drop"),
+            qnext=qnext + navail,
+            refills=state["refills"] + navail,
+            emitted=state["emitted"] + navail,
+            truncated=state["truncated"]
+            + (do & fin0 & ~eos0).sum().astype(jnp.int32),
+        )
+        # lanes that finish AT refill (instant EOS / budget 1) must
+        # release their freshly-allocated pages immediately, or a fully
+        # EOS-degenerate policy parks every page on idle lanes and the
+        # refill gate (ntop >= PP) wedges the queue closed
+        fin_lanes = (
+            jnp.zeros((SLOTS,), bool).at[slot].set(do & fin0, mode="drop")
+        )
+        return _release_pages(state, fin_lanes)
+
+    def _release_pages(state: Dict[str, Any], lanes: Array) -> Dict[str, Any]:
+        """Return `lanes`' pages to the free stack the moment the lane
+        finishes: a finished response's KV is dead, and reclaiming it
+        immediately is what lets the refill gate (`ntop >= PP`) admit
+        the next prompt without a separate scavenging pass."""
+        if not spec.paged:
+            return state
+        rows = state["table"]
+        free, ntop = paged_kv.push_free(
+            state["free"], state["ntop"], rows.reshape(-1),
+            jnp.repeat(lanes, MP),
+        )
+        table = jnp.where(lanes[:, None], 0, rows)
+        return dict(state, free=free, ntop=ntop, table=table)
+
+    def _ensure_page(state: Dict[str, Any], position: Array) -> Dict[str, Any]:
+        """Lazy response-page allocation for each active lane's write at
+        `position` [SLOTS]; lanes the pool cannot serve are force-
+        finished (counted as oom_truncated)."""
+        if not spec.paged:
+            return state
+        active = state["active"]
+        pi = jnp.clip(position // PS, 0, MP - 1)
+        have = jnp.take_along_axis(state["table"], pi[:, None], axis=1)[:, 0]
+        miss = active & (have == 0)
+        got, free, ntop = paged_kv.pop_pages(state["free"], state["ntop"], miss)
+        table = state["table"].at[
+            jnp.arange(SLOTS), pi
+        ].set(jnp.where(miss & (got > 0), got, have))
+        starve = miss & (got == 0)
+        state = dict(
+            state,
+            free=free,
+            ntop=ntop,
+            table=table,
+            active=active & ~starve,
+            oom=state["oom"] + starve.sum().astype(jnp.int32),
+            truncated=state["truncated"] + starve.sum().astype(jnp.int32),
+        )
+        return _release_pages(state, starve)
+
+    def _decode_step(state: Dict[str, Any]) -> Dict[str, Any]:
+        state = _ensure_page(state, state["pos"])
+        active = state["active"]
+        p = jnp.clip(state["pos"], 0, S - 1)
+        km = state["kmask"].at[jnp.arange(SLOTS), p].max(active.astype(jnp.int32))
+        cache = _paged_cache(state["pool"], dict(state, active=active), p, km)
+        out = model(
+            params,
+            state["cur"][:, None],
+            positions=jnp.maximum(p - state["npad"], 0)[:, None],
+            cache=cache,
+        )
+        pool = {
+            k: out["cache"][k]
+            for k in ("pk", "pv", "pk_scale", "pv_scale")
+            if k in out["cache"]
+        }
+        j = jnp.clip(state["new"], 0, N - 1)
+        keys = lane_keys(rng, state["pidx"] * N + j)
+        tok = sample_token_lanes(keys, out["logits"][:, -1], settings)
+        eos_hit = tok == eos
+        budget_hit = state["new"] + 1 >= state["budget"]
+        fin = eos_hit | budget_hit
+        wrow = jnp.where(active, state["pidx"], Q)
+        na = active.sum().astype(jnp.int32)
+        state = dict(
+            state,
+            pool=pool,
+            kmask=km,
+            resp_ids=state["resp_ids"].at[wrow, j].set(tok, mode="drop"),
+            resp_mask=state["resp_mask"].at[wrow, j].set(1, mode="drop"),
+            pos=state["pos"] + active,
+            new=state["new"] + active,
+            cur=jnp.where(active, tok, state["cur"]),
+            active=active & ~fin,
+            decode_steps=state["decode_steps"] + 1,
+            lane_steps=state["lane_steps"] + na,
+            emitted=state["emitted"] + na,
+            truncated=state["truncated"]
+            + (active & budget_hit & ~eos_hit).sum().astype(jnp.int32),
+        )
+        return _release_pages(state, active & fin)
+
+    def _spec_round(state: Dict[str, Any]) -> Dict[str, Any]:
+        # pages for the whole draft window [pos, pos+K)
+        for j in range(K):
+            state = _ensure_page(state, state["pos"] + j)
+        active = state["active"]
+        p = jnp.clip(state["pos"], 0, S - K)
+        window = p[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
+        km = state["kmask"].at[
+            jnp.arange(SLOTS)[:, None], window
+        ].max(jnp.broadcast_to(active.astype(jnp.int32)[:, None], (SLOTS, K)))
+        base_pos = jnp.maximum(p - state["npad"], 0)
+
+        # -- draft: K single-token steps off the reference ---------------
+        def dbody(carry, j):
+            dpool, tok_in = carry
+            cache = _paged_cache(dpool, dict(state, active=active), p + j, km)
+            out = model(
+                draft_params, tok_in[:, None],
+                positions=(base_pos + j)[:, None], cache=cache,
+            )
+            dpool = {
+                k: out["cache"][k]
+                for k in ("pk", "pv", "pk_scale", "pv_scale")
+                if k in out["cache"]
+            }
+            ql = process_logits(out["logits"][:, -1], settings)
+            keys = lane_keys(rng, state["pidx"] * N + state["new"] + j)
+            if settings.do_sample:
+                g = jax.vmap(lambda k2: jax.random.gumbel(k2, (ql.shape[-1],)))(
+                    keys
+                )
+                x = jnp.argmax(ql + g, axis=-1).astype(jnp.int32)
+            else:
+                x = jnp.argmax(ql, axis=-1).astype(jnp.int32)
+            return (dpool, x), (x, jax.nn.softmax(ql, axis=-1))
+
+        (dpool, _), (xs, qprobs) = jax.lax.scan(
+            dbody, (state["dpool"], state["cur"]),
+            jnp.arange(K, dtype=jnp.int32),
+        )
+        xs = xs.transpose(1, 0)  # [SLOTS, K]
+
+        # -- verify: ONE policy forward over the k drafted inputs --------
+        ver_in = jnp.concatenate([state["cur"][:, None], xs[:, : K - 1]], axis=1)
+        cache = _paged_cache(state["pool"], dict(state, active=active), p, km)
+        out = model(
+            params, ver_in,
+            positions=base_pos[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :],
+            cache=cache,
+        )
+        pool = {
+            k: out["cache"][k]
+            for k in ("pk", "pv", "pk_scale", "pv_scale")
+            if k in out["cache"]
+        }
+        pl_ = process_logits(out["logits"], settings)  # [SLOTS, K, V]
+        pprobs = jax.nn.softmax(pl_, axis=-1)
+
+        # -- rejection sampling (exact: accepted + residual draws leave
+        # the marginal of every emitted token the POLICY's) -------------
+        still = active
+        fin = jnp.zeros((SLOTS,), bool)
+        m = jnp.zeros((SLOTS,), jnp.int32)
+        last = state["cur"]
+        resp_ids, resp_mask = state["resp_ids"], state["resp_mask"]
+        truncated = state["truncated"]
+        drafted = state["drafted"]
+        accepted = state["accepted"]
+        emitted = state["emitted"]
+        for j in range(K):
+            xj = xs[:, j]
+            pj = pprobs[:, j]
+            qj = qprobs[j]
+            if settings.do_sample:
+                ukeys = lane_keys(
+                    rng, OFF_ACC + state["pidx"] * N + state["new"] + j
+                )
+                u = jax.vmap(lambda k2: jax.random.uniform(k2, ()))(ukeys)
+                px = jnp.take_along_axis(pj, xj[:, None], axis=1)[:, 0]
+                qx = jnp.take_along_axis(qj, xj[:, None], axis=1)[:, 0]
+                acc = u * qx <= px
+                res = jnp.maximum(pj - qj, 0.0)
+                rs = res.sum(axis=-1, keepdims=True)
+                res = jnp.where(rs > 1e-12, res / jnp.maximum(rs, 1e-30), pj)
+                rkeys = lane_keys(
+                    rng, OFF_RES + state["pidx"] * N + state["new"] + j
+                )
+                tok_rej = categorical_lanes(rkeys, res)
+            else:
+                am = jnp.argmax(pj, axis=-1).astype(jnp.int32)
+                acc = xj == am
+                tok_rej = am
+            tok = jnp.where(acc, xj, tok_rej)
+            emit = still
+            wrow = jnp.where(emit, state["pidx"], Q)
+            wcol = jnp.clip(state["new"] + j, 0, N - 1)
+            resp_ids = resp_ids.at[wrow, wcol].set(tok, mode="drop")
+            resp_mask = resp_mask.at[wrow, wcol].set(1, mode="drop")
+            eos_hit = tok == eos
+            budget_hit = state["new"] + j + 1 >= state["budget"]
+            fin_now = emit & (eos_hit | budget_hit)
+            m = m + emit
+            last = jnp.where(emit, tok, last)
+            truncated = truncated + (fin_now & ~eos_hit).sum().astype(jnp.int32)
+            drafted = drafted + emit.sum().astype(jnp.int32)
+            accepted = accepted + (emit & acc).sum().astype(jnp.int32)
+            emitted = emitted + emit.sum().astype(jnp.int32)
+            still = still & acc & ~fin_now
+            fin = fin | fin_now
+
+        # kmask in the draft window becomes "consumed inputs only": the
+        # first m positions stay attendable (their KV is final), stale
+        # slots from rejected/over-budget drafts are cleared. Window
+        # positions all sit at >= pos, so inactive lanes' real bits are
+        # untouched (their window bits were never set).
+        keep = (
+            jnp.arange(K, dtype=jnp.int32)[None, :] < m[:, None]
+        ) & active[:, None]
+        km = km.at[jnp.arange(SLOTS)[:, None], window].set(
+            keep.astype(jnp.int32)
+        )
+        state = dict(
+            state,
+            pool=pool,
+            dpool=dpool,
+            kmask=km,
+            resp_ids=resp_ids,
+            resp_mask=resp_mask,
+            pos=state["pos"] + m,
+            new=state["new"] + m,
+            cur=last,
+            active=active & ~fin,
+            decode_steps=state["decode_steps"] + K + 1,
+            lane_steps=state["lane_steps"] + (K + 1) * active.sum().astype(jnp.int32),
+            rounds=state["rounds"] + 1,
+            emitted=emitted,
+            truncated=truncated,
+            drafted=drafted,
+            accepted=accepted,
+        )
+        return _release_pages(state, active & fin)
+
+    step_fn = _spec_round if spec.spec_decode else _decode_step
+
+    def cond(state):
+        can_refill = (~state["active"]).any() & (state["qnext"] < Q)
+        if spec.paged:
+            can_refill = can_refill & (state["ntop"] >= PP)
+        return state["active"].any() | can_refill
+
+    def body(state):
+        need = (~state["active"]).any() & (state["qnext"] < Q)
+        if spec.paged:
+            need = need & (state["ntop"] >= PP)
+        state = jax.lax.cond(need, _refill, lambda s: s, state)
+        state = jax.lax.cond(
+            state["active"].any(), step_fn, lambda s: s, state
+        )
+        return state
+
+    final = jax.lax.while_loop(cond, body, _init_state())
+
+    resp_ids = jnp.where(final["resp_mask"] > 0, final["resp_ids"], pad)
+    steps_f = jnp.maximum(final["decode_steps"].astype(jnp.float32), 1.0)
+    stats = {
+        "decode_steps": final["decode_steps"],
+        "refills": final["refills"],
+        "real_tokens": final["emitted"],
+        "occupancy": final["lane_steps"].astype(jnp.float32)
+        / (steps_f * SLOTS),
+        "truncated": final["truncated"],
+        "oom_truncated": final["oom"],
+        "unserved": Q - final["qnext"],
+    }
+    if spec.spec_decode:
+        stats.update(
+            spec_rounds=final["rounds"],
+            drafted=final["drafted"],
+            accepted=final["accepted"],
+        )
+    return {
+        "sequences": jnp.concatenate([q_ids, resp_ids], axis=1),
+        "response_ids": resp_ids,
+        "response_mask": final["resp_mask"],
+        "gen_stats": stats,
+    }
+
+
+def make_engine_fn(
+    model: TransformerLM,
+    settings: SamplerSettings,
+    spec: EngineSpec,
+):
+    """Jitted engine entry: `(params[, draft_params], q_ids, q_mask,
+    rng[, row_budget]) -> outputs`. One executable per (Q, P) shape."""
+    if spec.spec_decode:
+
+        @partial(jax.jit, static_argnums=())
+        def fn(params, draft_params, q_ids, q_mask, rng, row_budget=None):
+            return engine_generate(
+                model, params, q_ids, q_mask, rng, settings, spec,
+                draft_params=draft_params, row_budget=row_budget,
+            )
+
+        return fn
+
+    @partial(jax.jit, static_argnums=())
+    def fn(params, q_ids, q_mask, rng, row_budget=None):
+        return engine_generate(
+            model, params, q_ids, q_mask, rng, settings, spec,
+            row_budget=row_budget,
+        )
+
+    return fn

@@ -135,6 +135,41 @@ def sample_token(rng: jax.Array, logits: Array, settings: SamplerSettings) -> Ar
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def lane_keys(base: jax.Array, lane_ids: Array) -> jax.Array:
+    """Per-lane PRNG keys: fold a vector of ids into one base key.
+
+    The decode engine (models/gen_engine.py) keys every sampling event
+    on (prompt index, token position, event kind) folded into the call's
+    base key, so a prompt's sampled continuation is INDEPENDENT of which
+    slot served it, how the batch was composed, and whether speculative
+    decoding was on — the property the golden-equivalence tests pin."""
+    return jax.vmap(lambda i: jax.random.fold_in(base, i))(
+        lane_ids.astype(jnp.uint32)
+    )
+
+
+def sample_token_lanes(
+    keys: jax.Array,  # [B] per-lane keys (lane_keys)
+    logits: Array,  # [B, V]
+    settings: SamplerSettings,
+) -> Array:
+    """Per-lane sampling: like `sample_token` but each row draws from
+    its own key (gumbel-max == categorical, one lane at a time)."""
+    logits = process_logits(logits, settings)
+    if not settings.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    g = jax.vmap(lambda k: jax.random.gumbel(k, (logits.shape[-1],)))(keys)
+    return jnp.argmax(logits + g, axis=-1).astype(jnp.int32)
+
+
+def categorical_lanes(keys: jax.Array, probs: Array) -> Array:
+    """Per-lane categorical draw from probability rows [B, V] (used by
+    the speculative residual re-draw; probs need not be normalized)."""
+    logp = jnp.log(jnp.maximum(probs, 1e-30))
+    g = jax.vmap(lambda k: jax.random.gumbel(k, (probs.shape[-1],)))(keys)
+    return jnp.argmax(logp + g, axis=-1).astype(jnp.int32)
+
+
 
 def cast_params_for_decode(params: Dict, compute_dtype) -> Dict:
     """Hoist the per-matmul param casts out of a decode loop: every step
@@ -191,6 +226,7 @@ def generate(
     logits_processor: Optional[Callable[[Array, Array], Array]] = None,
     soft_prompt: Optional[Array] = None,  # [n, E] prompt-tuning tokens
     kv_prefix: Optional[Dict[str, Array]] = None,  # prefix-tuning k/v
+    row_budget: Optional[Array] = None,  # [B] per-row max_new cap (<= N)
 ) -> Dict[str, Array]:
     """Sample up to `settings.max_new_tokens` continuations.
 
@@ -316,6 +352,12 @@ def generate(
     h_last = out["hidden_states"][:, -1]
     logits_last = logit_projection(params)(h_last)
     tok0, finished0 = pick_next(sub, h_last, logits_last, finished0)
+    if row_budget is not None:
+        # per-row response budgets (serving-style per-request
+        # max_tokens; also how the bench builds honestly-ragged decode
+        # workloads): a row that hits its budget finishes like an EOS
+        budget = jnp.asarray(row_budget, jnp.int32)
+        finished0 = finished0 | (budget <= 1)
 
     decode_cache = out["cache"]
     if model.cfg.kv_cache_quant in ("int8", "int8_kernel"):
@@ -354,6 +396,8 @@ def generate(
                 sub, step_out["hidden_states"][:, -1], step_out["logits"][:, -1],
                 finished,
             )
+            if row_budget is not None:
+                now_finished = now_finished | (budget <= t + 1)
             real = ~finished  # next_tok is real iff not finished before it
             ids_buf = jax.lax.dynamic_update_slice_in_dim(
                 ids_buf, next_tok[:, None], t, axis=1

@@ -249,7 +249,33 @@ class Attention(nn.Module):
 
         new_kv = None
         kernel_out = None  # set by the fused int8 decode kernel path
-        if cache is not None:
+        if cache is not None and "pk" in cache:
+            # paged cache (slot→page indirection, models/gen_engine.py):
+            # write the T incoming tokens into their pages, attend each
+            # query against its slot's gathered logical sequence. All
+            # masking (per-row lengths, causality among the T tokens,
+            # refill staleness) rides the additive bias, so this branch
+            # is generic over plain/alibi/local architectures. The
+            # folded-scale int8 math and the gather/scatter live in
+            # ops/decode_attention.paged_attention_step.
+            from trlx_tpu.ops.decode_attention import paged_attention_step
+
+            scale = (
+                cfg.attn_scale if cfg.attn_scale is not None
+                else 1.0 / math.sqrt(D)
+            )
+            pools = {
+                name: cache[name]
+                for name in ("pk", "pv", "pk_scale", "pv_scale")
+                if name in cache
+            }
+            kernel_out, new_kv = paged_attention_step(
+                q, k, v, pools, cache["ix"], cache["page_table"],
+                cache["slot_pos"], attn_bias, scale,
+                lane_valid=cache.get("lane_valid"),
+                contiguous=bool(cache.get("contiguous", False)),
+            )
+        elif cache is not None:
             # update-carry-FIRST: write this layer's new [B, T, Hkv, D]
             # column into the scan-carried stacked buffer, then attend
             # against a slice of the UPDATED buffer. The column write
@@ -1026,6 +1052,46 @@ class TransformerLM:
         n = jax.tree_util.tree_leaves(block_params)[0].shape[0]
         flags = self._layer_flags(n, layer_offset)
 
+        if cache is not None and "pk" in cache:
+            # paged cache: the scan carries the page POOLS; the page
+            # table / slot positions / validity masks are per-forward
+            # constants (the engine advances them between forwards), so
+            # they ride the closure, not the carry
+            pool_keys = tuple(
+                name for name in ("pk", "pv", "pk_scale", "pv_scale")
+                if name in cache
+            )
+            meta = {
+                name: cache[name]
+                for name in ("page_table", "slot_pos", "lane_valid", "contiguous")
+                if name in cache
+            }
+
+            def paged_body(carry, layer):
+                hidden = carry[0]
+                layer_cache = dict(zip(pool_keys, carry[1:]), ix=layer["ix"], **meta)
+                lp = layer["p"]
+                bias = attn_bias
+                if flags is not None:
+                    bias = bias + layer["flag"] * local_bias
+                out, new_kv = self.block.apply(
+                    {"params": lp}, hidden, bias, positions, layer_cache,
+                    key_mask, ring_mesh,
+                )
+                return (out,) + tuple(new_kv[k] for k in pool_keys), None
+
+            from trlx_tpu.ops.remat import wrap_remat as _wrap
+
+            paged_body = _wrap(paged_body, remat)
+            xs: Dict[str, Any] = {"p": block_params, "ix": jnp.arange(n)}
+            if flags is not None:
+                xs["flag"] = flags
+            carry, _ = jax.lax.scan(
+                paged_body, (h,) + tuple(cache[k] for k in pool_keys), xs
+            )
+            new_cache = dict(cache, **dict(zip(pool_keys, carry[1:])))
+            return carry[0], new_cache
+
         quant = cache is not None and "k_scale" in cache
 
         def body(carry, layer):
@@ -1177,7 +1243,21 @@ class TransformerLM:
             # semantics)
             positions = n + jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
         ring = None
-        if cache is not None:
+        if cache is not None and "pk" in cache:
+            # paged cache (gen_engine): per-ROW slot positions — each
+            # decode lane sits at its own depth, unlike the dense cache's
+            # single scalar write index. The engine precomputes key_mask
+            # to cover exactly the valid logical slots INCLUDING the T
+            # incoming tokens; causality among those tokens falls out of
+            # the slot-index comparison in make_attention_bias.
+            S = cache["page_table"].shape[1] * cache["pk"].shape[2]
+            q_slots = cache["slot_pos"][:, None] + jnp.arange(T)[None, :]
+            if positions is None:
+                positions = q_slots
+            key_mask = cache["key_mask"].astype(jnp.int32)
+            bias, local_bias = self._build_bias(key_mask, q_slots, jnp.arange(S))
+            layer_cache = cache
+        elif cache is not None:
             # bf16 cache: [L, B, S, Hkv, D]; int8 (quantized) cache:
             # [L, B, Hkv, S, D] (layout rationale: quantize_kv_cache)
             S = cache["k"].shape[3 if "k_scale" in cache else 2]

@@ -32,6 +32,7 @@ generation is HF `model.generate` over full-precision torch caches
 from __future__ import annotations
 
 import functools
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,98 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 CHUNK = 512  # fp32 score tile per in-kernel step: [rep, CHUNK]
+
+
+def paged_attention_step(
+    q,  # [B, T, H, D] queries (rope already applied), T >= 1
+    k_new,  # [B, T, Hkv, D] this step's keys (pre-quantization)
+    v_new,  # [B, T, Hkv, D] this step's values
+    pools: Dict[str, jnp.ndarray],  # pk/pv [L, NP, PS, Hkv, D] (+ scales)
+    layer_ix,  # scalar int32: which layer's pages to touch
+    page_table,  # [B, MP] int32 slot -> page indirection
+    slot_pos,  # [B] int32: logical slot of the FIRST incoming token
+    attn_bias,  # [B, 1, T, S] additive fp32 (S = MP * PS)
+    sm_scale: float,
+    lane_valid: Optional[jnp.ndarray] = None,  # [B] bool; False -> trash write
+    contiguous: bool = False,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One layer's attention over a paged KV cache: write the T incoming
+    tokens' K/V into their pages, then attend every query against the
+    slot's full logical sequence (gathered pages), with the per-row
+    quant scales folded into the score / prob vectors so int8 K/V are
+    never dequantized at S width (the dense int8 path's folded-scale
+    recipe, generalized to per-row indirection and per-row positions).
+
+    Serves both the single-token decode step (T=1) and the speculative
+    verify forward (T=draft_k): causality among the T incoming tokens is
+    carried by `attn_bias` (slot-index comparison), so the same code is
+    exact for both. Returns (out [B, T, H, D], updated pools).
+    """
+    from trlx_tpu.ops.paged_kv import (
+        gather_layer,
+        quantize_rows,
+        scatter_layer,
+        write_positions,
+    )
+
+    B, T, H, D = q.shape
+    Hkv = k_new.shape[2]
+    PS = pools["pk"].shape[2]
+    quant = "pk_scale" in pools
+    positions = slot_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    pids, offs = write_positions(page_table, positions, PS, lane_valid)
+
+    new_pools = dict(pools)
+    if quant:
+        kq, ks = quantize_rows(k_new)  # [B, T, Hkv] scales
+        vq, vs = quantize_rows(v_new)
+        new_pools["pk"] = scatter_layer(pools["pk"], layer_ix, pids, offs, kq)
+        new_pools["pv"] = scatter_layer(pools["pv"], layer_ix, pids, offs, vq)
+        new_pools["pk_scale"] = scatter_layer(
+            pools["pk_scale"], layer_ix, pids, offs, ks
+        )
+        new_pools["pv_scale"] = scatter_layer(
+            pools["pv_scale"], layer_ix, pids, offs, vs
+        )
+    else:
+        new_pools["pk"] = scatter_layer(pools["pk"], layer_ix, pids, offs, k_new)
+        new_pools["pv"] = scatter_layer(pools["pv"], layer_ix, pids, offs, v_new)
+
+    # read AFTER the write (update-carry-first, like the dense cache
+    # branch): each query sees every token up to and including itself;
+    # older/unwritten/stale slots are excluded by attn_bias
+    k_all = gather_layer(new_pools["pk"], layer_ix, page_table, contiguous)
+    v_all = gather_layer(new_pools["pv"], layer_ix, page_table, contiguous)
+    if H != Hkv:
+        rep = H // Hkv
+        k_all = jnp.repeat(k_all, rep, axis=2)
+        v_all = jnp.repeat(v_all, rep, axis=2)
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q, k_all.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale
+    if quant:
+        ks_all = gather_layer(
+            new_pools["pk_scale"], layer_ix, page_table, contiguous
+        )  # [B, S, Hkv]
+        vs_all = gather_layer(
+            new_pools["pv_scale"], layer_ix, page_table, contiguous
+        )
+        if H != Hkv:
+            rep = H // Hkv
+            ks_all = jnp.repeat(ks_all, rep, axis=2)
+            vs_all = jnp.repeat(vs_all, rep, axis=2)
+        # per-row K scale rides the score tensor; per-row V scale rides
+        # the prob tensor — both commute out of the attention reductions
+        scores = scores * ks_all.transpose(0, 2, 1)[:, :, None, :]
+        probs = jax.nn.softmax(scores + attn_bias, axis=-1)
+        probs = (probs * vs_all.transpose(0, 2, 1)[:, :, None, :]).astype(
+            q.dtype
+        )
+    else:
+        probs = jax.nn.softmax(scores + attn_bias, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v_all.astype(q.dtype))
+    return out.astype(q.dtype), new_pools
 
 
 def _interpret() -> bool:

@@ -1,0 +1,203 @@
+"""Paged KV cache: fixed-size pages + slot→page indirection tables.
+
+The dense decode cache allocates `batch × (prompt + max_new_tokens)`
+slots per row for the whole rollout, so every row pays max-length KV
+even when its response ends after 10 tokens — and a finished row's
+memory cannot be reused until the whole batch finishes. Pages fix both:
+the cache is a pool of fixed-size pages ([L, n_pages, page_size, Hkv, D]
+int8 by default) plus a per-slot page table, so
+
+  * a decode slot allocates response pages LAZILY as its sequence grows
+    (a row that stops at 10 tokens never touches its other pages),
+  * a refilled slot (continuous batching, models/gen_engine.py) returns
+    its pages to a free stack and the next prompt reuses them,
+  * the pool is sized to expected LIVE tokens, not slots × max length.
+
+Quantization is symmetric per-(slot, kv-head) over the D axis for BOTH
+K and V (the same `_quantize_kv` formula the dense int8 cache applies
+to K): a per-row scale multiplies the score vector (K) or the prob
+vector (V), so both dequants commute out of the attention reductions
+and nothing S-sized is ever dequantized to HBM. This differs from the
+dense path's frozen per-channel V scales deliberately — per-row V
+scales need no saturation headroom and no freeze point, which matters
+when slots are refilled with fresh prompts mid-rollout.
+
+Vocabulary: a *slot* is a decode lane (row of the step batch); a *page*
+holds `page_size` consecutive logical positions of one slot's sequence.
+Page 0 is RESERVED as the null/trash page: unassigned page-table
+entries point at it, and masked lanes write into it, so it must never
+be allocated (init_alloc never hands it out) and is never marked
+attendable. The "contiguous" layout (page_table[b, j] == 1 + b*MP + j,
+never rebuilt) degenerates to a dense per-slot cache — the gather
+becomes a reshape — and exists so the engine can attribute the paging
+indirection's cost/benefit separately from continuous batching
+(bench.py decode section).
+
+All ops here are plain XLA (gathers/scatters): the repo's own
+measurements (Attention's int8 branch) found the folded-scale XLA
+decode faster than the pallas kernel at the production geometry, so the
+paged path follows the same recipe; a pallas paged kernel can slot in
+behind `paged_attention_step` (ops/decode_attention.py) later.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+def pages_per_slot(prompt_len: int, max_new: int, page_size: int) -> int:
+    """Logical pages a slot can touch: ceil((P + N) / page_size)."""
+    return -(-(prompt_len + max_new) // page_size)
+
+
+def init_pool(
+    n_layer: int,
+    n_pages: int,
+    page_size: int,
+    n_kv_head: int,
+    head_dim: int,
+    quant: Optional[str],
+    dtype,
+) -> Dict[str, Array]:
+    """Allocate the page pool. Keys: pk/pv (+ pk_scale/pv_scale when
+    quant == "int8"). Page 0 is the reserved null page."""
+    shape = (n_layer, n_pages, page_size, n_kv_head, head_dim)
+    if quant == "int8":
+        pool = {
+            "pk": jnp.zeros(shape, jnp.int8),
+            "pv": jnp.zeros(shape, jnp.int8),
+            "pk_scale": jnp.zeros(shape[:4], jnp.float32),
+            "pv_scale": jnp.zeros(shape[:4], jnp.float32),
+        }
+    elif quant in (None, "none"):
+        pool = {"pk": jnp.zeros(shape, dtype), "pv": jnp.zeros(shape, dtype)}
+    else:
+        raise ValueError(f"paged KV quant must be None or 'int8', got {quant!r}")
+    return pool
+
+
+def init_alloc(n_pages: int) -> Tuple[Array, Array]:
+    """Free stack over pages 1..n_pages-1 (page 0 reserved null).
+
+    Returns (free, ntop): free[:ntop] are free page ids, popped from the
+    TOP (highest index) so allocation order is deterministic."""
+    free = jnp.concatenate(
+        [jnp.arange(1, n_pages, dtype=jnp.int32), jnp.zeros((1,), jnp.int32)]
+    )
+    return free, jnp.int32(n_pages - 1)
+
+
+def push_free(
+    free: Array, ntop: Array, pages: Array, is_real: Array
+) -> Tuple[Array, Array]:
+    """Return `pages[is_real]` to the stack (vectorized, fixed shape).
+
+    `pages` [M] int32, `is_real` [M] bool; entries with is_real=False
+    (or page id 0) are dropped. Order among returned pages follows the
+    input order."""
+    is_real = is_real & (pages > 0)
+    order = jnp.cumsum(is_real.astype(jnp.int32)) - 1
+    dst = jnp.where(is_real, ntop + order, free.shape[0])  # OOB -> dropped
+    free = free.at[dst].set(pages, mode="drop")
+    return free, ntop + is_real.sum(dtype=jnp.int32)
+
+
+def pop_pages(
+    free: Array, ntop: Array, want: Array
+) -> Tuple[Array, Array, Array]:
+    """Pop one page per wanting lane, vectorized at fixed shape.
+
+    `want` [M] bool: lane m wants one page. Lanes are served in input
+    order from the top of the stack; a lane beyond the available count
+    gets page 0 (null) — callers treat that as allocation failure.
+    Returns (page_ids [M], free, ntop)."""
+    want = want.astype(jnp.int32)
+    order = jnp.cumsum(want) - 1  # 0-based rank among wanting lanes
+    have = order < ntop
+    src = jnp.where((want > 0) & have, ntop - 1 - order, free.shape[0] - 1)
+    # free[free.shape[0]-1] is a zero sentinel kept by init_alloc
+    ids = free[src] * ((want > 0) & have)
+    taken = ((want > 0) & have).sum(dtype=jnp.int32)
+    return ids.astype(jnp.int32), free, ntop - taken
+
+
+def quantize_rows(x: Array) -> Tuple[Array, Array]:
+    """Symmetric per-row int8 over the trailing D axis: (int8, f32 scale
+    shaped x.shape[:-1]). Zero rows get scale 0 and dequantize to 0 —
+    the same contract as transformer._quantize_kv."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    s = amax / 127.0
+    q = jnp.round(
+        x.astype(jnp.float32) / jnp.maximum(s, 1e-12)[..., None]
+    ).astype(jnp.int8)
+    return q, s
+
+
+def write_positions(
+    page_table: Array,  # [B, MP] int32
+    positions: Array,  # [B, T] int32 logical slot positions
+    page_size: int,
+    lane_valid: Optional[Array] = None,  # [B] bool; invalid -> null page
+) -> Tuple[Array, Array]:
+    """(page_ids [B, T], offsets [B, T]) for scattering tokens at
+    `positions` of each slot. Invalid lanes are routed to page 0 (the
+    null page), so masked writes land in trash instead of corrupting a
+    live slot."""
+    MP = page_table.shape[1]
+    pix = jnp.clip(positions // page_size, 0, MP - 1)
+    pids = jnp.take_along_axis(page_table, pix, axis=1)
+    offs = positions % page_size
+    if lane_valid is not None:
+        pids = jnp.where(lane_valid[:, None], pids, 0)
+    return pids.astype(jnp.int32), offs.astype(jnp.int32)
+
+
+def scatter_layer(
+    pool_leaf: Array,  # [L, NP, PS, ...] (values or scales)
+    layer_ix: Array,  # scalar int32
+    pids: Array,  # [B, T]
+    offs: Array,  # [B, T]
+    values: Array,  # [B, T, ...]
+) -> Array:
+    """Scatter one layer's new tokens into the pool, in place on a
+    scan-carried buffer."""
+    return pool_leaf.at[layer_ix, pids, offs].set(
+        values.astype(pool_leaf.dtype)
+    )
+
+
+def scatter_prefill(
+    pool_leaf: Array,  # [L, NP, PS, ...]
+    pids: Array,  # [R, P]
+    offs: Array,  # [R, P]
+    values: Array,  # [L, R, P, ...]
+) -> Array:
+    """Scatter a whole prefilled prompt block (all layers at once)."""
+    return pool_leaf.at[:, pids, offs].set(values.astype(pool_leaf.dtype))
+
+
+def gather_layer(
+    pool_leaf: Array,  # [L, NP, PS, ...]
+    layer_ix: Array,  # scalar int32
+    page_table: Array,  # [B, MP]
+    contiguous: bool = False,
+) -> Array:
+    """This layer's logical [B, MP*PS, ...] view of the pool.
+
+    `contiguous=True` asserts page_table[b, j] == 1 + b*MP + j (the
+    engine's unpaged layout): the gather collapses to a slice+reshape,
+    which XLA fuses into the attention reads like a dense cache."""
+    B, MP = page_table.shape
+    layer = jax.lax.dynamic_index_in_dim(pool_leaf, layer_ix, 0, keepdims=False)
+    PS = layer.shape[1]
+    if contiguous:
+        block = jax.lax.dynamic_slice_in_dim(layer, 1, B * MP, axis=0)
+        return block.reshape((B, MP * PS) + layer.shape[2:])
+    return jnp.take(layer, page_table, axis=0).reshape(
+        (B, MP * PS) + layer.shape[2:]
+    )

@@ -851,6 +851,59 @@ class TPUPPOTrainer(TPUBaseTrainer):
                     lambda x: x[:B], rollout_batch
                 )
 
+            # honest rollout accounting: pad emissions from finished
+            # rows are NOT generated tokens — report mask-weighted real
+            # tokens plus batch occupancy, and a truncation rate (rows
+            # that ran to max_new_tokens without an EOS: a degenerate
+            # policy that stops emitting EOS shows up here, and the
+            # guardrails can trip on it via truncation_max)
+            rm_np = np.asarray(response_mask)
+            ri_np = np.asarray(response_ids)
+            N_resp = rm_np.shape[1]
+            real_toks = float(rm_np.sum())
+            stats["rollout/real_tokens"] = real_toks
+            stats["rollout/token_occupancy"] = real_toks / max(
+                rm_np.shape[0] * N_resp, 1
+            )
+            eos_id = self.generate_settings.eos_token_id
+            full_rows = rm_np.sum(axis=1) >= N_resp
+            hit_eos = (
+                ((ri_np == eos_id) & (rm_np > 0)).any(axis=1)
+                if eos_id >= 0
+                else np.zeros(len(full_rows), bool)
+            )
+            stats["rollout/truncation_rate"] = (
+                float((full_rows & ~hit_eos).mean()) if len(full_rows) else 0.0
+            )
+            gstats = gen_out.get("gen_stats")
+            if gstats is not None:
+                g = {k: float(np.asarray(v)) for k, v in gstats.items()}
+                # per-refill heartbeat accounting (host-side,
+                # post-dispatch): with the decode engine a chunk is ONE
+                # device dispatch, so the refills all land at once —
+                # batch them into a single annotated beat (count=N)
+                # instead of N same-instant beats that would evict the
+                # other phases from the watchdog's bounded timeline
+                refills = int(g.get("refills", 0))
+                if refills:
+                    self.watchdog.beat(
+                        "rollout", step=iter_count, count=refills
+                    )
+                stats["rollout/engine_occupancy"] = g.get("occupancy", 0.0)
+                stats["rollout/engine_refills"] = g.get("refills", 0.0)
+                stats["rollout/engine_decode_steps"] = g.get("decode_steps", 0.0)
+                if "drafted" in g:
+                    stats["rollout/spec_accept_rate"] = g["accepted"] / max(
+                        g["drafted"], 1.0
+                    )
+                if g.get("oom_truncated") or g.get("unserved"):
+                    logger.warning(
+                        "gen_engine: page pool exhausted (%d lanes "
+                        "truncated, %d prompts unserved) — raise "
+                        "ppo.gen_engine.pool_pages",
+                        int(g.get("oom_truncated", 0)),
+                        int(g.get("unserved", 0)),
+                    )
             stats["time/rollout_time"] = clock.tick()
             stats["policy/sqrt_kl"] = jnp.sqrt(
                 jnp.maximum(kl_stats["mean_kl"], 0.0)
@@ -899,6 +952,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
                     reward_mean=stats.get("rollout_scores/mean"),
                     running_mean=stats.get("rollout_scores/running_mean"),
                     running_std=stats.get("rollout_scores/running_std"),
+                    truncation_rate=stats.get("rollout/truncation_rate"),
                 )
             self._tracker_log(stats, step=step)
 

@@ -33,7 +33,9 @@ Everything here is pure host-side bookkeeping (no jax at module scope);
 trainer/base.py owns executing the actions.
 
 Trip signals: ``loss`` / ``grad_norm`` / ``cycle_time`` (observe_train),
-``kl`` / ``reward`` (observe_rollout), plus the externally-detected
+``kl`` / ``reward`` / ``truncation`` (observe_rollout — truncation is
+the rollout decode ledger: the fraction of rows running to
+max_new_tokens without EOS), plus the externally-detected
 kinds recorded via :meth:`GuardrailMonitor.trip` — ``consistency``
 (the PR 4 cross-host fingerprint watchdog), ``peer`` (a synthetic
 lockstep trip), and ``stall`` (:data:`STALL_SIGNAL`, the hang doctor:
@@ -115,6 +117,13 @@ class GuardrailConfig:
                        escalates straight to abort.
     recover_after      consecutive healthy cycles that reset the ladder
                        (and mark the state clean for checkpoint gating).
+    truncation_max     trip when the fraction of rollout rows that hit
+                       max_new_tokens WITHOUT emitting EOS exceeds this
+                       (0 disables). A policy collapsing into never
+                       emitting EOS silently multiplies rollout cost
+                       (every response runs to the cap) before any
+                       reward/KL signal moves — this catches it at the
+                       decode ledger instead.
     """
 
     enabled: bool = False
@@ -132,6 +141,7 @@ class GuardrailConfig:
     cooldown_cycles: int = 3
     max_rollbacks: int = 2
     recover_after: int = 2
+    truncation_max: float = 0.0
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "GuardrailConfig":
@@ -313,6 +323,7 @@ class GuardrailMonitor:
         reward_mean: Optional[float] = None,
         running_mean: Optional[float] = None,
         running_std: Optional[float] = None,
+        truncation_rate: Optional[float] = None,
     ) -> None:
         """One rollout phase's aggregate stats (PPO)."""
         if not self.enabled:
@@ -350,6 +361,19 @@ class GuardrailMonitor:
                     f"running moments ({float(running_mean):.4g} ± "
                     f"{cfg.reward_sigma}*{float(running_std):.4g})",
                 )
+        if (
+            truncation_rate is not None
+            and cfg.truncation_max > 0
+            and _finite(truncation_rate)
+            and float(truncation_rate) > cfg.truncation_max
+        ):
+            self._trip(
+                "truncation",
+                f"{float(truncation_rate):.0%} of rollout rows hit "
+                f"max_new_tokens without EOS (> {cfg.truncation_max:.0%}"
+                ") — the policy may have stopped terminating; rollout "
+                "cost is inflating to the cap",
+            )
 
     # -- decisions -------------------------------------------------------
 

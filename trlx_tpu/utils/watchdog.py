@@ -238,17 +238,23 @@ class HangWatchdog:
         return st
 
     def beat(self, phase: str, event: str = "point",
-             step: Optional[int] = None) -> None:
+             step: Optional[int] = None, count: int = 1) -> None:
         """Record a heartbeat. ``event`` is ``start``/``end``/``point``;
         a ``point`` beat inside an in-progress phase refreshes its
         staleness clock (a healthy many-chunk rollout keeps beating per
-        chunk; a single wedged chunk goes silent). Host-side only."""
-        if not self.cfg.enabled:
+        chunk; a single wedged chunk goes silent). Host-side only.
+
+        ``count`` batches N same-instant beats into ONE call (e.g. the
+        decode engine reports a whole dispatch's slot refills after the
+        fact): the beat counter advances by N but the timeline gets a
+        single annotated entry, so a burst cannot evict the other
+        phases' history from the bounded timeline deque."""
+        if not self.cfg.enabled or count < 1:
             return
         now = self._clock()
         with self._lock:
             st = self._state(phase)
-            st.beats += 1
+            st.beats += count
             st.last_beat = now
             if step is not None:
                 st.step = step
@@ -260,7 +266,9 @@ class HangWatchdog:
                     st.total_s += now - st.started_at
                 st.started_at = None
             self._last_beat = now
-            self._timeline.append((now, phase, event, step))
+            self._timeline.append(
+                (now, phase, event if count == 1 else f"{event} x{count}", step)
+            )
 
     @contextmanager
     def phase(self, name: str, step: Optional[int] = None):
