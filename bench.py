@@ -1306,8 +1306,14 @@ def bench_chaos() -> dict:
     # end in detection -> stack dump -> restorable emergency snapshot ->
     # EXIT_STALLED, in child processes (the abort is a process exit)
     stall = bench_chaos_stalls()
+    # experience-transport leg: producer death mid-lease / duplicate
+    # delivery / queue wedge leave the consumed stream bit-identical to
+    # the fault-free exp.enabled run, and stale_flood trips the
+    # staleness guardrail without aborting
+    exp_leg = bench_chaos_exp()
     return {
         **stall,
+        **exp_leg,
         "chaos_completed_steps": int(trainer.iter_count),
         "chaos_rollbacks": int(trainer.guardrails.rollbacks),
         "chaos_actions": list(trainer.guardrails.actions_taken),
@@ -1321,6 +1327,141 @@ def bench_chaos() -> dict:
     }
 
 
+def _chaos_exp_config(ckpt_dir: str, chaos=None, guardrails=None):
+    """Tiny-PPO config for the experience-transport chaos leg:
+    ``ppo.exp`` armed with a short lease TTL (so an injected producer
+    death expires and re-dispatches in test time), overlap prefetch on,
+    jsonl tracker for the loss/reward-stream compare."""
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    return default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=6, eval_interval=100,
+            checkpoint_interval=100, seq_length=24, epochs=64,
+            tracker="jsonl", checkpoint_dir=ckpt_dir, save_best=False,
+            external_retries=1, retry_base_delay=0.05,
+            chaos=chaos, guardrails=guardrails or {},
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    vocab_size=258, hidden_size=64, n_layer=2, n_head=2,
+                    n_positions=64,
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            overlap_rollouts=True,
+            exp=dict(enabled=True, lease_ttl_s=0.2, wait_poll_s=0.02),
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                            do_sample=True),
+        ),
+    )
+
+
+def _run_exp_leg(tag: str, chaos=None, guardrails=None):
+    """One exp.enabled learn() run; returns (trainer, loss/reward
+    stream) where the stream is every tracker record's losses/* +
+    reward/mean keys, in order — the bit-equality artifact."""
+    import shutil
+
+    import trlx_tpu
+
+    ckpt_dir = os.path.join("/tmp", f"chaos_exp_{tag}_ckpts")
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    config = _chaos_exp_config(ckpt_dir, chaos=chaos, guardrails=guardrails)
+    prompts = ["hello world", "the cat", "a b", "xyz",
+               "what is", "I am", "go", "ok"]
+
+    def reward(samples, prompts, outputs, **kw):
+        return [float(len(o.split())) for o in outputs]
+
+    trainer = trlx_tpu.train(reward_fn=reward, prompts=prompts, config=config)
+    with open(os.path.join(ckpt_dir, "logs", "metrics.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    stream = [
+        {k: v for k, v in r.items()
+         if k.startswith("losses/") or k == "reward/mean"}
+        for r in recs
+    ]
+    return trainer, [s for s in stream if s]
+
+
+def bench_chaos_exp() -> dict:
+    """Experience-transport chaos proof (part of ``bench.py --chaos``):
+
+    1. fault-free ``exp.enabled`` baseline — records the loss/reward
+       stream;
+    2. producer killed mid-lease (+ a duplicate delivery and a queue
+       wedge): the lease must expire, the chunk re-dispatch to a live
+       producer, the dedup drop the redelivery, the back-pressure wait
+       ride out the wedge — and the final loss/reward stream must be
+       BIT-IDENTICAL to the fault-free run;
+    3. ``stale_flood``: the staleness admission gate must trip the
+       ``staleness`` guardrail signal, re-dispatch the rejected chunk,
+       and the run must complete WITHOUT aborting."""
+    t0 = time.time()
+    _, stream_ff = _run_exp_leg("ff")
+
+    chaos = dict(seed=0, faults=[
+        # 2nd chunk's producer dies right after taking its lease
+        {"fault": "worker_death_mid_lease", "at": 2},
+        # 3rd chunk is delivered twice (retry racing its own success)
+        {"fault": "duplicate_delivery", "at": 3},
+        # 4th chunk's offers see a wedged (full) queue
+        {"fault": "queue_wedge", "at": 4},
+    ])
+    faulted, stream_faulted = _run_exp_leg("faulted", chaos=chaos)
+    summary = faulted._exp.stats_summary()
+    assert summary["lease_expired"] >= 1 and summary["redispatches"] >= 1, (
+        f"expected the killed producer's lease to expire and re-dispatch: "
+        f"{summary}"
+    )
+    assert summary["queue_duplicates"] >= 1, (
+        f"expected the duplicate delivery to be deduped: {summary}"
+    )
+    assert summary["backpressure_waits"] >= 1, (
+        f"expected the queue wedge to exercise the back-pressure wait: "
+        f"{summary}"
+    )
+    assert stream_faulted == stream_ff, (
+        "loss/reward stream diverged from the fault-free exp run under "
+        f"worker-death/duplicate/wedge chaos:\nfault-free: {stream_ff}\n"
+        f"faulted:    {stream_faulted}"
+    )
+
+    stale, stream_stale = _run_exp_leg(
+        "stale",
+        chaos=dict(seed=0, faults=[{"fault": "stale_flood", "at": 2}]),
+        guardrails=dict(
+            enabled=True, loss_spike_sigma=0.0,
+            ladder=["log", "requeue", "rollback", "abort"],
+        ),
+    )
+    assert "staleness" in stale.guardrails.trip_history, (
+        f"expected a staleness guardrail trip, saw "
+        f"{stale.guardrails.trip_history}"
+    )
+    assert stale.iter_count >= stale.config.train.total_steps, (
+        f"stale_flood leg aborted at step {stale.iter_count}"
+    )
+    assert stale._exp.stats_summary()["staleness_rejects"] >= 1
+
+    return {
+        "exp_bit_identical_under_faults": True,
+        "exp_lease_expiries": int(summary["lease_expired"]),
+        "exp_redispatches": int(summary["redispatches"]),
+        "exp_duplicates_dropped": int(summary["queue_duplicates"]),
+        "exp_backpressure_waits": int(summary["backpressure_waits"]),
+        "exp_staleness_trips":
+            stale.guardrails.trip_history.count("staleness"),
+        "exp_leg_wall_s": round(time.time() - t0, 1),
+    }
+
+
 def _chaos_stall_config(ckpt_dir: str, fault: str):
     """Tiny-PPO config for the hang-doctor smoke: the chaos ``fault``
     site sleeps far past the watchdog deadlines, so the run must END by
@@ -1330,7 +1471,19 @@ def _chaos_stall_config(ckpt_dir: str, fault: str):
     unambiguous watchdog failure."""
     from trlx_tpu.data.default_configs import default_ppo_config
 
-    at = {"stall_rollout": 3, "stall_collective": 2}[fault]
+    # the engine leg proves the PR 6 robustness gap is closed: the
+    # decode engine's refill paths beat the watchdog under exp.enabled
+    # prefetch too, so a wedged engine-backed rollout is detected the
+    # same way the dense sampler's is
+    engine = fault == "stall_rollout_engine"
+    chaos_fault = "stall_rollout" if engine else fault
+    at = {"stall_rollout": 3, "stall_collective": 2}[chaos_fault]
+    method_extra = {}
+    if engine:
+        method_extra = dict(
+            gen_engine=dict(enabled=True),
+            exp=dict(enabled=True, lease_ttl_s=0.2, wait_poll_s=0.02),
+        )
     return default_ppo_config().evolve(
         train=dict(
             batch_size=8, total_steps=8, eval_interval=100,
@@ -1346,7 +1499,7 @@ def _chaos_stall_config(ckpt_dir: str, fault: str):
             ),
             chaos=dict(
                 seed=0, stall_delay=STALL_SLEEP_S,
-                faults=[{"fault": fault, "at": at}],
+                faults=[{"fault": chaos_fault, "at": at}],
             ),
         ),
         model=dict(
@@ -1364,13 +1517,17 @@ def _chaos_stall_config(ckpt_dir: str, fault: str):
             overlap_rollouts=True,
             gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
                             do_sample=True),
+            **method_extra,
         ),
     )
 
 
 STALL_DEADLINE_S = 45.0
 STALL_SLEEP_S = 600.0
-_STALL_FAULTS = ("stall_rollout", "stall_collective")
+# stall_rollout_engine = the stall_rollout site with the PR 6 decode
+# engine AND the experience transport armed (the engine's refill beats
+# must keep the watchdog fed until the injected wedge goes silent)
+_STALL_FAULTS = ("stall_rollout", "stall_collective", "stall_rollout_engine")
 
 
 def bench_chaos_stall_child(fault: str) -> None:

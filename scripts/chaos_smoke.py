@@ -12,13 +12,21 @@ auto-rollback to the last good checkpoint, the corrupted checkpoint
 quarantined — not loaded, not deleted — the divergence tripping the
 ladder, finite final reward).
 
-It also proves the HANG DOCTOR end to end: `stall_rollout` and
-`stall_collective` schedules run in child processes whose injected
-sleep is ~13x the `train.watchdog` deadline, and each child must
-detect the stall within the deadline, log the all-thread stack dump,
-write an emergency snapshot (restorable via `trainer.load()`, asserted
-here) and exit with the "stalled" exit class
-(`watchdog.EXIT_STALLED = 87`) — distinguishable from a crash.
+It also proves the HANG DOCTOR end to end: `stall_rollout`,
+`stall_collective` and `stall_rollout_engine` (the same rollout wedge
+with the decode engine + experience transport armed) schedules run in
+child processes whose injected sleep is ~13x the `train.watchdog`
+deadline, and each child must detect the stall within the deadline,
+log the all-thread stack dump, write an emergency snapshot (restorable
+via `trainer.load()`, asserted here) and exit with the "stalled" exit
+class (`watchdog.EXIT_STALLED = 87`) — distinguishable from a crash.
+
+And it proves the EXPERIENCE TRANSPORT (`ppo.exp.enabled`,
+trlx_tpu/exp/): a producer killed mid-lease (lease expiry ->
+re-dispatch), a duplicate delivery (consumer dedup) and a queue wedge
+(bounded back-pressure wait) must leave the loss/reward stream
+BIT-IDENTICAL to the fault-free exp run, and a `stale_flood` schedule
+must trip the `staleness` guardrail signal without aborting.
 
 CPU-friendly (tiny random model, byte tokenizer, zero egress) — run it
 after touching guardrails / checkpointing / the rollout loop:

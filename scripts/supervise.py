@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Exit-class-aware run supervisor.
+
+Wraps a training command and routes each exit by its CLASS instead of
+blindly relaunching (docs/robustness.md "Exit classes"):
+
+  0   clean finish / handled preemption — honored: the supervisor stops.
+  87  stalled (`watchdog.EXIT_STALLED`, the hang doctor) — relaunch
+      pointed at the newest emergency snapshot under ``--checkpoint-dir``
+      via the ``TRLX_TPU_RESUME_FROM`` env override (api.py); emergency
+      snapshots are deliberately invisible to auto-discovery, so without
+      this routing a relaunch would silently lose everything after the
+      last interval commit. Falls back to a plain relaunch (auto-resume)
+      when no snapshot exists.
+  *   crash (exception, guardrails abort, OOM-kill) — relaunch with
+      exponential backoff (doubling from ``--backoff``, capped at
+      ``--backoff-max``), after FLAP DETECTION: ``--flap-limit`` exits
+      within ``--flap-window`` seconds of their own launch means the
+      process is dying faster than it can make progress (a code bug, not
+      an infra event) — the supervisor gives up instead of burning the
+      allocation, with a ``gave_up`` ledger entry naming the streak.
+
+Every decision is appended to a machine-readable JSONL RUN LEDGER
+(``--ledger``, default ``<checkpoint-dir>/run_ledger.jsonl``): one
+record per exit with the attempt number, exit code + class, run wall
+seconds, the action taken (``done`` / ``restart`` / ``resume_snapshot``
+/ ``gave_up``), the backoff applied and any resume path — what a fleet
+dashboard ingests to tell "stalls on host X" from "crash-looping
+everywhere".
+
+Usage:
+    python scripts/supervise.py --checkpoint-dir ckpts -- \
+        python examples/ppo_dense_sentiments.py
+    python scripts/supervise.py --max-restarts 20 --backoff 5 -- \
+        python train.py --config my.yml
+
+Everything after ``--`` is the child command, run as-is with the
+current environment (+ ``TRLX_TPU_RESUME_FROM`` when routing a stall).
+Tested end to end in child processes: tests/test_supervisor.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.utils.checkpointing import (  # noqa: E402
+    EMERGENCY_PREFIX,
+    is_committed,
+)
+from trlx_tpu.utils.watchdog import EXIT_STALLED  # noqa: E402
+
+EXIT_CLASSES = {0: "clean", EXIT_STALLED: "stalled"}
+
+
+def classify(code: int) -> str:
+    return EXIT_CLASSES.get(code, "crash")
+
+
+def _committed_steps(checkpoint_dir: str, prefix: str):
+    """(step, path) pairs of committed ``<prefix><step>`` dirs."""
+    out = []
+    for entry in os.listdir(checkpoint_dir):
+        if not entry.startswith(prefix):
+            continue
+        suffix = entry[len(prefix):]
+        if not suffix.isdigit():
+            continue
+        path = os.path.join(checkpoint_dir, entry)
+        if is_committed(path):
+            out.append((int(suffix), path))
+    return out
+
+
+def latest_emergency_snapshot(checkpoint_dir: str) -> Optional[str]:
+    """Newest committed ``emergency_checkpoint_<step>`` under the root
+    (highest step wins) — but only when it is at least as far along as
+    the newest committed REGULAR checkpoint. Emergency snapshots are
+    never reaped by retention, so a stale one from an old stall can
+    outlive hundreds of later interval commits; resuming it would
+    silently rewind training that plain auto-resume would have kept.
+    Returns None when there is no snapshot worth preferring."""
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return None
+    snaps = _committed_steps(checkpoint_dir, EMERGENCY_PREFIX)
+    if not snaps:
+        return None
+    step, path = max(snaps)
+    regular = _committed_steps(checkpoint_dir, "checkpoint_")
+    if regular and max(regular)[0] > step:
+        print(
+            f"supervise: ignoring stale emergency snapshot {path} "
+            f"(step {step}) — a newer committed checkpoint exists at "
+            f"step {max(regular)[0]}; plain auto-resume keeps more "
+            "progress"
+        )
+        return None
+    return path
+
+
+class Ledger:
+    """Append-only JSONL run ledger (one record per supervised exit)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def append(self, record: dict) -> None:
+        record = {"ts": time.time(), **record}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def supervise(
+    command: List[str],
+    checkpoint_dir: str,
+    ledger: Ledger,
+    max_restarts: int = 100,
+    backoff_s: float = 5.0,
+    backoff_max_s: float = 300.0,
+    flap_window_s: float = 60.0,
+    flap_limit: int = 3,
+    sleep=time.sleep,
+    runner=None,
+) -> int:
+    """Run ``command`` under exit-class routing. Returns the supervisor's
+    own exit code: 0 on a clean child finish, 1 on give-up (flap limit /
+    restart budget). ``runner``/``sleep`` are injectable for tests
+    (``runner(cmd, env) -> (exit_code,)`` defaults to subprocess)."""
+
+    def default_runner(cmd, env):
+        return (subprocess.call(cmd, env=env),)
+
+    runner = runner or default_runner
+    attempt = 0
+    flap_streak = 0
+    delay = backoff_s
+    resume_from: Optional[str] = None
+    while True:
+        attempt += 1
+        env = dict(os.environ)
+        if resume_from:
+            env["TRLX_TPU_RESUME_FROM"] = resume_from
+        t0 = time.time()
+        (code,) = runner(command, env)
+        run_s = time.time() - t0
+        exit_class = classify(code)
+        record = {
+            "attempt": attempt,
+            "exit_code": int(code),
+            "exit_class": exit_class,
+            "run_s": round(run_s, 3),
+            "resume_from": resume_from,
+        }
+        resume_from = None
+
+        if exit_class == "clean":
+            ledger.append({**record, "action": "done"})
+            print(f"supervise: clean exit after attempt {attempt}")
+            return 0
+
+        # flap detection applies to every non-clean exit class: a child
+        # that dies within flap_window_s of its own launch, flap_limit
+        # times in a row, is not making progress between failures. A
+        # long healthy run also resets the crash backoff — an isolated
+        # crash after days of progress should not pay backoff
+        # accumulated by unrelated failures from the run's start.
+        if run_s >= flap_window_s:
+            flap_streak = 0
+            delay = backoff_s
+        else:
+            flap_streak += 1
+        if flap_streak >= flap_limit:
+            ledger.append({
+                **record, "action": "gave_up",
+                "reason": (
+                    f"{flap_streak} consecutive exits within "
+                    f"{flap_window_s}s of launch (flap limit "
+                    f"{flap_limit}) — restarting cannot help; "
+                    "investigate the ledger and the last run's log"
+                ),
+            })
+            print(
+                f"supervise: giving up after {attempt} attempts "
+                f"({flap_streak} rapid failures in a row)",
+                file=sys.stderr,
+            )
+            return 1
+        if attempt >= max_restarts + 1:
+            ledger.append({
+                **record, "action": "gave_up",
+                "reason": f"restart budget exhausted ({max_restarts})",
+            })
+            print(
+                f"supervise: restart budget ({max_restarts}) exhausted",
+                file=sys.stderr,
+            )
+            return 1
+
+        if exit_class == "stalled":
+            # hang doctor took the run down (exit 87): the stall is an
+            # infra event, not a code bug — restart immediately (no
+            # backoff) from the emergency snapshot when one exists
+            snap = latest_emergency_snapshot(checkpoint_dir)
+            resume_from = snap
+            ledger.append({
+                **record,
+                "action": "resume_snapshot" if snap else "restart",
+                "snapshot": snap,
+                "backoff_s": 0.0,
+            })
+            print(
+                f"supervise: stalled exit (87); relaunching"
+                + (f" from emergency snapshot {snap}" if snap else
+                   " (no emergency snapshot found; auto-resume)")
+            )
+            continue
+
+        # crash: exponential backoff between attempts
+        ledger.append({
+            **record, "action": "restart", "backoff_s": round(delay, 3),
+        })
+        print(
+            f"supervise: crash (exit {code}); restarting in {delay:.1f}s "
+            f"(attempt {attempt + 1})",
+            file=sys.stderr,
+        )
+        sleep(delay)
+        delay = min(delay * 2, backoff_max_s)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--checkpoint-dir", default="ckpts",
+        help="the run's train.checkpoint_dir — where emergency "
+             "snapshots are discovered for stalled-exit routing",
+    )
+    parser.add_argument(
+        "--ledger", default=None,
+        help="JSONL run-ledger path (default "
+             "<checkpoint-dir>/run_ledger.jsonl)",
+    )
+    parser.add_argument(
+        "--max-restarts", type=int, default=100,
+        help="total relaunch budget before giving up",
+    )
+    parser.add_argument(
+        "--backoff", type=float, default=5.0,
+        help="initial crash-restart backoff seconds (doubles per "
+             "consecutive crash, capped at --backoff-max)",
+    )
+    parser.add_argument("--backoff-max", type=float, default=300.0)
+    parser.add_argument(
+        "--flap-window", type=float, default=60.0,
+        help="an exit within this many seconds of its own launch "
+             "counts toward the flap streak",
+    )
+    parser.add_argument(
+        "--flap-limit", type=int, default=3,
+        help="rapid failures in a row before the supervisor gives up",
+    )
+    parser.add_argument(
+        "command", nargs=argparse.REMAINDER,
+        help="the training command, after a literal --",
+    )
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given (pass it after a literal --)")
+    ledger = Ledger(
+        args.ledger
+        or os.path.join(args.checkpoint_dir, "run_ledger.jsonl")
+    )
+    return supervise(
+        command,
+        checkpoint_dir=args.checkpoint_dir,
+        ledger=ledger,
+        max_restarts=args.max_restarts,
+        backoff_s=args.backoff,
+        backoff_max_s=args.backoff_max,
+        flap_window_s=args.flap_window,
+        flap_limit=args.flap_limit,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

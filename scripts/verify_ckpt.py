@@ -43,6 +43,7 @@ from trlx_tpu.utils.checkpointing import (  # noqa: E402
     INTEGRITY_MANIFEST,
     QUARANTINE_SUFFIX,
     STALL_REPORT_FILE,
+    check_cursor_invariants,
     is_committed,
     is_emergency,
     verify_integrity,
@@ -89,6 +90,23 @@ def check_one(directory: str, deep: bool = False) -> list:
                 state = json.load(f)
             if "iter_count" not in state:
                 problems.append(f"{state_fp}: missing iter_count")
+            # experience transport: report the consumer cursor /
+            # staleness fields and FAIL LOUDLY on the torn-commit
+            # invariant (cursor past the committed prompt-stream
+            # position — see checkpointing.check_cursor_invariants)
+            eq = state.get("exp_queue")
+            if isinstance(eq, dict):
+                print(
+                    f"NOTE  {directory}: experience-transport state — "
+                    f"epoch {eq.get('epoch')}, consumer cursor "
+                    f"{eq.get('cursor')}, policy_version "
+                    f"{eq.get('policy_version')}, staleness mode "
+                    f"{eq.get('staleness_mode', 'reject')!r} (prompt "
+                    f"cursor {state.get('prompt_batches_consumed')})"
+                )
+            problems.extend(
+                f"{state_fp}: {p}" for p in check_cursor_invariants(state)
+            )
         except Exception as e:
             problems.append(f"{state_fp}: unparseable ({e})")
 

@@ -31,6 +31,7 @@ TIER1_BUDGETS = {
     "test_deferred_stats.py": 5,
     "test_elastic.py": 70,
     "test_examples.py": 20,
+    "test_exp_queue.py": 70,
     "test_fault_tolerance.py": 90,
     "test_flash_attention.py": 15,
     "test_gen_engine.py": 60,
@@ -40,20 +41,26 @@ TIER1_BUDGETS = {
     "test_marker_audit.py": 2,
     "test_mcts_value_branch.py": 15,
     "test_models.py": 20,
-    "test_multihost.py": 40,
+    # trimmed r07 against serial measurements (the round-6 note asked
+    # the next file to trim instead of raising the ceiling): these
+    # files' tier-1 portions are mostly version-gated skips/deselects —
+    # multihost 0.05s, pipeline_parallel 4.9s, ring_attention 6.3s,
+    # sharding 6.1s, properties 0.06s measured 2026-08-03
+    "test_multihost.py": 5,
     "test_ops.py": 10,
     "test_peft.py": 25,
-    "test_pipeline_parallel.py": 15,
+    "test_pipeline_parallel.py": 10,
     "test_pipelines.py": 10,
-    "test_properties.py": 15,
+    "test_properties.py": 5,
     "test_reference_harness.py": 10,
     "test_remat.py": 20,
     "test_resilient.py": 5,
-    "test_ring_attention.py": 20,
+    "test_ring_attention.py": 10,
     "test_scanned_epochs.py": 40,
     "test_seq2seq.py": 25,
-    "test_sharding.py": 30,
+    "test_sharding.py": 10,
     "test_summarize_eval.py": 5,
+    "test_supervisor.py": 15,
     "test_sweep.py": 15,
     "test_trainers.py": 15,
     "test_utils.py": 5,
@@ -62,9 +69,13 @@ TIER1_BUDGETS = {
 
 # ceiling: tier-1 runs under `timeout 870` (ROADMAP); budgets must fit
 # with scheduling headroom (raised 700 -> 780 for the decode-engine
-# suite in round 6 — measured 33s, budgeted 60; ~90s of headroom left
-# under the 870s timeout, so the NEXT file to land must trim budgets
-# or slow-mark instead of raising this again)
+# suite in round 6). Round 7 landed the experience-transport +
+# supervisor suites (measured ~54s + 8s serial, budgeted 70 + 15)
+# WITHOUT raising the ceiling, by trimming 80s of dead budget from the
+# version-gated files (see the in-table note) — the ceiling stays 780
+# with the same ~90s of headroom, and the trim playbook (measure the
+# biggest budgets serially, reclaim the skip-dominated ones) is the
+# template for the next landing too.
 TIER1_BUDGET_CEILING_S = 780
 
 # test files allowed to run full learn() loops in tier-1 WITHOUT a slow
@@ -72,6 +83,7 @@ TIER1_BUDGET_CEILING_S = 780
 # are tiny (documented tradeoff; everything else slow-marks them)
 LEARN_IN_TIER1_ALLOWLIST = {
     "test_elastic.py",          # resharded-resume / quarantine-fallback
+    "test_exp_queue.py",        # exp-vs-direct golden needs two tiny learns
     "test_fault_tolerance.py",  # kill/resume + chaos scenarios
     "test_guardrails.py",       # rollback/requeue under chaos
     "test_scanned_epochs.py",   # scanned-vs-looped golden equivalence

@@ -1,0 +1,180 @@
+"""Exit-class-aware run supervisor (scripts/supervise.py), demonstrated
+in REAL child processes: crash -> backoff restart, exit 87 (stalled) ->
+relaunch from the newest emergency snapshot via TRLX_TPU_RESUME_FROM,
+clean exit honored, flap limit -> give up with a machine-readable
+ledger entry. The children are plain python one-liners (no jax), so
+each attempt costs process startup only.
+
+Tier-1 budget: 15s (tests/test_marker_audit.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUPERVISE = os.path.join(REPO, "scripts", "supervise.py")
+
+# the supervised child: bumps a per-run attempt counter, records the
+# resume env it was launched with, exits with the scheduled code for
+# its attempt number (the last schedule entry repeats)
+CHILD = r"""
+import json, os, sys
+state_file, schedule = sys.argv[1], json.loads(sys.argv[2])
+n = int(open(state_file).read()) if os.path.exists(state_file) else 0
+open(state_file, "w").write(str(n + 1))
+with open(state_file + ".env", "a") as f:
+    f.write(json.dumps({
+        "attempt": n + 1,
+        "resume": os.environ.get("TRLX_TPU_RESUME_FROM"),
+    }) + "\n")
+sys.exit(schedule[min(n, len(schedule) - 1)])
+"""
+
+
+def run_supervisor(tmp_path, schedule, extra_args=()):
+    state = os.path.join(str(tmp_path), "attempts")
+    ledger = os.path.join(str(tmp_path), "ledger.jsonl")
+    cmd = [
+        sys.executable, SUPERVISE,
+        "--checkpoint-dir", str(tmp_path),
+        "--ledger", ledger,
+        "--backoff", "0.05", "--backoff-max", "0.2",
+        *extra_args,
+        "--",
+        sys.executable, "-c", CHILD, state, json.dumps(schedule),
+    ]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=120,
+    )
+    records = []
+    if os.path.exists(ledger):
+        with open(ledger) as f:
+            records = [json.loads(line) for line in f]
+    envs = []
+    if os.path.exists(state + ".env"):
+        with open(state + ".env") as f:
+            envs = [json.loads(line) for line in f]
+    return proc, records, envs
+
+
+def test_crash_backoff_restart_then_clean_exit(tmp_path):
+    # two rapid crashes, then a clean run: the supervisor restarts with
+    # doubling backoff (consecutive crashes, inside the flap window but
+    # under the flap limit) and honors the clean exit
+    proc, records, envs = run_supervisor(
+        tmp_path, [1, 1, 0],
+        extra_args=("--flap-window", "60", "--flap-limit", "5"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert [r["action"] for r in records] == ["restart", "restart", "done"]
+    assert [r["exit_class"] for r in records] == ["crash", "crash", "clean"]
+    assert records[0]["backoff_s"] == 0.05
+    assert records[1]["backoff_s"] == 0.1  # doubled
+    assert len(envs) == 3 and all(e["resume"] is None for e in envs)
+
+
+def test_backoff_resets_after_long_healthy_run(tmp_path):
+    # an exit AFTER the flap window resets both the flap streak and the
+    # crash backoff: an isolated crash days into a run must not pay
+    # backoff accumulated by unrelated failures at the run's start
+    proc, records, envs = run_supervisor(
+        tmp_path, [1, 1, 1, 0],
+        extra_args=("--flap-window", "0", "--flap-limit", "2",
+                    "--backoff", "0.05"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    # flap-window 0: every run counts as "long" — streak never builds
+    # and every restart uses the BASE backoff, never the doubled one
+    assert [r["action"] for r in records] == [
+        "restart", "restart", "restart", "done",
+    ]
+    assert all(
+        r["backoff_s"] == 0.05 for r in records if r["action"] == "restart"
+    )
+
+
+def test_stalled_exit_resumes_from_emergency_snapshot(tmp_path):
+    # a hang-doctor abort (exit 87): the next attempt must launch with
+    # TRLX_TPU_RESUME_FROM pointing at the NEWEST committed emergency
+    # snapshot (auto-discovery deliberately never picks one up)
+    for step, committed in ((3, True), (9, True), (12, False)):
+        snap = os.path.join(str(tmp_path), f"emergency_checkpoint_{step}")
+        os.makedirs(snap)
+        if committed:
+            with open(os.path.join(snap, "COMMIT"), "w") as f:
+                json.dump({"name": os.path.basename(snap),
+                           "emergency": True}, f)
+    proc, records, envs = run_supervisor(
+        tmp_path, [87, 0], extra_args=("--flap-window", "0"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    expected = os.path.join(str(tmp_path), "emergency_checkpoint_9")
+    assert [r["action"] for r in records] == ["resume_snapshot", "done"]
+    assert records[0]["exit_class"] == "stalled"
+    assert records[0]["snapshot"] == expected
+    assert records[0]["backoff_s"] == 0.0  # a stall restarts immediately
+    # the child of attempt 2 actually saw the override, and its ledger
+    # record names the snapshot it was launched from
+    assert envs[1]["resume"] == expected
+    assert records[1]["resume_from"] == expected
+
+
+def test_stale_emergency_snapshot_not_preferred_over_newer_commit(tmp_path):
+    # emergency snapshots are never reaped by retention: one left over
+    # from an old stall (step 4) must NOT beat a newer committed
+    # regular checkpoint (step 20) — resuming it would silently rewind
+    snap = os.path.join(str(tmp_path), "emergency_checkpoint_4")
+    os.makedirs(snap)
+    with open(os.path.join(snap, "COMMIT"), "w") as f:
+        json.dump({"name": "emergency_checkpoint_4", "emergency": True}, f)
+    ckpt = os.path.join(str(tmp_path), "checkpoint_20")
+    os.makedirs(ckpt)
+    with open(os.path.join(ckpt, "COMMIT"), "w") as f:
+        json.dump({"name": "checkpoint_20"}, f)
+    proc, records, envs = run_supervisor(
+        tmp_path, [87, 0], extra_args=("--flap-window", "0"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert records[0]["action"] == "restart"  # plain relaunch, auto-resume
+    assert records[0]["snapshot"] is None
+    assert envs[1]["resume"] is None
+
+
+def test_stalled_exit_without_snapshot_restarts_plain(tmp_path):
+    proc, records, envs = run_supervisor(
+        tmp_path, [87, 0], extra_args=("--flap-window", "0"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert records[0]["action"] == "restart"
+    assert records[0]["snapshot"] is None
+    assert envs[1]["resume"] is None  # plain relaunch -> auto-resume
+
+
+def test_flap_limit_gives_up_with_ledger_entry(tmp_path):
+    # a child that crashes instantly forever: after --flap-limit rapid
+    # failures the supervisor stops burning the allocation and says why
+    proc, records, envs = run_supervisor(
+        tmp_path, [1],
+        extra_args=("--flap-window", "60", "--flap-limit", "3",
+                    "--backoff", "0.01"),
+    )
+    assert proc.returncode == 1
+    assert [r["action"] for r in records] == [
+        "restart", "restart", "gave_up",
+    ]
+    assert "flap limit" in records[-1]["reason"]
+    assert len(envs) == 3  # exactly flap_limit attempts ran
+
+
+def test_restart_budget_gives_up(tmp_path):
+    proc, records, envs = run_supervisor(
+        tmp_path, [1],
+        extra_args=("--flap-window", "0", "--max-restarts", "2",
+                    "--backoff", "0.01"),
+    )
+    assert proc.returncode == 1
+    assert records[-1]["action"] == "gave_up"
+    assert "restart budget" in records[-1]["reason"]
+    assert len(envs) == 3  # initial attempt + 2 restarts

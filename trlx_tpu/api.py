@@ -116,7 +116,22 @@ def train(
     )
     trainer.add_eval_pipeline(eval_pipeline)
 
+    import os
+
     resume = config.train.resume_from_checkpoint
+    env_resume = os.environ.get("TRLX_TPU_RESUME_FROM")
+    if env_resume:
+        # the run supervisor's relaunch channel (scripts/supervise.py):
+        # after a stalled exit (class 87) it points the next attempt at
+        # the hang doctor's emergency snapshot — which auto-discovery
+        # deliberately never picks up — without editing the config the
+        # operator wrote
+        logger.warning(
+            "TRLX_TPU_RESUME_FROM=%s overrides "
+            "train.resume_from_checkpoint=%r for this launch",
+            env_resume, resume,
+        )
+        resume = env_resume
     if resume == "auto":
         from trlx_tpu.parallel import multihost as mh
         from trlx_tpu.utils.checkpointing import CheckpointCorruptError

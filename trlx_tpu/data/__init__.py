@@ -46,6 +46,12 @@ class PPORolloutBatch:
     values: jnp.ndarray  # [batch, resp_len] f32
     rewards: jnp.ndarray  # [batch, resp_len] f32 (KL penalty + terminal score)
     response_mask: jnp.ndarray  # [batch, resp_len] f32 (1 = real response token)
+    # experience-transport staleness correction (exp.staleness.mode:
+    # clip): per-token clipped importance weight applied to the PPO
+    # surrogate (ops/ppo.py is_weight). None outside clip mode — a
+    # pytree-empty leaf, so every existing path (store concat, device
+    # gathers, fused-scan perms) is untouched when the feature is off.
+    is_weight: Optional[jnp.ndarray] = None  # [batch, resp_len] f32
 
 
 @flax.struct.dataclass

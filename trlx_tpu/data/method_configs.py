@@ -113,6 +113,22 @@ class PPOConfig(MethodConfig):
     # the preemption/rewind cursors unchanged: the engine sits behind
     # the same per-chunk generate() seam both already drive.
     gen_engine: dict = field(default_factory=dict)
+    # Resilient experience transport (trlx_tpu/exp/): route rollout
+    # chunks through a durable queue with at-least-once delivery —
+    # lease-based production (an expired lease re-dispatches the chunk
+    # to a live producer), consumer-side dedup, back-pressure past
+    # exp.max_depth, a persisted consumer cursor (state.json, inside
+    # the atomic checkpoint) and a staleness admission gate
+    # (exp.staleness.mode: reject|clip, default reject at staleness>1;
+    # clip threads IMPACT-style per-token importance weights into the
+    # surrogate). Parsed by exp.queue.ExpConfig (enabled/max_depth/
+    # lease_ttl_s/offer_timeout_s/wait_poll_s/staleness). Default {} =
+    # disabled; enabled and fault-free it is golden-checked bit-equal
+    # (losses + consumed prompt order) to the direct rollout path.
+    # This is the substrate for the disaggregated actor-learner split
+    # (ROADMAP item 1): remote producers plug in behind the same
+    # transport the in-process loop chaos-proves.
+    exp: dict = field(default_factory=dict)
 
     def get_advantages_and_returns(self, values, rewards, response_length, use_whitening=True):
         from trlx_tpu.ops.ppo import gae_advantages_and_returns

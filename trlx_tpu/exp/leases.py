@@ -1,0 +1,170 @@
+"""Per-chunk production leases with watchdog-style heartbeats.
+
+At-least-once delivery needs an answer to "the producer died mid-chunk":
+a producer takes a LEASE on a chunk seq before generating it and
+heartbeats the lease at production milestones (after generation, after
+scoring). A lease whose heartbeat goes silent past ``ttl_s`` — worker
+death, a wedged sampler — is EXPIRED: the table reclaims it and the
+chunk is re-dispatched to a live producer, which regenerates it
+deterministically from the group-invariant prompt stream (the lease
+carries the producer-state snapshot needed for a bit-identical replay
+in-process; a remote producer would re-pull from the stream position
+instead).
+
+Host-side only, injectable clock (tier-1 tests drive expiry on a fake
+clock), no threads — expiry is evaluated by whoever calls
+:meth:`expired`, which in the in-process integration is the consumer
+loop's bounded wait.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+ChunkId = Tuple[int, int]
+
+
+@dataclass
+class Lease:
+    """One outstanding production claim.
+
+    ``meta`` carries whatever the producer needs to REPLAY the chunk on
+    re-dispatch (in-process PPO: the RNG/running-moments snapshot and
+    the pulled prompt batch; cross-process: the prompt-stream
+    position). ``attempt`` counts dispatches of this chunk — 1 on first
+    acquire, +1 per reclaim."""
+
+    chunk_id: ChunkId
+    owner: str
+    acquired_at: float
+    last_beat: float
+    attempt: int = 1
+    meta: Dict[str, Any] = field(default_factory=dict)
+    dead: bool = False  # producer announced death (chaos) — stop beating
+
+    def age(self, now: float) -> float:
+        return now - self.last_beat
+
+
+class LeaseTable:
+    """Outstanding leases keyed by chunk id, with TTL-based expiry."""
+
+    def __init__(
+        self,
+        ttl_s: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if ttl_s <= 0:
+            raise ValueError("lease ttl_s must be > 0")
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self._leases: Dict[ChunkId, Lease] = {}
+        self.stats: Dict[str, int] = {
+            "acquired": 0,
+            "released": 0,
+            "expired": 0,
+            "reclaimed": 0,
+            "heartbeats": 0,
+        }
+
+    def acquire(
+        self,
+        chunk_id: ChunkId,
+        owner: str,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> Lease:
+        """Claim production of ``chunk_id``. Re-acquiring an id whose
+        lease is still live is an error (two producers must never build
+        the same chunk concurrently — re-dispatch goes through
+        :meth:`reclaim`)."""
+        existing = self._leases.get(chunk_id)
+        if existing is not None:
+            raise ValueError(
+                f"chunk {chunk_id} is already leased to "
+                f"{existing.owner!r} (attempt {existing.attempt}); "
+                "reclaim the expired lease instead of re-acquiring"
+            )
+        now = self._clock()
+        lease = Lease(
+            chunk_id=chunk_id, owner=owner, acquired_at=now, last_beat=now,
+            meta=dict(meta or {}),
+        )
+        self._leases[chunk_id] = lease
+        self.stats["acquired"] += 1
+        return lease
+
+    def heartbeat(self, chunk_id: ChunkId) -> None:
+        """Producer liveness: refresh the lease's silent-age clock. A
+        dead (chaos-killed) producer's beats are ignored — that is the
+        death, as far as the table can observe it."""
+        lease = self._leases.get(chunk_id)
+        if lease is None or lease.dead:
+            return
+        lease.last_beat = self._clock()
+        self.stats["heartbeats"] += 1
+
+    def release(self, chunk_id: ChunkId) -> None:
+        """Production finished (the chunk was delivered): drop the lease."""
+        if self._leases.pop(chunk_id, None) is not None:
+            self.stats["released"] += 1
+
+    def mark_dead(self, chunk_id: ChunkId) -> None:
+        """The producer died mid-lease (chaos ``worker_death_mid_lease``
+        simulates it): heartbeats stop; the lease expires on TTL like a
+        real worker death would."""
+        lease = self._leases.get(chunk_id)
+        if lease is not None:
+            lease.dead = True
+
+    def expired(self) -> List[Lease]:
+        """Leases whose heartbeat is older than ``ttl_s`` — candidates
+        for reclaim + re-dispatch. Does not mutate the table."""
+        now = self._clock()
+        return [
+            lease for lease in self._leases.values()
+            if lease.age(now) > self.ttl_s
+        ]
+
+    def reclaim(self, chunk_id: ChunkId, new_owner: str) -> Lease:
+        """Take over an EXPIRED lease for re-dispatch: same chunk id and
+        replay meta, attempt incremented, fresh heartbeat clock."""
+        old = self._leases.get(chunk_id)
+        if old is None:
+            raise KeyError(f"no lease to reclaim for chunk {chunk_id}")
+        now = self._clock()
+        if old.age(now) <= self.ttl_s and not old.dead:
+            raise ValueError(
+                f"lease for chunk {chunk_id} is still live "
+                f"(age {old.age(now):.3f}s <= ttl {self.ttl_s}s)"
+            )
+        self.stats["expired"] += 1
+        self.stats["reclaimed"] += 1
+        fresh = Lease(
+            chunk_id=chunk_id, owner=new_owner, acquired_at=now,
+            last_beat=now, attempt=old.attempt + 1, meta=old.meta,
+        )
+        self._leases[chunk_id] = fresh
+        logger.warning(
+            "exp lease: chunk %s lease expired on %r (attempt %d) — "
+            "re-dispatched to %r (attempt %d)", chunk_id, old.owner,
+            old.attempt, new_owner, fresh.attempt,
+        )
+        return fresh
+
+    def get(self, chunk_id: ChunkId) -> Optional[Lease]:
+        return self._leases.get(chunk_id)
+
+    def drop_all(self) -> None:
+        """Epoch abort (guardrail requeue/rollback): every in-flight
+        production is void — its prompts replay under the new epoch."""
+        self._leases.clear()
+
+    @property
+    def outstanding(self) -> int:
+        return len(self._leases)

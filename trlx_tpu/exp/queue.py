@@ -1,0 +1,302 @@
+"""Sharded experience queue: ordered, deduplicating, bounded.
+
+The queue is the delivery half of the experience transport
+(``trlx_tpu/exp/__init__.py``): producers ``offer`` finished chunks,
+the consumer ``poll``s them back **in chunk-sequence order** and
+advances a **committed cursor** once a chunk has actually been pushed
+to the rollout store. The semantics are chosen so at-least-once
+delivery composes with exactly-once consumption:
+
+- every chunk carries a ``(epoch, chunk_seq)`` id, monotonically
+  increasing within an epoch (the epoch bumps when a guardrail
+  requeue/rollback rebuilds the data stream — in-flight chunks from the
+  old generation can then never be confused with replayed ones);
+- a redelivered id — one at-or-below the committed cursor, or one
+  already buffered — is dropped as a duplicate (consumer-side dedup);
+- out-of-order arrivals are buffered until the gap fills; ``poll`` only
+  ever hands out ``cursor + 1``, so the consumed sequence is invariant
+  to delivery interleaving (the property tests/test_exp_queue.py
+  fuzzes);
+- ``offer`` reports ``"full"`` once ``max_depth`` unconsumed chunks are
+  buffered — the producer-side back-pressure signal (the learner lags);
+  the transport turns it into a bounded, watchdog-beating wait.
+
+The committed cursor is what the trainer persists in ``state.json``
+(inside the atomic checkpoint commit + integrity manifest), so a
+resume/rollback replays exactly the unconsumed chunks: the PR 4
+group-invariant prompt stream regenerates any lost-in-flight chunk
+deterministically from its stream position.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+STALENESS_MODES = ("reject", "clip")
+
+# offer() outcomes (strings so transport stats/tests read plainly)
+OFFER_ACCEPTED = "accepted"
+OFFER_DUPLICATE = "duplicate"
+OFFER_FULL = "full"
+OFFER_STALE_EPOCH = "stale_epoch"
+
+
+@dataclass(frozen=True)
+class StalenessConfig:
+    """Parsed ``ppo.exp.staleness`` section.
+
+    mode           ``reject``: drop a chunk older than ``max_staleness``
+                   policy versions (it is re-dispatched and regenerated
+                   with the current policy); ``clip``: admit it with
+                   IMPACT-style clipped importance weights threaded into
+                   the PPO surrogate as a per-token correction factor
+                   (arXiv:1912.00167).
+    max_staleness  versions-at-consumption minus version-at-generation a
+                   chunk may carry before the gate acts. The default 1
+                   admits the ``overlap_rollouts`` prefetch (one update
+                   stale by construction) untouched.
+    clip_c         symmetric clip range for the importance correction in
+                   ``clip`` mode: weights land in [1-clip_c, 1+clip_c].
+    """
+
+    mode: str = "reject"
+    max_staleness: int = 1
+    clip_c: float = 0.3
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "StalenessConfig":
+        d = dict(d or {})
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"exp.staleness: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        cfg = cls(**d)
+        if cfg.mode not in STALENESS_MODES:
+            raise ValueError(
+                f"exp.staleness.mode must be one of {STALENESS_MODES}, "
+                f"got {cfg.mode!r}"
+            )
+        if cfg.max_staleness < 0:
+            raise ValueError("exp.staleness.max_staleness must be >= 0")
+        return cfg
+
+
+@dataclass(frozen=True)
+class ExpConfig:
+    """Parsed ``ppo.exp`` section (plain dict in YAML).
+
+    enabled          master switch (default off: the rollout loop keeps
+                     the direct path; on, and fault-free, the transport
+                     path is golden-checked bit-equal to it).
+    max_depth        unconsumed chunks the queue buffers before
+                     ``offer`` reports back-pressure and producers
+                     block/shed.
+    lease_ttl_s      seconds a production lease may go without a
+                     heartbeat before it is considered dead and its
+                     chunk re-dispatched to a live producer.
+    offer_timeout_s  bound on one back-pressure wait before the
+                     producer gives up the attempt (the wait itself
+                     heartbeats the ``exp_wait`` watchdog phase); 0
+                     waits indefinitely — the watchdog deadline is then
+                     the backstop.
+    wait_poll_s      poll cadence (and beat cadence) of the bounded
+                     waits: back-pressure and lease-expiry.
+    staleness        :class:`StalenessConfig` (``mode``/
+                     ``max_staleness``/``clip_c``).
+    """
+
+    enabled: bool = False
+    max_depth: int = 4
+    lease_ttl_s: float = 30.0
+    offer_timeout_s: float = 600.0
+    wait_poll_s: float = 0.05
+    staleness: StalenessConfig = field(default_factory=StalenessConfig)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ExpConfig":
+        d = dict(d or {})
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"ppo.exp: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        if "staleness" in d:
+            d["staleness"] = StalenessConfig.from_dict(d["staleness"])
+        cfg = cls(**d)
+        if cfg.max_depth < 1:
+            raise ValueError("exp.max_depth must be >= 1")
+        if cfg.lease_ttl_s <= 0:
+            raise ValueError("exp.lease_ttl_s must be > 0")
+        return cfg
+
+
+@dataclass
+class ExperienceChunk:
+    """One unit of delivered experience.
+
+    chunk_id        ``(epoch, chunk_seq)``: epoch = data-stream
+                    generation (bumped on guardrail requeue/rollback),
+                    seq = monotonically increasing chunk index within
+                    the epoch — for PPO, the prompt-stream chunk
+                    position, so a lost chunk regenerates from the
+                    group-invariant stream.
+    policy_version  optimizer cycles applied when the chunk's samples
+                    were GENERATED; the admission gate compares it
+                    against the version at consumption (staleness
+                    metadata).
+    payload         the finished experience (PPO: the assembled
+                    PPORolloutBatch) — opaque to the queue.
+    meta            host-side stats riding along (chunk stats dict,
+                    row counts).
+    """
+
+    chunk_id: Tuple[int, int]
+    policy_version: int
+    payload: Any = None
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def epoch(self) -> int:
+        return self.chunk_id[0]
+
+    @property
+    def seq(self) -> int:
+        return self.chunk_id[1]
+
+
+class ExperienceQueue:
+    """Bounded, ordered, deduplicating chunk buffer (host-side only).
+
+    The consumer cursor counts COMMITTED chunks of the current epoch:
+    ``poll`` hands out seq ``cursor + 1`` when buffered, and
+    :meth:`commit` advances the cursor once the chunk's payload reached
+    the store. ``offer`` never blocks — the bounded wait (with watchdog
+    beats) is the transport's job, so this class stays fake-clock-free
+    and exhaustively testable."""
+
+    def __init__(self, max_depth: int):
+        if max_depth < 1:
+            raise ValueError("max_depth must be >= 1")
+        self.max_depth = int(max_depth)
+        self.epoch = 0
+        self._cursor = 0  # highest committed seq of the current epoch
+        self._buffered: Dict[int, ExperienceChunk] = {}
+        self.stats: Dict[str, int] = {
+            "accepted": 0,
+            "duplicates": 0,
+            "full_rejections": 0,
+            "stale_epoch_drops": 0,
+            "committed": 0,
+        }
+
+    # -- producer side ---------------------------------------------------
+
+    def offer(self, chunk: ExperienceChunk) -> str:
+        """Deliver a chunk. Returns one of ``accepted`` / ``duplicate``
+        (consumer-side dedup: at-or-below the cursor, or already
+        buffered) / ``full`` (back-pressure: ``max_depth`` unconsumed
+        chunks pending) / ``stale_epoch`` (the data stream was rebuilt
+        under this chunk — its prompts will be replayed under the new
+        epoch, so the old delivery must not train)."""
+        if chunk.epoch != self.epoch:
+            self.stats["stale_epoch_drops"] += 1
+            logger.warning(
+                "exp queue: dropping chunk %s from epoch %d (current "
+                "epoch %d — the data stream was rebuilt under it)",
+                chunk.chunk_id, chunk.epoch, self.epoch,
+            )
+            return OFFER_STALE_EPOCH
+        if chunk.seq <= self._cursor or chunk.seq in self._buffered:
+            self.stats["duplicates"] += 1
+            logger.info(
+                "exp queue: dropping duplicate delivery of chunk %s "
+                "(cursor %d)", chunk.chunk_id, self._cursor,
+            )
+            return OFFER_DUPLICATE
+        if len(self._buffered) >= self.max_depth:
+            self.stats["full_rejections"] += 1
+            return OFFER_FULL
+        self._buffered[chunk.seq] = chunk
+        self.stats["accepted"] += 1
+        return OFFER_ACCEPTED
+
+    # -- consumer side ---------------------------------------------------
+
+    def poll(self) -> Optional[ExperienceChunk]:
+        """The next in-order chunk (seq ``cursor + 1``), or None when it
+        has not been delivered yet. Does NOT advance the cursor — call
+        :meth:`commit` after the payload reached the store, so a crash
+        between poll and push replays the chunk instead of losing it."""
+        return self._buffered.get(self._cursor + 1)
+
+    def commit(self, chunk: ExperienceChunk) -> None:
+        """Mark ``chunk`` consumed: advance the committed cursor and
+        drop the buffer entry. Must be the chunk :meth:`poll` returned
+        (in-order consumption is the queue's contract)."""
+        if chunk.seq != self._cursor + 1:
+            raise ValueError(
+                f"out-of-order commit: chunk seq {chunk.seq} but cursor "
+                f"is {self._cursor} (expected {self._cursor + 1})"
+            )
+        self._buffered.pop(chunk.seq, None)
+        self._cursor = chunk.seq
+        self.stats["committed"] += 1
+
+    def discard(self, chunk: ExperienceChunk) -> None:
+        """Drop a buffered chunk WITHOUT advancing the cursor (staleness
+        rejection: the seq will be re-dispatched and redelivered)."""
+        self._buffered.pop(chunk.seq, None)
+
+    # -- state -----------------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        return len(self._buffered)
+
+    @property
+    def cursor(self) -> int:
+        return self._cursor
+
+    def next_undelivered(self) -> int:
+        """Smallest seq > cursor not currently buffered — the next gap
+        an in-order consumer is waiting on."""
+        seq = self._cursor + 1
+        while seq in self._buffered:
+            seq += 1
+        return seq
+
+    def advance_epoch(self) -> int:
+        """Invalidate every in-flight chunk: bump the epoch, clear the
+        buffer, reset the cursor (the rebuilt data stream replays from
+        its own position; seqs restart with it)."""
+        self.epoch += 1
+        self._buffered.clear()
+        self._cursor = 0
+        return self.epoch
+
+    def load_cursor(self, epoch: int, cursor: int) -> None:
+        """Resume: restore the committed consumer position (the buffer
+        is empty by construction — in-flight chunks never persist; the
+        prompt stream regenerates them)."""
+        self.epoch = int(epoch)
+        self._cursor = int(cursor)
+        self._buffered.clear()
+
+    def state_summary(self) -> Dict[str, Any]:
+        return {
+            "epoch": self.epoch,
+            "cursor": self._cursor,
+            "depth": self.depth,
+            **self.stats,
+        }

@@ -61,9 +61,20 @@ def ppo_loss(
     cliprange: float,
     cliprange_value: float,
     vf_coef: float,
+    is_weight: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Clipped-ratio policy loss + clipped value loss, masked over real
-    response tokens. All shapes [batch, response_len]."""
+    response tokens. All shapes [batch, response_len].
+
+    ``is_weight`` is the experience transport's staleness correction
+    (``exp.staleness.mode: clip``): a per-token CLIPPED importance
+    weight rho = clip(pi_proximal/pi_behavior, 1±c) computed at chunk
+    admission (IMPACT, arXiv:1912.00167 — ``old_logprobs`` are then the
+    proximal recompute, and the behavior mismatch rides this factor).
+    It multiplies only the policy surrogate; stop-gradiented, so it
+    scales each token's objective without entering the ratio's
+    gradient. None (the default and every fresh chunk) is exactly
+    weight 1."""
     mask = mask.astype(jnp.float32)
     n = jnp.maximum(mask.sum(), 1e-8)
 
@@ -80,8 +91,11 @@ def ppo_loss(
     # k3 estimator, http://joschu.net/blog/kl-approx.html
     approx_kl = jax.lax.stop_gradient(jnp.mean((ratio - 1) - log_ratio))
 
-    pg_loss1 = -advantages * ratio
-    pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange)
+    w = 1.0 if is_weight is None else jax.lax.stop_gradient(
+        is_weight.astype(jnp.float32)
+    )
+    pg_loss1 = -advantages * ratio * w
+    pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange) * w
     pg_loss = (jnp.maximum(pg_loss1, pg_loss2) * mask).sum() / n
     pg_clipfrac = ((pg_loss2 > pg_loss1).astype(jnp.float32) * mask).sum() / n
 

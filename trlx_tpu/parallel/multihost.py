@@ -356,6 +356,23 @@ def consensus(values, atol: float = 0.0) -> ConsensusResult:
     return ConsensusResult(agree, reference, detail)
 
 
+def cursor_consensus(
+    name: str, epoch: int, cursor: int
+) -> ConsensusResult:
+    """Agreement check for a (epoch, cursor) position of a shared
+    stream — the experience transport's consumer cursor foremost: every
+    host must have committed exactly the same chunks, or the fleet is
+    silently training different data. Runs on the :func:`consensus`
+    gather (exact compare; positions are integers in lockstep control
+    flow, so any tolerance would paper over a real divergence). The
+    trainer calls this at the guardrails consistency cadence and routes
+    disagreement onto the escalation ladder."""
+    return consensus(
+        {f"{name}_epoch": float(epoch), f"{name}_cursor": float(cursor)},
+        atol=0.0,
+    )
+
+
 def any_flag(value: bool) -> bool:
     """True on every process iff ANY process passed True. The preemption
     path needs this rather than `broadcast_flag`: a SIGTERM lands on

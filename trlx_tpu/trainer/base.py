@@ -255,6 +255,14 @@ class TPUBaseTrainer(BaseRLTrainer):
         # cross-host consistency watchdog (guardrails.consistency_every)
         self._fingerprint_fn = None  # jitted replicated state reduction
         self._consistency_counter = 0
+        # policy version: optimizer CYCLES applied to the params (one
+        # fused block, or one inner epoch of the per-step loop). This is
+        # the experience transport's staleness unit — every chunk
+        # records the version its samples were generated at, and the
+        # admission gate compares it against the version at consumption
+        # (the overlap_rollouts prefetch is exactly 1 stale by
+        # construction).
+        self._policy_version = 0
 
     # ------------------------------------------------------------------
     # model setup
@@ -1393,6 +1401,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         # consumed at the next flush point (no blocking fetch here)
         prev = self.iter_count
         self.iter_count += n_steps
+        self._policy_version += 1  # one fused block = one staleness unit
         staged = {"__mean_loss__": loss}
         staged.update(
             {k: stats[k] for k in stats if np.ndim(stats[k]) == 0}
@@ -2035,6 +2044,15 @@ class TPUBaseTrainer(BaseRLTrainer):
                 f"cross-host state fingerprint diverged at step "
                 f"{self.iter_count}: {detail or 'rows disagree'}",
             )
+        # trainer-specific lockstep assertions at the same cadence (PPO:
+        # the experience-transport consumer cursor via
+        # multihost.cursor_consensus)
+        self._extra_consistency_checks()
+
+    def _extra_consistency_checks(self) -> None:
+        """Subclass hook, run at the consistency-check cadence after the
+        fingerprint consensus: extra cross-host agreement assertions
+        whose disagreement should trip the ladder."""
 
     def _requeue_poisoned_batch(self) -> bool:
         """Hook: discard the current (poisoned) training batch and
@@ -2394,6 +2412,11 @@ class TPUBaseTrainer(BaseRLTrainer):
                 if guard_break:
                     break
                 self.post_backward_callback()
+            # per-step loop: one completed optimization cycle = one
+            # staleness unit (the fused path counts one per block — both
+            # count one version per pass over the cycle's data)
+            if not guard_break:
+                self._policy_version += 1
             self.post_epoch_callback()
         # epoch exhaustion can end BELOW total_steps (a NaN-skipped step
         # consumes its batch without advancing iter_count, and small

@@ -30,6 +30,9 @@ import numpy as np
 
 from trlx_tpu.data import PPORolloutBatch, PromptBatch
 from trlx_tpu.data.method_configs import PPOConfig
+from trlx_tpu.exp import ExpConfig, ExperienceTransport
+from trlx_tpu.exp import transport as exp_transport
+from trlx_tpu.utils.guardrails import STALENESS_SIGNAL
 from trlx_tpu.models.wrappers import CausalLMWithValueHead, Seq2SeqLMWithValueHead
 from trlx_tpu.ops.common import (
     chunked_logprobs,
@@ -174,6 +177,27 @@ class TPUPPOTrainer(TPUBaseTrainer):
         if self.log_rollouts:
             self.setup_rollout_logging(config)
         self._experience_fns: Dict[Tuple, Any] = {}
+        # resilient experience transport (ppo.exp.*, trlx_tpu/exp/):
+        # rollout chunks travel through a leased, deduplicating queue
+        # with a staleness admission gate; default off = the direct
+        # rollout loop, and fault-free the transport path is golden-
+        # checked bit-equal to it (tests/test_exp_queue.py)
+        self._exp_cfg = ExpConfig.from_dict(getattr(config.method, "exp", None))
+        self._exp: Optional[ExperienceTransport] = None
+        if self._exp_cfg.enabled:
+            if self.seq2seq and self._exp_cfg.staleness.mode == "clip":
+                raise NotImplementedError(
+                    "exp.staleness.mode='clip' needs the causal "
+                    "experience forward for the proximal recompute; "
+                    "use mode='reject' with seq2seq models"
+                )
+            self._exp = ExperienceTransport(
+                self._exp_cfg, owner=f"proc{mh.process_index()}"
+            )
+        # policy version the in-flight overlap_rollouts prefetch was
+        # generated at (the chunk is consumed one optimizer cycle later,
+        # so its recorded version must be the generation-time one)
+        self._prefetch_policy_version = 0
 
     # -- model -----------------------------------------------------------
 
@@ -280,6 +304,7 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 cliprange=method.cliprange,
                 cliprange_value=method.cliprange_value,
                 vf_coef=method.vf_coef,
+                is_weight=batch.is_weight,
             )
         P = batch.query_tensors.shape[1]
         N = batch.response_tensors.shape[1]
@@ -316,6 +341,9 @@ class TPUPPOTrainer(TPUBaseTrainer):
             cliprange=method.cliprange,
             cliprange_value=method.cliprange_value,
             vf_coef=method.vf_coef,
+            # experience-transport staleness correction (exp.staleness.
+            # mode: clip); None on every other path = weight 1
+            is_weight=batch.is_weight,
         )
 
     # -- rollout engine --------------------------------------------------
@@ -493,6 +521,8 @@ class TPUPPOTrainer(TPUBaseTrainer):
             self._make_experience(num_rollouts, iter_count)
 
     def _make_experience(self, num_rollouts: int, iter_count: int) -> None:
+        if self._exp is not None:
+            return self._make_experience_exp(num_rollouts, iter_count)
         logger.info("Collecting rollouts")
         self._rollout_abandoned = False
         # snapshot the prompt cursor: an abandoned (preempted) rollout
@@ -513,7 +543,6 @@ class TPUPPOTrainer(TPUBaseTrainer):
         clock = Clock()
         n_collected = 0
         accumulated_stats: List[Dict[str, float]] = []
-        method = self.config.method
 
         pbar = logging.progress(total=num_rollouts, desc="rollouts")
         # one-chunk lookahead: generation for chunk i+1 is DISPATCHED
@@ -573,350 +602,15 @@ class TPUPPOTrainer(TPUBaseTrainer):
             else:
                 next_batch, next_gen = None, None
 
-            prompt_tensors = np.asarray(batch.input_ids)
-            seq_w = gen_out["sequences"].shape[1]
-            N = gen_out["response_ids"].shape[1]
-            P_width = prompt_tensors.shape[1]
-            # a ragged multi-host chunk comes back PADDED per data group
-            # with real_rows marking the group's real count — all row
-            # bookkeeping below runs on real rows; the pad rows only
-            # exist inside device arrays until the local slice
-            real_local = gen_out.get("real_rows")
-            B_local = (
-                real_local
-                if real_local is not None
-                else gen_out["sequences"].shape[0] // mh.data_group_count(self.mesh)
-            )
-
-            # ONE packed device->host transfer for the three generation
-            # outputs (a remote-tunneled chip pays ~100ms latency PER
-            # transfer). The concatenate is enqueued FIRST — devices run
-            # FIFO, so the DMA starts as soon as generation finishes and
-            # streams while the experience forward below computes
-            packed_dev = mh.local_rows(
-                jnp.concatenate(
-                    [
-                        gen_out["sequences"],
-                        gen_out["response_ids"],
-                        gen_out["response_mask"].astype(gen_out["sequences"].dtype),
-                    ],
-                    axis=1,
-                )
-            )
-            try:
-                packed_dev.copy_to_host_async()
-            except Exception:
-                pass
-
-            # fast path: the score-INDEPENDENT half of the experience step
-            # (policy/ref/value forward + KL penalty — the heaviest rollout
-            # compute) is dispatched NOW, on the device tensors the sampler
-            # just produced. It executes while the host decodes and scores
-            # the samples; the tiny score-injection jit below completes the
-            # rollout batch once reward_fn returns. Falls back to the
-            # fused experience fn when host-side token rewrites (stop
-            # sequences, seq2seq) or pad rows are needed.
-            device_gen = (
-                not self.seq2seq
-                and not self.stop_sequences
-                and B_local % self.local_ways() == 0
-                # a padded multihost chunk (real_rows set — including the
-                # divisible-but-widened case, where generate() padded up
-                # to an already-compiled wider shape) must take the
-                # host-scored path: the device fast path would build
-                # pre_batch over the pad rows and mismatch the real-row
-                # scores at injection
-                and real_local is None
-            )
-            pre_batch = pre_kl_stats = None
-            if device_gen:
-                with self.mesh:
-                    fwd_fn = self._get_experience_fwd_fn(P_width, N)
-                    pre_batch, pre_kl_stats = fwd_fn(
-                        self.params,
-                        self.ref_params,
-                        gen_out["sequences"].astype(jnp.int32),
-                        jnp.concatenate(
-                            [
-                                gen_out["prompt_mask"].astype(jnp.int32),
-                                gen_out["response_mask"].astype(jnp.int32),
-                            ],
-                            axis=1,
-                        ),
-                        gen_out["response_mask"].astype(jnp.int32),
-                        jnp.float32(self.kl_ctl.value),
-                        # device_gen only runs on unpadded batches: every
-                        # row is valid
-                        jnp.ones((gen_out["sequences"].shape[0],), jnp.float32),
-                    )
-
-            packed = packed_dev[:B_local]  # drop per-group pad rows
-            sequences = packed[:, :seq_w]
-            response_ids = packed[:, seq_w : seq_w + N]
-            response_mask = packed[:, seq_w + N :]
-            P = prompt_tensors.shape[1]
-
-            prompt_sizes = [P] * len(sequences)
-            str_samples, str_prompts, str_outputs = self.decode(
-                prompt_tensors, sequences, prompt_sizes, append_eos_token=True
-            )
-
-            rollout_score_time = time()
-            all_scores = self._call_reward_fn(
-                samples=str_samples,
-                prompts=str_prompts,
-                outputs=str_outputs,
-                tokenizer=self.tokenizer,
-                **(batch.metadata or {}),
-            )
-            stats["time/rollout_score"] = time() - rollout_score_time
-
-            scores_list = [np.atleast_1d(np.asarray(s, np.float32)) for s in all_scores]
-            S = max(len(s) for s in scores_list)
-            scores = np.zeros((len(scores_list), S), np.float32)
-            scores_mask = np.zeros((len(scores_list), S), np.float32)
-            for i, s in enumerate(scores_list):
-                scores[i, : len(s)] = s
-                scores_mask[i, : len(s)] = 1.0
-
-            if self.stop_sequences:
-                # stop-sequence trimming changed the outputs: rebuild the
-                # response tokens from the trimmed strings (the reference
-                # re-tokenizes unconditionally, :345-365 — lossy for some
-                # tokenizers, so here only when actually needed)
-                outputs = self.tokenizer(str_outputs, add_special_tokens=False)["input_ids"]
-                response_ids = np.full((len(outputs), N), self.generate_settings.pad_token_id, np.int32)
-                response_mask = np.zeros((len(outputs), N), np.int32)
-                for i, o in enumerate(outputs):
-                    o = o[:N]
-                    response_ids[i, : len(o)] = o
-                    response_mask[i, : len(o)] = 1
-                if self.seq2seq:
-                    start = sequences[:, :1]  # decoder start token column
-                    sequences = np.concatenate([start, response_ids], axis=1)
-                else:
-                    sequences = np.concatenate([prompt_tensors, response_ids], axis=1)
-
-            if method.cliprange_reward:
-                scores = np.clip(scores, -method.cliprange_reward, method.cliprange_reward)
-
-            # local per-row sums -> one GLOBAL vector; the running-moment
-            # update then reduces over every host's rows in-graph (the
-            # reference all-gathers scores to rank 0 instead). A short
-            # final chunk (prompt dataset smaller than chunk_size) may not
-            # divide dp*fsdp — keep the tiny vector replicated then
-            # (padding would bias the running reward moments). Multi-host
-            # replication of per-group-DIFFERENT rows needs a host-side
-            # allgather first, so every process places the same full
-            # vector (parity: the reference pads across processes,
-            # accelerate_ppo_trainer.py:292-300).
-            local_sums = (scores * scores_mask).sum(axis=1)
-            rows = len(local_sums) * mh.data_group_count(self.mesh)
-            if rows % self.data_ways() == 0:
-                score_sums = mh.global_from_local(
-                    local_sums, vector_sharding(self.mesh)
-                )
-            elif mh.is_multihost():
-                score_sums = jax.device_put(
-                    np.asarray(
-                        mh.allgather_group_rows(
-                            local_sums.astype(np.float32), self.mesh
-                        ),
-                        np.float32,
-                    ),
-                    replicated_sharding(self.mesh),
-                )
-            else:
-                score_sums = mh.global_from_local(
-                    local_sums, replicated_sharding(self.mesh)
-                )
-            if self.ref_mean is None:
-                self.ref_mean = float(score_sums.mean())
-                self.ref_std = float(score_sums.std())
-            new_moments, scores_mean, scores_std = running_moments_update(
-                self.running_moments, score_sums
-            )
-            # a NaN-poisoned chunk must not permanently poison the
-            # running reward moments (they scale every later reward and
-            # persist across checkpoints): keep the pre-chunk moments
-            # when the chunk's sums are non-finite. The chunk's OWN
-            # stats still report the poison, so the guardrails see it.
-            keep = jnp.all(jnp.isfinite(score_sums))
-            self.running_moments = jax.tree_util.tree_map(
-                lambda n, o: jnp.where(keep, n, o),
-                new_moments, self.running_moments,
-            )
-            # stats stay DEVICE scalars until the single packed fetch at
-            # the end of make_experience (each host read costs a full
-            # round-trip on a remote-tunneled chip)
-            stats["rollout_scores/mean"] = scores_mean
-            stats["rollout_scores/std"] = scores_std
-            stats["rollout_scores/running_mean"] = self.running_moments.mean
-            stats["rollout_scores/running_std"] = self.running_moments.std
-
-            # reward scaling happens inside the experience fn: pass the
-            # divisor as a device scalar instead of fetching the running
-            # std to the host
-            if method.scale_reward == "running":
-                scale_div = self.running_moments.std
-            elif method.scale_reward == "ref":
-                scale_div = jnp.float32(max(self.ref_std, 1e-8))
-            else:
-                scale_div = jnp.float32(1.0)
-
-            # pad rows to the data-parallel multiple for sharding; the
-            # extra rows are trimmed off the rollout batch afterwards
-            # (multi-host: every group pads the same B -> target, so the
-            # global batch stays rectangular; pad rows repeat the last
-            # real row, are excluded from KL stats via the row-validity
-            # vector below, and are dropped before the store push)
-            B = len(sequences)
-            target = B + (-B) % self.local_ways()
-
-            def rpad(x):
-                return self.pad_rows(x, target)
-
-            sharding = data_sharding(self.mesh)
-            if device_gen:
-                # the forward half has been executing since right after
-                # generation; complete it with the host-computed scores
-                with self.mesh:
-                    inject_fn = self._get_score_inject_fn(N, S)
-                    rollout_batch = inject_fn(
-                        pre_batch,
-                        mh.global_from_local(scores, sharding),
-                        mh.global_from_local(scores_mask, sharding),
-                        scale_div,
-                    )
-                kl_stats = pre_kl_stats
-            else:
-                exp_fn = self._get_experience_fn(P, N, S)
-                if self.seq2seq:
-                    args = (
-                        rpad(prompt_tensors.astype(np.int32)),
-                        rpad(np.asarray(batch.attention_mask, np.int32)),
-                        rpad(sequences.astype(np.int32)),
-                    )
-                else:
-                    attention_mask = np.concatenate(
-                        [np.asarray(batch.attention_mask, np.int32), response_mask],
-                        axis=1,
-                    )
-                    args = (
-                        rpad(sequences.astype(np.int32)),
-                        rpad(attention_mask),
-                    )
-                with self.mesh:
-                    rollout_batch, kl_stats = exp_fn(
-                        self.params,
-                        self.ref_params,
-                        *[mh.global_from_local(a, sharding) for a in args],
-                        mh.global_from_local(rpad(response_mask), sharding),
-                        mh.global_from_local(rpad(scores), sharding),
-                        mh.global_from_local(rpad(scores_mask), sharding),
-                        jnp.float32(self.kl_ctl.value),
-                        # per-ROW validity (pad rows sit inside each data
-                        # group's block of the global batch, so a prefix
-                        # count can't mark them)
-                        mh.global_from_local(
-                            np.concatenate(
-                                [np.ones(B, np.float32),
-                                 np.zeros(target - B, np.float32)]
-                            ),
-                            vector_sharding(self.mesh),
-                        ),
-                        scale_div,
-                    )
-            if target != B and mh.is_multihost():
-                # each group's pad rows sit inside the global batch; a
-                # flat [:B] can't drop them. The chunk is tiny (only a
-                # short FINAL chunk is ragged), so take the host
-                # round-trip: local real rows -> allgather -> one
-                # replicated, consistent global batch for the store
-                rollout_batch = jax.tree_util.tree_map(
-                    lambda x: jax.device_put(
-                        np.asarray(
-                            mh.allgather_group_rows(
-                                mh.local_rows(x)[:B], self.mesh
-                            )
-                        ),
-                        replicated_sharding(self.mesh),
-                    ),
-                    rollout_batch,
-                )
-            elif target != B:
-                # trim the sharding-pad rows ON DEVICE (the store keeps
-                # device-resident rollouts; no host round-trip here)
-                rollout_batch = jax.tree_util.tree_map(
-                    lambda x: x[:B], rollout_batch
-                )
-
-            # honest rollout accounting: pad emissions from finished
-            # rows are NOT generated tokens — report mask-weighted real
-            # tokens plus batch occupancy, and a truncation rate (rows
-            # that ran to max_new_tokens without an EOS: a degenerate
-            # policy that stops emitting EOS shows up here, and the
-            # guardrails can trip on it via truncation_max)
-            rm_np = np.asarray(response_mask)
-            ri_np = np.asarray(response_ids)
-            N_resp = rm_np.shape[1]
-            real_toks = float(rm_np.sum())
-            stats["rollout/real_tokens"] = real_toks
-            stats["rollout/token_occupancy"] = real_toks / max(
-                rm_np.shape[0] * N_resp, 1
-            )
-            eos_id = self.generate_settings.eos_token_id
-            full_rows = rm_np.sum(axis=1) >= N_resp
-            hit_eos = (
-                ((ri_np == eos_id) & (rm_np > 0)).any(axis=1)
-                if eos_id >= 0
-                else np.zeros(len(full_rows), bool)
-            )
-            stats["rollout/truncation_rate"] = (
-                float((full_rows & ~hit_eos).mean()) if len(full_rows) else 0.0
-            )
-            gstats = gen_out.get("gen_stats")
-            if gstats is not None:
-                g = {k: float(np.asarray(v)) for k, v in gstats.items()}
-                # per-refill heartbeat accounting (host-side,
-                # post-dispatch): with the decode engine a chunk is ONE
-                # device dispatch, so the refills all land at once —
-                # batch them into a single annotated beat (count=N)
-                # instead of N same-instant beats that would evict the
-                # other phases from the watchdog's bounded timeline
-                refills = int(g.get("refills", 0))
-                if refills:
-                    self.watchdog.beat(
-                        "rollout", step=iter_count, count=refills
-                    )
-                stats["rollout/engine_occupancy"] = g.get("occupancy", 0.0)
-                stats["rollout/engine_refills"] = g.get("refills", 0.0)
-                stats["rollout/engine_decode_steps"] = g.get("decode_steps", 0.0)
-                if "drafted" in g:
-                    stats["rollout/spec_accept_rate"] = g["accepted"] / max(
-                        g["drafted"], 1.0
-                    )
-                if g.get("oom_truncated") or g.get("unserved"):
-                    logger.warning(
-                        "gen_engine: page pool exhausted (%d lanes "
-                        "truncated, %d prompts unserved) — raise "
-                        "ppo.gen_engine.pool_pages",
-                        int(g.get("oom_truncated", 0)),
-                        int(g.get("unserved", 0)),
-                    )
-            stats["time/rollout_time"] = clock.tick()
-            stats["policy/sqrt_kl"] = jnp.sqrt(
-                jnp.maximum(kl_stats["mean_kl"], 0.0)
-            )
-            stats["policy/kl_per_token"] = jnp.sqrt(
-                jnp.maximum(kl_stats["mean_kl_per_token"], 0.0)
+            rollout_batch, rows_local = self._score_and_assemble(
+                batch, gen_out, stats, iter_count, clock
             )
             accumulated_stats.append(stats)
 
             self.push_to_store(rollout_batch)
-            n_collected += len(sequences) * mh.data_group_count(self.mesh)
+            n_collected += rows_local * mh.data_group_count(self.mesh)
             if hasattr(pbar, "update"):
-                pbar.update(len(sequences) * mh.data_group_count(self.mesh))
+                pbar.update(rows_local * mh.data_group_count(self.mesh))
             logger.info("[rollout %d / %d]", n_collected, num_rollouts)
 
         if not accumulated_stats:
@@ -937,6 +631,674 @@ class TPUPPOTrainer(TPUBaseTrainer):
         if hasattr(pbar, "close"):
             pbar.close()
         self._deferred_rollout.stage(agg, step=iter_count, meta=self.kl_ctl.value)
+
+    def _score_and_assemble(
+        self, batch: PromptBatch, gen_out, stats: Dict[str, Any],
+        iter_count: int, clock: Clock,
+    ):
+        """The score half of one rollout chunk: decode + reward_fn, the
+        teacher-forced policy/ref/value forward, KL penalty + reward
+        assembly, running-moment update and the chunk's stats (mutated
+        into ``stats``). Shared verbatim by the direct rollout loop and
+        the experience-transport producer, so the two paths cannot
+        numerically diverge. Returns ``(rollout_batch, rows_local)``."""
+        method = self.config.method
+        prompt_tensors = np.asarray(batch.input_ids)
+        seq_w = gen_out["sequences"].shape[1]
+        N = gen_out["response_ids"].shape[1]
+        P_width = prompt_tensors.shape[1]
+        # a ragged multi-host chunk comes back PADDED per data group
+        # with real_rows marking the group's real count — all row
+        # bookkeeping below runs on real rows; the pad rows only
+        # exist inside device arrays until the local slice
+        real_local = gen_out.get("real_rows")
+        B_local = (
+            real_local
+            if real_local is not None
+            else gen_out["sequences"].shape[0] // mh.data_group_count(self.mesh)
+        )
+
+        # ONE packed device->host transfer for the three generation
+        # outputs (a remote-tunneled chip pays ~100ms latency PER
+        # transfer). The concatenate is enqueued FIRST — devices run
+        # FIFO, so the DMA starts as soon as generation finishes and
+        # streams while the experience forward below computes
+        packed_dev = mh.local_rows(
+            jnp.concatenate(
+                [
+                    gen_out["sequences"],
+                    gen_out["response_ids"],
+                    gen_out["response_mask"].astype(gen_out["sequences"].dtype),
+                ],
+                axis=1,
+            )
+        )
+        try:
+            packed_dev.copy_to_host_async()
+        except Exception:
+            pass
+
+        # fast path: the score-INDEPENDENT half of the experience step
+        # (policy/ref/value forward + KL penalty — the heaviest rollout
+        # compute) is dispatched NOW, on the device tensors the sampler
+        # just produced. It executes while the host decodes and scores
+        # the samples; the tiny score-injection jit below completes the
+        # rollout batch once reward_fn returns. Falls back to the
+        # fused experience fn when host-side token rewrites (stop
+        # sequences, seq2seq) or pad rows are needed.
+        device_gen = (
+            not self.seq2seq
+            and not self.stop_sequences
+            and B_local % self.local_ways() == 0
+            # a padded multihost chunk (real_rows set — including the
+            # divisible-but-widened case, where generate() padded up
+            # to an already-compiled wider shape) must take the
+            # host-scored path: the device fast path would build
+            # pre_batch over the pad rows and mismatch the real-row
+            # scores at injection
+            and real_local is None
+        )
+        pre_batch = pre_kl_stats = None
+        if device_gen:
+            with self.mesh:
+                fwd_fn = self._get_experience_fwd_fn(P_width, N)
+                pre_batch, pre_kl_stats = fwd_fn(
+                    self.params,
+                    self.ref_params,
+                    gen_out["sequences"].astype(jnp.int32),
+                    jnp.concatenate(
+                        [
+                            gen_out["prompt_mask"].astype(jnp.int32),
+                            gen_out["response_mask"].astype(jnp.int32),
+                        ],
+                        axis=1,
+                    ),
+                    gen_out["response_mask"].astype(jnp.int32),
+                    jnp.float32(self.kl_ctl.value),
+                    # device_gen only runs on unpadded batches: every
+                    # row is valid
+                    jnp.ones((gen_out["sequences"].shape[0],), jnp.float32),
+                )
+
+        packed = packed_dev[:B_local]  # drop per-group pad rows
+        sequences = packed[:, :seq_w]
+        response_ids = packed[:, seq_w : seq_w + N]
+        response_mask = packed[:, seq_w + N :]
+        P = prompt_tensors.shape[1]
+
+        prompt_sizes = [P] * len(sequences)
+        str_samples, str_prompts, str_outputs = self.decode(
+            prompt_tensors, sequences, prompt_sizes, append_eos_token=True
+        )
+
+        rollout_score_time = time()
+        all_scores = self._call_reward_fn(
+            samples=str_samples,
+            prompts=str_prompts,
+            outputs=str_outputs,
+            tokenizer=self.tokenizer,
+            **(batch.metadata or {}),
+        )
+        stats["time/rollout_score"] = time() - rollout_score_time
+
+        scores_list = [np.atleast_1d(np.asarray(s, np.float32)) for s in all_scores]
+        S = max(len(s) for s in scores_list)
+        scores = np.zeros((len(scores_list), S), np.float32)
+        scores_mask = np.zeros((len(scores_list), S), np.float32)
+        for i, s in enumerate(scores_list):
+            scores[i, : len(s)] = s
+            scores_mask[i, : len(s)] = 1.0
+
+        if self.stop_sequences:
+            # stop-sequence trimming changed the outputs: rebuild the
+            # response tokens from the trimmed strings (the reference
+            # re-tokenizes unconditionally, :345-365 — lossy for some
+            # tokenizers, so here only when actually needed)
+            outputs = self.tokenizer(str_outputs, add_special_tokens=False)["input_ids"]
+            response_ids = np.full((len(outputs), N), self.generate_settings.pad_token_id, np.int32)
+            response_mask = np.zeros((len(outputs), N), np.int32)
+            for i, o in enumerate(outputs):
+                o = o[:N]
+                response_ids[i, : len(o)] = o
+                response_mask[i, : len(o)] = 1
+            if self.seq2seq:
+                start = sequences[:, :1]  # decoder start token column
+                sequences = np.concatenate([start, response_ids], axis=1)
+            else:
+                sequences = np.concatenate([prompt_tensors, response_ids], axis=1)
+
+        if method.cliprange_reward:
+            scores = np.clip(scores, -method.cliprange_reward, method.cliprange_reward)
+
+        # local per-row sums -> one GLOBAL vector; the running-moment
+        # update then reduces over every host's rows in-graph (the
+        # reference all-gathers scores to rank 0 instead). A short
+        # final chunk (prompt dataset smaller than chunk_size) may not
+        # divide dp*fsdp — keep the tiny vector replicated then
+        # (padding would bias the running reward moments). Multi-host
+        # replication of per-group-DIFFERENT rows needs a host-side
+        # allgather first, so every process places the same full
+        # vector (parity: the reference pads across processes,
+        # accelerate_ppo_trainer.py:292-300).
+        local_sums = (scores * scores_mask).sum(axis=1)
+        rows = len(local_sums) * mh.data_group_count(self.mesh)
+        if rows % self.data_ways() == 0:
+            score_sums = mh.global_from_local(
+                local_sums, vector_sharding(self.mesh)
+            )
+        elif mh.is_multihost():
+            score_sums = jax.device_put(
+                np.asarray(
+                    mh.allgather_group_rows(
+                        local_sums.astype(np.float32), self.mesh
+                    ),
+                    np.float32,
+                ),
+                replicated_sharding(self.mesh),
+            )
+        else:
+            score_sums = mh.global_from_local(
+                local_sums, replicated_sharding(self.mesh)
+            )
+        if self.ref_mean is None:
+            self.ref_mean = float(score_sums.mean())
+            self.ref_std = float(score_sums.std())
+        new_moments, scores_mean, scores_std = running_moments_update(
+            self.running_moments, score_sums
+        )
+        # a NaN-poisoned chunk must not permanently poison the
+        # running reward moments (they scale every later reward and
+        # persist across checkpoints): keep the pre-chunk moments
+        # when the chunk's sums are non-finite. The chunk's OWN
+        # stats still report the poison, so the guardrails see it.
+        keep = jnp.all(jnp.isfinite(score_sums))
+        self.running_moments = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(keep, n, o),
+            new_moments, self.running_moments,
+        )
+        # stats stay DEVICE scalars until the single packed fetch at
+        # the end of make_experience (each host read costs a full
+        # round-trip on a remote-tunneled chip)
+        stats["rollout_scores/mean"] = scores_mean
+        stats["rollout_scores/std"] = scores_std
+        stats["rollout_scores/running_mean"] = self.running_moments.mean
+        stats["rollout_scores/running_std"] = self.running_moments.std
+
+        # reward scaling happens inside the experience fn: pass the
+        # divisor as a device scalar instead of fetching the running
+        # std to the host
+        if method.scale_reward == "running":
+            scale_div = self.running_moments.std
+        elif method.scale_reward == "ref":
+            scale_div = jnp.float32(max(self.ref_std, 1e-8))
+        else:
+            scale_div = jnp.float32(1.0)
+
+        # pad rows to the data-parallel multiple for sharding; the
+        # extra rows are trimmed off the rollout batch afterwards
+        # (multi-host: every group pads the same B -> target, so the
+        # global batch stays rectangular; pad rows repeat the last
+        # real row, are excluded from KL stats via the row-validity
+        # vector below, and are dropped before the store push)
+        B = len(sequences)
+        target = B + (-B) % self.local_ways()
+
+        def rpad(x):
+            return self.pad_rows(x, target)
+
+        sharding = data_sharding(self.mesh)
+        if device_gen:
+            # the forward half has been executing since right after
+            # generation; complete it with the host-computed scores
+            with self.mesh:
+                inject_fn = self._get_score_inject_fn(N, S)
+                rollout_batch = inject_fn(
+                    pre_batch,
+                    mh.global_from_local(scores, sharding),
+                    mh.global_from_local(scores_mask, sharding),
+                    scale_div,
+                )
+            kl_stats = pre_kl_stats
+        else:
+            exp_fn = self._get_experience_fn(P, N, S)
+            if self.seq2seq:
+                args = (
+                    rpad(prompt_tensors.astype(np.int32)),
+                    rpad(np.asarray(batch.attention_mask, np.int32)),
+                    rpad(sequences.astype(np.int32)),
+                )
+            else:
+                attention_mask = np.concatenate(
+                    [np.asarray(batch.attention_mask, np.int32), response_mask],
+                    axis=1,
+                )
+                args = (
+                    rpad(sequences.astype(np.int32)),
+                    rpad(attention_mask),
+                )
+            with self.mesh:
+                rollout_batch, kl_stats = exp_fn(
+                    self.params,
+                    self.ref_params,
+                    *[mh.global_from_local(a, sharding) for a in args],
+                    mh.global_from_local(rpad(response_mask), sharding),
+                    mh.global_from_local(rpad(scores), sharding),
+                    mh.global_from_local(rpad(scores_mask), sharding),
+                    jnp.float32(self.kl_ctl.value),
+                    # per-ROW validity (pad rows sit inside each data
+                    # group's block of the global batch, so a prefix
+                    # count can't mark them)
+                    mh.global_from_local(
+                        np.concatenate(
+                            [np.ones(B, np.float32),
+                             np.zeros(target - B, np.float32)]
+                        ),
+                        vector_sharding(self.mesh),
+                    ),
+                    scale_div,
+                )
+        if target != B and mh.is_multihost():
+            # each group's pad rows sit inside the global batch; a
+            # flat [:B] can't drop them. The chunk is tiny (only a
+            # short FINAL chunk is ragged), so take the host
+            # round-trip: local real rows -> allgather -> one
+            # replicated, consistent global batch for the store
+            rollout_batch = jax.tree_util.tree_map(
+                lambda x: jax.device_put(
+                    np.asarray(
+                        mh.allgather_group_rows(
+                            mh.local_rows(x)[:B], self.mesh
+                        )
+                    ),
+                    replicated_sharding(self.mesh),
+                ),
+                rollout_batch,
+            )
+        elif target != B:
+            # trim the sharding-pad rows ON DEVICE (the store keeps
+            # device-resident rollouts; no host round-trip here)
+            rollout_batch = jax.tree_util.tree_map(
+                lambda x: x[:B], rollout_batch
+            )
+
+        # honest rollout accounting: pad emissions from finished
+        # rows are NOT generated tokens — report mask-weighted real
+        # tokens plus batch occupancy, and a truncation rate (rows
+        # that ran to max_new_tokens without an EOS: a degenerate
+        # policy that stops emitting EOS shows up here, and the
+        # guardrails can trip on it via truncation_max)
+        rm_np = np.asarray(response_mask)
+        ri_np = np.asarray(response_ids)
+        N_resp = rm_np.shape[1]
+        real_toks = float(rm_np.sum())
+        stats["rollout/real_tokens"] = real_toks
+        stats["rollout/token_occupancy"] = real_toks / max(
+            rm_np.shape[0] * N_resp, 1
+        )
+        eos_id = self.generate_settings.eos_token_id
+        full_rows = rm_np.sum(axis=1) >= N_resp
+        hit_eos = (
+            ((ri_np == eos_id) & (rm_np > 0)).any(axis=1)
+            if eos_id >= 0
+            else np.zeros(len(full_rows), bool)
+        )
+        stats["rollout/truncation_rate"] = (
+            float((full_rows & ~hit_eos).mean()) if len(full_rows) else 0.0
+        )
+        gstats = gen_out.get("gen_stats")
+        if gstats is not None:
+            g = {k: float(np.asarray(v)) for k, v in gstats.items()}
+            # per-refill heartbeat accounting (host-side,
+            # post-dispatch): with the decode engine a chunk is ONE
+            # device dispatch, so the refills all land at once —
+            # batch them into a single annotated beat (count=N)
+            # instead of N same-instant beats that would evict the
+            # other phases from the watchdog's bounded timeline
+            refills = int(g.get("refills", 0))
+            if refills:
+                self.watchdog.beat(
+                    "rollout", step=iter_count, count=refills
+                )
+            stats["rollout/engine_occupancy"] = g.get("occupancy", 0.0)
+            stats["rollout/engine_refills"] = g.get("refills", 0.0)
+            stats["rollout/engine_decode_steps"] = g.get("decode_steps", 0.0)
+            if "drafted" in g:
+                stats["rollout/spec_accept_rate"] = g["accepted"] / max(
+                    g["drafted"], 1.0
+                )
+            if g.get("oom_truncated") or g.get("unserved"):
+                logger.warning(
+                    "gen_engine: page pool exhausted (%d lanes "
+                    "truncated, %d prompts unserved) — raise "
+                    "ppo.gen_engine.pool_pages",
+                    int(g.get("oom_truncated", 0)),
+                    int(g.get("unserved", 0)),
+                )
+        stats["time/rollout_time"] = clock.tick()
+        stats["policy/sqrt_kl"] = jnp.sqrt(
+            jnp.maximum(kl_stats["mean_kl"], 0.0)
+        )
+        stats["policy/kl_per_token"] = jnp.sqrt(
+            jnp.maximum(kl_stats["mean_kl_per_token"], 0.0)
+        )
+        return rollout_batch, len(sequences)
+
+    # -- experience transport (ppo.exp.*) --------------------------------
+
+    def _exp_snapshot(self) -> Dict[str, Any]:
+        """Replay state for a production lease, taken BEFORE the chunk
+        touches anything: the trainer RNG key and the host-side reward
+        accounting (running moments, ref stats). jax arrays are
+        immutable, so holding references is free; restoring them makes
+        a re-dispatched production bit-identical to the original
+        attempt (same key -> same samples, same moments -> same reward
+        scaling), which is what lets a producer death leave the
+        consumed stream untouched. (The prompt batch itself is stashed
+        on the lease at pull time — ``snap["batch"]`` — so a replay
+        never re-pulls the stream.)"""
+        return {
+            "rng": self.rng,
+            "running_moments": self.running_moments,
+            "ref_mean": self.ref_mean,
+            "ref_std": self.ref_std,
+        }
+
+    def _exp_restore_snapshot(self, snap: Dict[str, Any]) -> None:
+        self.rng = snap["rng"]
+        self.running_moments = snap["running_moments"]
+        self.ref_mean = snap["ref_mean"]
+        self.ref_std = snap["ref_std"]
+
+    def _exp_wait(self, iter_count: int):
+        """Bounded-wait callback for transport waits (back-pressure,
+        lease expiry): beat the ``exp_wait`` watchdog phase and sleep
+        one poll — a genuinely wedged queue then trips the watchdog
+        deadline instead of hanging undiagnosed."""
+        import time as _time
+
+        def wait(poll_s: float) -> None:
+            self.watchdog.beat("exp_wait", step=iter_count)
+            _time.sleep(poll_s)
+
+        return wait
+
+    def _exp_produce(self, lease, iter_count: int, clock: Clock) -> None:
+        """Produce one chunk under ``lease`` and deliver it: pull the
+        prompt chunk (or consume the cycle's overlap prefetch), sample,
+        score+assemble, then offer to the queue with the lease's
+        heartbeats at each milestone. Re-dispatched leases (attempt > 1
+        or a staleness re-dispatch) restore the replay snapshot first,
+        so the regenerated chunk is bit-identical to the lost one."""
+        exp = self._exp
+        snap = lease.meta if lease.meta is not None else {}
+        lease.meta = snap
+        if snap.get("rng") is not None:
+            # no-op on a fresh attempt (the snapshot IS the live state);
+            # on a re-dispatch it rewinds the producer-side effects so
+            # the replay is bit-identical
+            self._exp_restore_snapshot(snap)
+        stats: Dict[str, float] = {}
+        if snap.get("gen") is not None:
+            # replaying a chunk originally produced from the cycle
+            # prefetch: the generation (old params, old key) cannot be
+            # re-run — redeliver the retained samples wholesale
+            batch, gen_out, gen_time, version = snap["gen"]
+        elif self._prefetched_gen is not None:
+            batch, gen_out, gen_time = self._prefetched_gen
+            self._prefetched_gen = None
+            self._prefetch_cursor_start = None
+            version = self._prefetch_policy_version
+            snap["gen"] = (batch, gen_out, gen_time, version)
+        else:
+            batch = snap.get("batch")
+            if batch is None:
+                batch = self._next_prompt_batch()
+                snap["batch"] = batch
+            exp.heartbeat(lease)
+            t0 = time()
+            gen_out = self.generate(batch.input_ids, batch.attention_mask)
+            gen_time = time() - t0
+            version = self._policy_version
+        stats["time/rollout_generate"] = gen_time
+        exp.heartbeat(lease)
+        rollout_batch, rows_local = self._score_and_assemble(
+            batch, gen_out, stats, iter_count, clock
+        )
+        exp.heartbeat(lease)
+        if self.chaos is not None and self.chaos.consult("stale_flood"):
+            # chaos: the chunk's staleness metadata is corrupted — its
+            # recorded generation version lands far behind the live
+            # policy, so the admission gate must reject (or clip) it
+            version = version - (self._exp_cfg.staleness.max_staleness + 10)
+        if self.chaos is not None and self.chaos.consult("queue_wedge"):
+            # chaos: the learner stops draining — the next offers see a
+            # full queue and the bounded back-pressure wait must ride
+            # it out under exp_wait heartbeats
+            exp.wedge()
+        payload = (rollout_batch, stats, rows_local)
+        with self.watchdog.phase("exp_wait", step=iter_count):
+            exp.deliver(
+                lease, version, payload, meta={"snapshot": snap},
+                wait=self._exp_wait(iter_count),
+            )
+            if self.chaos is not None and self.chaos.consult(
+                "duplicate_delivery"
+            ):
+                # chaos: the producer's retry races its own success —
+                # the same finished chunk is delivered twice; consumer
+                # dedup must drop the redelivery
+                exp.deliver(
+                    lease, version, payload, meta={"snapshot": snap},
+                    wait=self._exp_wait(iter_count),
+                )
+
+    def _make_experience_exp(self, num_rollouts: int, iter_count: int) -> None:
+        """The experience-transport rollout loop: the in-process PPO
+        trainer acting as the first producer/consumer pair behind the
+        leased queue (ROADMAP item 1's remote rollout fleet plugs in
+        behind the same seam). Fault-free it is bit-equal to the direct
+        loop: the same prompt pulls, the same RNG splits per generate,
+        the same score math (shared ``_score_and_assemble``), consumed
+        in the same order (the queue is in-order by construction)."""
+        import time as _time
+
+        logger.info("Collecting rollouts (experience transport)")
+        self._rollout_abandoned = False
+        exp = self._exp
+        prompt_cursor_start = (
+            self._prefetch_cursor_start
+            if self._prefetched_gen is not None
+            else self._prompt_batches_consumed
+        )
+        self._cycle_cursor_start = prompt_cursor_start
+        self._finish_rollout_stats()
+        clock = Clock()
+        n_collected = 0
+        accumulated_stats: List[Dict[str, float]] = []
+        pbar = logging.progress(total=num_rollouts, desc="rollouts")
+        scfg = self._exp_cfg.staleness
+        pending_redispatch = None  # a reclaimed/re-leased chunk to produce
+        while n_collected < num_rollouts:
+            self.watchdog.beat("rollout", step=iter_count)
+            if self.chaos is not None:
+                # chaos: same wedge site as the direct loop — the
+                # producer stalls at the top of a chunk and the
+                # watchdog deadline must end the run
+                self.chaos.stall("stall_rollout")
+            if self._should_stop(force=True):
+                logger.warning(
+                    "preemption during rollout collection: abandoning "
+                    "after %d/%d rollouts", n_collected, num_rollouts,
+                )
+                self._rollout_abandoned = True
+                self._prompt_batches_consumed = prompt_cursor_start
+                # in-flight chunks and leases never train: void them so
+                # the resumed run's replayed prompts produce fresh
+                # chunks under a new epoch
+                exp.abort_epoch()
+                break
+            chunk = exp.poll()
+            if chunk is None:
+                lease = pending_redispatch
+                pending_redispatch = None
+                if lease is None:
+                    gap = exp.queue.next_undelivered()
+                    gap_lease = exp.leases.get((exp.queue.epoch, gap))
+                    if gap_lease is not None:
+                        # the next in-order chunk is leased but not
+                        # delivered: its producer died (or is slow).
+                        # Wait out the lease TTL under the exp_wait
+                        # phase, then reclaim + re-dispatch.
+                        with self.watchdog.phase("exp_wait", step=iter_count):
+                            while True:
+                                reclaimed = exp.reclaim_expired()
+                                if reclaimed:
+                                    lease = reclaimed[0]
+                                    break
+                                self.watchdog.beat(
+                                    "exp_wait", step=iter_count
+                                )
+                                _time.sleep(self._exp_cfg.wait_poll_s)
+                    else:
+                        lease = exp.begin_chunk(snapshot=self._exp_snapshot())
+                        if self.chaos is not None and self.chaos.consult(
+                            "worker_death_mid_lease"
+                        ):
+                            # chaos: the producer dies right after
+                            # taking the lease — before any side
+                            # effect. Heartbeats stop; the consumer
+                            # loop above waits out the TTL and
+                            # re-dispatches the chunk.
+                            exp.producer_died(lease)
+                            continue
+                self._exp_produce(lease, iter_count, clock)
+                continue
+            verdict, staleness = exp.admit(chunk, self._policy_version)
+            if staleness > scfg.max_staleness and self.guardrails.enabled:
+                self.guardrails.trip(
+                    STALENESS_SIGNAL,
+                    f"chunk {chunk.chunk_id} is {staleness} policy "
+                    f"versions stale (> max {scfg.max_staleness}; "
+                    f"verdict: {verdict}) — the rollout producers are "
+                    "falling behind the learner",
+                )
+            if verdict == exp_transport.REJECT:
+                # over-stale: drop the delivery and regenerate the
+                # chunk's prompts with the current policy (the replay
+                # snapshot keeps the regeneration deterministic). A
+                # chunk born from the cycle prefetch retains its old
+                # samples in snap["gen"] for lost-delivery replay —
+                # but a staleness reject must NOT redeliver those
+                # verbatim (same samples, same version -> an infinite
+                # reject/redeliver loop): strip the retained
+                # generation, keep its prompt batch, so the produce
+                # path re-samples with the live policy and stamps the
+                # live version
+                snap = chunk.meta.get("snapshot")
+                if snap is not None and snap.get("gen") is not None:
+                    snap["batch"] = snap["gen"][0]
+                    snap["gen"] = None
+                pending_redispatch = exp.redispatch_rejected(chunk)
+                continue
+            rollout_batch, stats, rows_local = chunk.payload
+            if verdict == exp_transport.ADMIT_CLIP:
+                rollout_batch = self._apply_staleness_clip(rollout_batch)
+                stats["exp/staleness_clipped"] = 1.0
+            elif scfg.mode == "clip":
+                # uniform store pytree structure: every batch of a
+                # clip-mode run carries weights (fresh chunks at 1)
+                rollout_batch = rollout_batch.replace(
+                    is_weight=jnp.ones_like(rollout_batch.response_mask)
+                )
+            stats["exp/staleness"] = float(staleness)
+            self.push_to_store(rollout_batch)
+            exp.committed(chunk)
+            accumulated_stats.append(stats)
+            n_collected += rows_local * mh.data_group_count(self.mesh)
+            if hasattr(pbar, "update"):
+                pbar.update(rows_local * mh.data_group_count(self.mesh))
+            logger.info("[rollout %d / %d]", n_collected, num_rollouts)
+
+        if not accumulated_stats:
+            if hasattr(pbar, "close"):
+                pbar.close()
+            return
+        # aggregate over the UNION of keys: conditional keys (a clip
+        # admission mid-cycle) must not vanish just because the final
+        # chunk was fresh — that telemetry is exactly what the
+        # staleness ledger exists to surface
+        all_keys = [k for xs in accumulated_stats for k in xs]
+        agg = {
+            k: sum(xs.get(k, 0.0) for xs in accumulated_stats) / len(accumulated_stats)
+            for k in dict.fromkeys(all_keys)
+        }
+        # transport health ledger rides the same deferred stage as the
+        # rollout stats (host ints — free)
+        agg.update({
+            f"exp/{k}": float(v)
+            for k, v in exp.stats_summary().items()
+            if isinstance(v, (int, float))
+        })
+        if hasattr(pbar, "close"):
+            pbar.close()
+        self._deferred_rollout.stage(agg, step=iter_count, meta=self.kl_ctl.value)
+
+    def _apply_staleness_clip(self, rollout_batch: PPORolloutBatch):
+        """IMPACT-style admission correction for an over-stale chunk
+        (``exp.staleness.mode: clip``, arXiv:1912.00167): recompute
+        logprobs/values with the CURRENT policy (the proximal recompute
+        — the PPO ratio is then measured against the policy the
+        optimization epoch actually starts from) and thread the
+        behavior mismatch into the surrogate as a per-token CLIPPED
+        importance weight rho = clip(pi_now/pi_behavior, 1±clip_c)
+        (``ops/ppo.py`` ``is_weight``). The stored rewards keep their
+        generation-time KL penalty (the terminal score is
+        policy-independent)."""
+        pad = self.generate_settings.pad_token_id
+        q = jnp.asarray(rollout_batch.query_tensors, jnp.int32)
+        r = jnp.asarray(rollout_batch.response_tensors, jnp.int32)
+        P, N = q.shape[1], r.shape[1]
+        tokens = jnp.concatenate([q, r], axis=1)
+        attention_mask = (tokens != pad).astype(jnp.int32)
+        resp_mask = jnp.asarray(rollout_batch.response_mask)
+        attention_mask = attention_mask.at[:, P:].set(
+            jnp.maximum(attention_mask[:, P:], resp_mask.astype(jnp.int32))
+        )
+        with self.mesh:
+            fwd_fn = self._get_experience_fwd_fn(P, N)
+            pre_batch, _ = fwd_fn(
+                self.params, self.ref_params, tokens, attention_mask,
+                resp_mask.astype(jnp.int32),
+                jnp.float32(self.kl_ctl.value),
+                jnp.ones((tokens.shape[0],), jnp.float32),
+            )
+        c = self._exp_cfg.staleness.clip_c
+        mask = resp_mask.astype(jnp.float32)
+        rho = jnp.exp(pre_batch.logprobs - rollout_batch.logprobs)
+        is_weight = jnp.clip(rho, 1.0 - c, 1.0 + c) * mask + (1.0 - mask)
+        return rollout_batch.replace(
+            logprobs=pre_batch.logprobs,
+            values=pre_batch.values,
+            is_weight=is_weight,
+        )
+
+    def _extra_consistency_checks(self) -> None:
+        """Every host must hold the SAME experience-transport consumer
+        cursor — a drifted cursor means hosts silently trained
+        different chunks. Asserted through ``multihost.cursor_consensus``
+        at the guardrails consistency cadence; disagreement trips the
+        ladder like any other divergence."""
+        if self._exp is None or not self.guardrails.enabled:
+            return
+        result = mh.cursor_consensus(
+            "exp", self._exp.queue.epoch, self._exp.queue.cursor
+        )
+        if not result.agree:
+            self.guardrails.trip(
+                "consistency",
+                f"experience-transport cursor diverged at step "
+                f"{self.iter_count}: {result.detail}",
+            )
 
     def _finish_rollout_stats(self) -> None:
         """Materialize + log the deferred make_experience stats (sets
@@ -1034,6 +1396,11 @@ class TPUPPOTrainer(TPUBaseTrainer):
         if getattr(self, "_prompt_pipeline", None) is None:
             return
         self._resume_prompt_cursor = 0
+        if self._exp is not None:
+            # in-flight transport chunks belong to the discarded live
+            # state; the load() that follows restores the committed
+            # cursor on top of the bumped epoch
+            self._exp.abort_epoch()
         self._build_prompt_iterator()
 
     def _requeue_poisoned_batch(self) -> bool:
@@ -1046,6 +1413,11 @@ class TPUPPOTrainer(TPUBaseTrainer):
         if len(self.store) == 0 or start is None:
             return False
         self._abandon_prefetch()
+        if self._exp is not None:
+            # the rebuilt stream replays this cycle's prompts: void the
+            # transport's in-flight chunks/leases under a new epoch so
+            # an old delivery can never shadow a replayed one
+            self._exp.abort_epoch()
         self.store.clear_history()
         self._rewind_prompt_stream(start)
         logger.warning(
@@ -1092,6 +1464,11 @@ class TPUPPOTrainer(TPUBaseTrainer):
             gen = self.generate(batch.input_ids, batch.attention_mask)
         self._prefetched_gen = (batch, gen, time() - t0)
         self._prefetch_cursor_start = cursor0
+        # staleness metadata: the prefetched chunk's samples belong to
+        # the PRE-update policy — it is consumed one optimizer cycle
+        # later at exactly staleness 1 (which the admission gate's
+        # default max_staleness admits untouched)
+        self._prefetch_policy_version = self._policy_version
 
     def _abandon_prefetch(self) -> None:
         """Drop an in-flight prefetched chunk and rewind the prompt
@@ -1125,16 +1502,24 @@ class TPUPPOTrainer(TPUBaseTrainer):
         KL controller — the two pieces of host-side PPO state that MUST
         advance in lockstep across hosts (a drifted cursor silently
         trains different prompts per host)."""
-        return {
+        out = {
             "prompt_cursor": float(self._prompt_batches_consumed),
             "kl_ctl": float(self.kl_ctl.value),
         }
+        if self._exp is not None:
+            # the transport's committed consumer position must advance
+            # in lockstep too (a drifted cursor = hosts training
+            # different chunks); also asserted dedicatedly through
+            # multihost.cursor_consensus in _extra_consistency_checks
+            out["exp_epoch"] = float(self._exp.queue.epoch)
+            out["exp_cursor"] = float(self._exp.queue.cursor)
+        return out
 
     # -- resumable state -------------------------------------------------
 
     def _extra_state(self):
         rm = self.running_moments
-        return {
+        state = {
             "kl_ctl_value": float(self.kl_ctl.value),
             "mean_kl": float(self.mean_kl),
             "ref_mean": None if self.ref_mean is None else float(self.ref_mean),
@@ -1156,6 +1541,20 @@ class TPUPPOTrainer(TPUBaseTrainer):
             # saved under the old per-group-shuffle scheme)
             "prompt_stream": "global-chunks-v1",
         }
+        if self._exp is not None:
+            # the experience-transport consumer cursor, committed INSIDE
+            # the atomic checkpoint (state.json rides the integrity
+            # manifest): a resume replays exactly the unconsumed chunks
+            # — produced-but-unconsumed ones regenerate from the
+            # group-invariant prompt stream. Invariant (verify_ckpt.py's
+            # torn-commit detector): cursor <= prompt_batches_consumed,
+            # every committed chunk consumed a prompt pull.
+            state["exp_queue"] = {
+                **self._exp.state_dict(),
+                "policy_version": self._policy_version,
+                "staleness_mode": self._exp_cfg.staleness.mode,
+            }
+        return state
 
     def _restore_extra_state(self, state) -> None:
         from trlx_tpu.ops.common import RunningMoments
@@ -1171,6 +1570,10 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 mean=jnp.float32(rm["mean"]), var=jnp.float32(rm["var"]),
                 std=jnp.float32(rm["std"]), count=jnp.float32(rm["count"]),
             )
+        eq = state.get("exp_queue")
+        if eq and self._exp is not None:
+            self._exp.load_state_dict(eq)
+            self._policy_version = int(eq.get("policy_version", 0))
         self._resume_prompt_cursor = state.get("prompt_batches_consumed", 0)
         if (
             self._resume_prompt_cursor

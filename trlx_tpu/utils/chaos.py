@@ -35,7 +35,11 @@ to an exact cycle/call):
                   consistency check (train.guardrails.consistency_every).
   stall_rollout   sleep ``stall_delay`` seconds at the top of a rollout
                   chunk (a wedged sampler / dead generation collective);
-                  consulted once per rollout chunk iteration.
+                  consulted once per rollout loop iteration (NOTE: under
+                  ``ppo.exp.enabled`` the transport loop takes two
+                  iterations per chunk — produce, then consume — so the
+                  same ``at`` lands on a different chunk than on the
+                  direct path; each path's counts stay deterministic).
   stall_reward    sleep ``stall_delay`` seconds in the reward path,
                   OUTSIDE the resilient per-attempt deadline (a reward
                   service that hangs rather than erroring — a deadline
@@ -54,6 +58,27 @@ to an exact cycle/call):
   snapshot -> abort with the "stalled" exit class. Pick a
   ``stall_delay`` comfortably past the configured
   ``train.watchdog`` deadline.
+
+  Experience-transport sites (``ppo.exp.enabled``; trlx_tpu/exp/):
+  worker_death_mid_lease  the producer dies right after taking a
+                  chunk's production lease (before any side effect):
+                  heartbeats stop, the lease expires on TTL, and the
+                  chunk is re-dispatched to a live producer; consulted
+                  once per lease acquire.
+  duplicate_delivery  the finished chunk is delivered TWICE (a retry
+                  racing its own success — the at-least-once failure
+                  mode); the consumer's dedup must drop the second;
+                  consulted once per delivery.
+  stale_flood     the chunk's staleness metadata is inflated (its
+                  policy-version-at-generation pushed far behind the
+                  live version) so the admission gate rejects/clips it
+                  and the ``staleness`` guardrail signal trips;
+                  consulted once per delivery.
+  queue_wedge     the next deliveries see a full queue (the learner
+                  stopped draining): the producer's bounded
+                  back-pressure wait — with ``exp_wait`` watchdog
+                  beats — must ride it out; consulted once per
+                  delivery.
 
 Schedule entries select by count: ``{"fault": "nan_loss", "at": 2}``
 fires on the 2nd consult (1-based), ``{"fault": ..., "at": 2, "span": 3}``
@@ -89,6 +114,12 @@ FAULT_SITES = (
     "stall_rollout",
     "stall_reward",
     "stall_collective",
+    # experience-transport sites (appended so the per-site RNG streams
+    # of every pre-existing site stay unshifted)
+    "worker_death_mid_lease",
+    "duplicate_delivery",
+    "stale_flood",
+    "queue_wedge",
 )
 
 

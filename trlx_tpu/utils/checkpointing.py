@@ -304,6 +304,49 @@ def atomic_json_write(path: str, obj) -> None:
     _fsync_path(os.path.dirname(path))
 
 
+def check_cursor_invariants(state: Dict[str, Any]) -> List[str]:
+    """Cross-field invariants of a ``state.json`` dict — the torn-commit
+    detector for the experience transport's consumer cursor. Returns
+    problem strings (empty = consistent). Shared by the offline
+    validator (scripts/verify_ckpt.py) and tests, so the invariant has
+    exactly one definition.
+
+    The load-bearing one: ``exp_queue.cursor`` (chunks the transport
+    consumer COMMITTED) can never exceed ``prompt_batches_consumed``
+    (chunks PULLED off the prompt stream) — every committed chunk
+    consumed a pull first, and both fields are written by the same
+    atomic ``state.json`` commit. A cursor pointing past the committed
+    prompt-stream position means the two halves came from different
+    moments: a torn commit, a hand-edited file, or a writer bug — and a
+    resume from it would fabricate experience for prompts that were
+    never drawn."""
+    problems: List[str] = []
+    eq = state.get("exp_queue")
+    if not isinstance(eq, dict):
+        return problems
+    cursor = eq.get("cursor")
+    prompts = state.get("prompt_batches_consumed")
+    if not isinstance(cursor, int) or cursor < 0:
+        problems.append(
+            f"exp_queue.cursor={cursor!r} is not a non-negative integer"
+        )
+    elif isinstance(prompts, int) and cursor > prompts:
+        problems.append(
+            f"exp_queue.cursor={cursor} points PAST the committed "
+            f"prompt-stream position (prompt_batches_consumed="
+            f"{prompts}): every consumed chunk must have pulled a "
+            "prompt chunk first — this state.json is torn (its halves "
+            "were written at different moments) and a resume from it "
+            "would train on experience for prompts never drawn"
+        )
+    epoch = eq.get("epoch")
+    if epoch is not None and (not isinstance(epoch, int) or epoch < 0):
+        problems.append(
+            f"exp_queue.epoch={epoch!r} is not a non-negative integer"
+        )
+    return problems
+
+
 def is_committed(directory: str) -> bool:
     """True iff `directory` is a checkpoint whose commit marker landed —
     the only state an auto-resume is allowed to pick up."""

@@ -38,7 +38,9 @@ the rollout decode ledger: the fraction of rows running to
 max_new_tokens without EOS), plus the externally-detected
 kinds recorded via :meth:`GuardrailMonitor.trip` — ``consistency``
 (the PR 4 cross-host fingerprint watchdog), ``peer`` (a synthetic
-lockstep trip), and ``stall`` (:data:`STALL_SIGNAL`, the hang doctor:
+lockstep trip), ``staleness`` (:data:`STALENESS_SIGNAL`, the experience
+transport's admission gate: a chunk arrived too many policy versions
+behind the learner), and ``stall`` (:data:`STALL_SIGNAL`, the hang doctor:
 utils/watchdog.py records it when a phase blows its heartbeat deadline
 — on the soft path, a cross-host straggler report, the trip walks this
 ladder; on the hard path, a frozen loop, it lands in ``trip_history``
@@ -65,6 +67,15 @@ LADDER_ACTIONS = ("log", "requeue", "lr_cut", "rollback", "abort")
 # (frozen loop) record it here and then abort with the stalled exit
 # class — either way the trip history names the stall.
 STALL_SIGNAL = "stall"
+
+# the experience transport's trip kind (trlx_tpu/exp/): a delivered
+# chunk's staleness (policy-version-at-consumption minus
+# version-at-generation) exceeded ``exp.staleness.max_staleness``. In
+# ``reject`` mode the chunk was dropped and re-dispatched; in ``clip``
+# mode it trains under IMPACT-style clipped importance weights — either
+# way the trip walks this ladder, because sustained over-staleness
+# means the rollout fleet is falling behind the learner.
+STALENESS_SIGNAL = "staleness"
 
 
 def _finite(x) -> bool:

@@ -73,7 +73,7 @@ EXIT_STALLED = 87
 # allowed — these are the ones the shipped deadlines/docs talk about)
 PHASES = (
     "rollout", "reward", "fused_block", "train_step", "checkpoint",
-    "eval", "experience",
+    "eval", "experience", "exp_wait",
 )
 
 
