@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Docs <-> bench-artifact sync check (the r06-gap closer).
+
+Round 6 reported engine numbers whose driver artifact
+(``BENCH_r06.json``) was never recorded into the repo, so the claimed
+recovery of the r05 ``train_s`` regression could not be confirmed from
+checked-in data. ``bench.py --record`` now writes the artifact and the
+docs/benchmarks.md trajectory row in ONE step; this check makes the
+other direction structural:
+
+1. every trajectory row that CLAIMS a number must have its
+   ``BENCH_rNN.json`` artifact in the repo root (a row explicitly
+   marked ``*artifact missing*`` is an honest documented gap, not a
+   violation);
+2. every ``BENCH_rNN.json`` artifact must have a trajectory row (an
+   artifact the table never mentions is an unreported round).
+
+Run standalone (exit 1 on problems) or via the tier-1 hook in
+``tests/test_marker_audit.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check(repo: str = REPO) -> list:
+    """Return a list of problem strings (empty = in sync)."""
+    problems = []
+    doc_path = os.path.join(repo, "docs", "benchmarks.md")
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        return [f"{doc_path}: unreadable ({e})"]
+    artifacts = {
+        int(m.group(1))
+        for e in os.listdir(repo)
+        for m in [re.match(r"BENCH_r(\d+)\.json$", e)]
+        if m
+    }
+    rows = {}
+    for m in re.finditer(r"^\|\s*r(\d+)\s*\|([^|]*)\|", doc, re.M):
+        rows[int(m.group(1))] = m.group(2).strip()
+    for nn, cell in sorted(rows.items()):
+        claims_number = bool(re.search(r"\d", cell)) and "missing" not in cell
+        if claims_number and nn not in artifacts:
+            problems.append(
+                f"docs/benchmarks.md trajectory row r{nn:02d} claims "
+                f"{cell!r} but BENCH_r{nn:02d}.json is absent from the "
+                "repo root — record the artifact (bench.py --record) or "
+                "mark the row '*artifact missing*'"
+            )
+    for nn in sorted(artifacts - set(rows)):
+        problems.append(
+            f"BENCH_r{nn:02d}.json exists but docs/benchmarks.md has no "
+            f"r{nn:02d} trajectory row — bench.py --record appends it; "
+            "add the row for hand-recorded artifacts"
+        )
+    # the r06 gap covered BOTH halves: multichip claims are made by
+    # naming their artifact, so every MULTICHIP_rNN.json the docs cite
+    # must be in the repo too (a citation on a line that admits the
+    # artifact is missing is an honest documented gap)
+    for m in re.finditer(r"MULTICHIP_r(\d+)\.json", doc):
+        if os.path.isfile(os.path.join(repo, m.group(0))):
+            continue
+        # markdown wraps mid-sentence, so the honesty marker may sit on
+        # a neighboring line — search a window around the citation
+        window = doc[max(m.start() - 200, 0):m.end() + 200]
+        if re.search(r"missing|not exist|never", window, re.I):
+            continue
+        problems.append(
+            f"docs/benchmarks.md cites {m.group(0)} but the artifact "
+            "is absent from the repo root — check it in or mark the "
+            "citation '*artifact missing*'"
+        )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"FAIL  {p}")
+    if not problems:
+        print("OK    docs/benchmarks.md and BENCH_r*.json are in sync")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,13 @@ seconds, the action taken (``done`` / ``restart`` / ``resume_snapshot``
 dashboard ingests to tell "stalls on host X" from "crash-looping
 everywhere".
 
+FLEET MODE (``--worker-cmd``, see :func:`supervise_fleet`): the
+positional command is the LEARNER and each ``--worker-cmd`` launches a
+rollout-worker slot with per-role routing — workers survive learner
+relaunches (the membership-epoch re-attach handshake), a clean worker
+exit retires its slot, a crashing one relaunches with per-slot backoff
+and flap give-up.
+
 Usage:
     python scripts/supervise.py --checkpoint-dir ckpts -- \
         python examples/ppo_dense_sentiments.py
@@ -238,6 +245,221 @@ def supervise(
         delay = min(delay * 2, backoff_max_s)
 
 
+def supervise_fleet(
+    learner_cmd: List[str],
+    worker_cmds: List[List[str]],
+    checkpoint_dir: str,
+    ledger: Ledger,
+    max_restarts: int = 100,
+    backoff_s: float = 5.0,
+    backoff_max_s: float = 300.0,
+    flap_window_s: float = 60.0,
+    flap_limit: int = 3,
+    poll_s: float = 0.2,
+) -> int:
+    """Fleet mode (``--worker-cmd``): the learner and N rollout workers
+    run as sibling child processes with PER-ROLE exit-class routing.
+
+    learner   routed exactly like :func:`supervise` — clean stop ends
+              the fleet (workers are signalled, then terminated as the
+              backstop), stalled (87) relaunches from the newest
+              emergency snapshot, crash relaunches with backoff + flap
+              give-up. Workers are deliberately left RUNNING across a
+              learner relaunch: the relaunched learner bumps the
+              membership epoch and the surviving workers re-register
+              (the re-attach handshake), so a learner stall never costs
+              the fleet's warm compiles.
+    worker    exit 0 is honored (the learner's clean-finish flag, or a
+              worker-side ``max_chunks`` budget) — the slot is not
+              relaunched. Any other exit is a crash: relaunch with
+              per-slot doubling backoff; ``flap_limit`` rapid failures
+              in a row retires the SLOT (ledger ``gave_up``) instead of
+              the run — the learner degrades below ``fleet.min_workers``
+              on its own if too many slots retire.
+
+    Every decision lands in the same JSONL ledger with a ``role`` field
+    (``learner`` / ``worker-<i>``)."""
+    import signal
+
+    t_now = time.time
+    learner: Optional[subprocess.Popen] = None
+    workers: List[Optional[subprocess.Popen]] = [None] * len(worker_cmds)
+    wstate = [
+        {"streak": 0, "delay": backoff_s, "next_launch": 0.0,
+         "launched": 0.0, "retired": False, "attempt": 0}
+        for _ in worker_cmds
+    ]
+    l_attempt = 0
+    l_streak = 0
+    l_delay = backoff_s
+    l_next_launch = 0.0
+    l_launched = 0.0
+    resume_from: Optional[str] = None
+
+    def spawn_learner():
+        nonlocal learner, l_attempt, l_launched
+        env = dict(os.environ)
+        if resume_from:
+            env["TRLX_TPU_RESUME_FROM"] = resume_from
+        l_attempt += 1
+        l_launched = t_now()
+        learner = subprocess.Popen(learner_cmd, env=env)
+
+    def spawn_worker(i: int):
+        workers[i] = subprocess.Popen(worker_cmds[i], env=dict(os.environ))
+        wstate[i]["launched"] = t_now()
+        wstate[i]["attempt"] += 1
+
+    def stop_workers(sig=signal.SIGTERM, grace_s: float = 10.0):
+        for proc in workers:
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.send_signal(sig)
+                except OSError:
+                    pass
+        deadline = t_now() + grace_s
+        for proc in workers:
+            if proc is None:
+                continue
+            while proc.poll() is None and t_now() < deadline:
+                time.sleep(poll_s)
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()  # reap — an embedding caller must not leak zombies
+
+    try:
+        spawn_learner()
+        for i in range(len(worker_cmds)):
+            spawn_worker(i)
+        while True:
+            time.sleep(poll_s)
+            # -- learner routing (the run's fate) -----------------------
+            code = learner.poll() if learner is not None else None
+            if code is not None:
+                run_s = t_now() - l_launched
+                exit_class = classify(code)
+                record = {
+                    "role": "learner", "attempt": l_attempt,
+                    "exit_code": int(code), "exit_class": exit_class,
+                    "run_s": round(run_s, 3), "resume_from": resume_from,
+                }
+                resume_from = None
+                learner = None
+                if exit_class == "clean":
+                    ledger.append({**record, "action": "done"})
+                    print("supervise: learner finished cleanly; "
+                          "stopping the worker fleet")
+                    stop_workers()
+                    return 0
+                if run_s >= flap_window_s:
+                    l_streak, l_delay = 0, backoff_s
+                else:
+                    l_streak += 1
+                if l_streak >= flap_limit or l_attempt >= max_restarts + 1:
+                    reason = (
+                        f"{l_streak} rapid learner failures in a row"
+                        if l_streak >= flap_limit
+                        else f"restart budget exhausted ({max_restarts})"
+                    )
+                    ledger.append(
+                        {**record, "action": "gave_up", "reason": reason}
+                    )
+                    print(f"supervise: giving up ({reason}); stopping "
+                          "the worker fleet", file=sys.stderr)
+                    stop_workers()
+                    return 1
+                if exit_class == "stalled":
+                    resume_from = latest_emergency_snapshot(checkpoint_dir)
+                    ledger.append({
+                        **record,
+                        "action": "resume_snapshot" if resume_from
+                        else "restart",
+                        "snapshot": resume_from, "backoff_s": 0.0,
+                    })
+                    l_next_launch = t_now()
+                else:
+                    ledger.append({
+                        **record, "action": "restart",
+                        "backoff_s": round(l_delay, 3),
+                    })
+                    l_next_launch = t_now() + l_delay
+                    l_delay = min(l_delay * 2, backoff_max_s)
+                print(
+                    f"supervise: learner exit {code} ({exit_class}); "
+                    "relaunching with the worker fleet left attached",
+                    file=sys.stderr,
+                )
+            if learner is None and t_now() >= l_next_launch:
+                spawn_learner()
+            # -- worker routing (per-slot) ------------------------------
+            for i, proc in enumerate(workers):
+                st = wstate[i]
+                if proc is not None:
+                    wcode = proc.poll()
+                    if wcode is None:
+                        continue
+                    run_s = t_now() - st["launched"]
+                    workers[i] = None
+                    record = {
+                        "role": f"worker-{i}", "attempt": st["attempt"],
+                        "exit_code": int(wcode),
+                        "exit_class": "clean" if wcode == 0 else "crash",
+                        "run_s": round(run_s, 3),
+                    }
+                    if wcode == 0:
+                        # clean worker exit = the learner's shutdown
+                        # flag or a worker-side budget; not an outage
+                        ledger.append({**record, "action": "done"})
+                        st["retired"] = True
+                        continue
+                    if run_s >= flap_window_s:
+                        st["streak"], st["delay"] = 0, backoff_s
+                    else:
+                        st["streak"] += 1
+                    if st["streak"] >= flap_limit:
+                        ledger.append({
+                            **record, "action": "gave_up",
+                            "reason": (
+                                f"{st['streak']} rapid failures in a "
+                                "row — slot retired (the learner "
+                                "degrades below fleet.min_workers on "
+                                "its own)"
+                            ),
+                        })
+                        st["retired"] = True
+                        continue
+                    ledger.append({
+                        **record, "action": "restart",
+                        "backoff_s": round(st["delay"], 3),
+                    })
+                    st["next_launch"] = t_now() + st["delay"]
+                    st["delay"] = min(st["delay"] * 2, backoff_max_s)
+                elif not st["retired"] and t_now() >= st["next_launch"]:
+                    spawn_worker(i)
+    except KeyboardInterrupt:
+        print("supervise: interrupted — stopping learner + workers",
+              file=sys.stderr)
+        if learner is not None and learner.poll() is None:
+            learner.terminate()
+            try:
+                learner.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                learner.kill()
+                learner.wait()
+        stop_workers()
+        return 130
+    except BaseException:
+        # a failed spawn (bad worker command), a full-disk ledger write,
+        # anything unexpected: never leave the fleet running unmanaged
+        print("supervise: internal error — stopping learner + workers",
+              file=sys.stderr)
+        if learner is not None and learner.poll() is None:
+            learner.kill()
+            learner.wait()
+        stop_workers()
+        raise
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__,
@@ -273,6 +495,21 @@ def main(argv=None) -> int:
         help="rapid failures in a row before the supervisor gives up",
     )
     parser.add_argument(
+        "--worker-cmd", action="append", default=[],
+        help="FLEET MODE: a rollout-worker command (shell-quoted "
+             "string; '{i}' expands to the slot index), repeatable — "
+             "one slot per flag. The positional command becomes the "
+             "LEARNER; workers get per-role exit routing (clean = "
+             "retire slot, crash = per-slot backoff + flap give-up) "
+             "and survive learner relaunches for the membership-epoch "
+             "re-attach handshake",
+    )
+    parser.add_argument(
+        "--workers", type=int, default=0,
+        help="with a single --worker-cmd: replicate it into this many "
+             "slots (each formatting '{i}' with its index)",
+    )
+    parser.add_argument(
         "command", nargs=argparse.REMAINDER,
         help="the training command, after a literal --",
     )
@@ -286,6 +523,31 @@ def main(argv=None) -> int:
         args.ledger
         or os.path.join(args.checkpoint_dir, "run_ledger.jsonl")
     )
+    if args.worker_cmd:
+        import shlex
+
+        worker_cmds = list(args.worker_cmd)
+        if args.workers > 0:
+            if len(worker_cmds) != 1:
+                parser.error(
+                    "--workers N replicates exactly one --worker-cmd"
+                )
+            worker_cmds = worker_cmds * args.workers
+        return supervise_fleet(
+            command,
+            # plain replace, not str.format: a literal brace in the
+            # worker command (JSON overrides, shell syntax) must pass
+            # through — only the documented '{i}' token expands
+            [shlex.split(cmd.replace("{i}", str(i)))
+             for i, cmd in enumerate(worker_cmds)],
+            checkpoint_dir=args.checkpoint_dir,
+            ledger=ledger,
+            max_restarts=args.max_restarts,
+            backoff_s=args.backoff,
+            backoff_max_s=args.backoff_max,
+            flap_window_s=args.flap_window,
+            flap_limit=args.flap_limit,
+        )
     return supervise(
         command,
         checkpoint_dir=args.checkpoint_dir,

@@ -104,6 +104,22 @@ def check_one(directory: str, deep: bool = False) -> list:
                     f"{eq.get('staleness_mode', 'reject')!r} (prompt "
                     f"cursor {state.get('prompt_batches_consumed')})"
                 )
+            # rollout fleet (trlx_tpu/fleet/): report the persisted
+            # membership epoch + broadcast version; the torn-commit
+            # invariant (exp cursor referencing a policy version the
+            # committed snapshot never broadcast) fails loudly through
+            # check_cursor_invariants below
+            fleet = state.get("fleet")
+            if isinstance(fleet, dict):
+                bver = fleet.get("broadcast_version")
+                print(
+                    f"NOTE  {directory}: rollout-fleet state — "
+                    f"membership epoch {fleet.get('membership_epoch')} "
+                    "(a relaunched learner re-attaches by bumping past "
+                    "it), broadcast policy version "
+                    f"{'none published' if bver in (None, -1) else bver}"
+                    f", publish cadence {fleet.get('broadcast_every', 1)}"
+                )
             problems.extend(
                 f"{state_fp}: {p}" for p in check_cursor_invariants(state)
             )

@@ -1,0 +1,607 @@
+"""Fault-tolerant rollout fleet (trlx_tpu/fleet/): membership leases,
+eviction and flap quarantine on a fake clock; versioned weight
+broadcast with manifest verification (corrupt snapshot rejected, prior
+version kept); exact serde round-trips; the below-min-workers degraded
+golden (fleet-enabled run == plain ``ppo.exp.enabled`` run BIT-EQUAL
+while the ``fleet`` guardrail signal trips); and a multi-process
+integration check — a real learner + 2 real worker processes, one
+killed mid-chunk by chaos, loss stream bit-identical to the fault-free
+exp baseline.
+
+Tier-1 budget: 65s (tests/test_marker_audit.py) — the shared exp
+baseline + degraded-golden learn() runs and the multi-process
+integration run (two cold jax worker processes, measured 32s serial)
+dominate; membership/broadcast/serde units are host-side. The same
+worker-kill scenario also runs against ``bench.py --chaos``'s fleet
+leg, where the full bit-equality acceptance gate lives.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from trlx_tpu.fleet import (
+    BroadcastCorrupt,
+    FleetConfig,
+    WeightBroadcast,
+    WorkerRegistry,
+)
+from trlx_tpu.fleet import serde
+from trlx_tpu.fleet.coordinator import FleetCoordinator
+from trlx_tpu.fleet.membership import (
+    read_membership,
+    shutdown_requested,
+    write_worker_record,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.dirname(os.path.abspath(__file__))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- config ------------------------------------------------------------
+
+
+def test_fleet_config_validation():
+    cfg = FleetConfig.from_dict({"enabled": True, "min_workers": 2})
+    assert cfg.enabled and cfg.min_workers == 2
+    assert FleetConfig.from_dict(None) == FleetConfig()
+    with pytest.raises(ValueError, match="unknown keys"):
+        FleetConfig.from_dict({"min_worker": 1})
+    with pytest.raises(ValueError, match="min_workers"):
+        FleetConfig.from_dict({"min_workers": 0})
+    with pytest.raises(ValueError, match="broadcast_every"):
+        FleetConfig.from_dict({"broadcast_every": 0})
+    assert FleetConfig(dir="/x").resolved_dir("ck") == "/x"
+    assert FleetConfig().resolved_dir("ck") == os.path.join("ck", "fleet")
+
+
+# -- membership: epochs, eviction, quarantine (fake clock) -------------
+
+
+def test_membership_epoch_handshake_and_eviction(tmp_path):
+    clock = FakeClock()
+    root = str(tmp_path)
+    reg = WorkerRegistry(root, worker_ttl_s=5.0, clock=clock)
+    assert reg.open_epoch("learner-a") == 1
+    write_worker_record(root, "w0", 1, 0, clock=clock)
+    write_worker_record(root, "w1", 1, 0, clock=clock)
+    assert reg.live_workers() == ["w0", "w1"]
+    # a beat within the TTL keeps a worker alive while the other ages out
+    clock.advance(4.0)
+    write_worker_record(root, "w1", 1, 0, clock=clock)
+    clock.advance(2.0)  # w0 silent 6s > ttl, w1 silent 2s
+    assert reg.evict_silent() == ["w0"]
+    assert reg.live_workers() == ["w1"]
+    assert reg.stats["evictions"] == 1
+    # learner relaunch: the epoch bumps, surviving workers re-register
+    reg2 = WorkerRegistry(root, worker_ttl_s=5.0, clock=clock)
+    assert reg2.open_epoch("learner-b") == 2
+    assert read_membership(root)["epoch"] == 2
+    assert reg2.live_workers() == []  # w1's record carries epoch 1
+    write_worker_record(root, "w1", 2, 0, clock=clock)
+    assert reg2.live_workers() == ["w1"]
+    # stale-epoch leftovers are GC'd silently, not flap-tracked
+    write_worker_record(root, "w9", 1, 0, clock=clock)
+    clock.advance(6.0)
+    evicted = reg2.evict_silent()
+    assert "w9" not in evicted
+    assert "w9" not in reg2.worker_records()
+
+
+def test_flap_quarantine_backoff_doubles_and_readmits(tmp_path):
+    clock = FakeClock()
+    root = str(tmp_path)
+    reg = WorkerRegistry(
+        root, worker_ttl_s=5.0, flap_limit=2, flap_backoff_s=10.0,
+        clock=clock,
+    )
+    reg.open_epoch()
+
+    def flap():
+        write_worker_record(root, "w0", reg.epoch, 0, clock=clock)
+        assert reg.evict("w0", "test flap")
+
+    flap()
+    assert not reg.is_quarantined("w0")  # streak 1 < flap_limit 2
+    flap()
+    assert reg.is_quarantined("w0")  # streak 2: quarantined 10s
+    assert reg.stats["quarantines"] == 1
+    write_worker_record(root, "w0", reg.epoch, 0, clock=clock)
+    assert reg.live_workers() == []  # beating but excluded
+    clock.advance(10.5)
+    assert not reg.is_quarantined("w0")  # expiry = re-admission
+    assert reg.stats["readmissions"] == 1
+    write_worker_record(root, "w0", reg.epoch, 0, clock=clock)  # next beat
+    assert reg.live_workers() == ["w0"]
+    # the NEXT quarantine doubles the backoff (streak restarted at 0)
+    flap()
+    flap()
+    with open(os.path.join(root, "quarantine", "w0.json")) as f:
+        assert f and json.load(f)["backoff_s"] == 20.0
+
+
+def test_flap_streak_resets_on_healthy_delivery(tmp_path):
+    """'flap_limit evictions in a row' means CONSECUTIVE: a consumed
+    delivery between evictions breaks the streak, so unrelated
+    transient evictions spread over a long healthy run never
+    accumulate into a quarantine."""
+    clock = FakeClock()
+    root = str(tmp_path)
+    reg = WorkerRegistry(
+        root, worker_ttl_s=5.0, flap_limit=2, flap_backoff_s=10.0,
+        clock=clock,
+    )
+    reg.open_epoch()
+    write_worker_record(root, "w0", 1, 0, clock=clock)
+    assert reg.evict("w0", "blip 1")
+    reg.note_healthy("w0")  # a delivery landed in between
+    write_worker_record(root, "w0", 1, 0, clock=clock)
+    assert reg.evict("w0", "blip 2")
+    assert not reg.is_quarantined("w0")  # 1+1 nonconsecutive != 2 in a row
+    write_worker_record(root, "w0", 1, 0, clock=clock)
+    assert reg.evict("w0", "blip 3")
+    assert reg.is_quarantined("w0")  # 2 in a row WITHOUT a delivery
+
+
+def test_shutdown_flag_cleared_on_reattach(tmp_path):
+    clock = FakeClock()
+    root = str(tmp_path)
+    reg = WorkerRegistry(root, worker_ttl_s=5.0, clock=clock)
+    reg.open_epoch()
+    reg.shutdown("done")
+    assert shutdown_requested(root)
+    # a NEW learner attaching must not inherit the old clean-finish flag
+    # (re-attached workers would exit instead of serving)
+    reg2 = WorkerRegistry(root, worker_ttl_s=5.0, clock=clock)
+    reg2.open_epoch()
+    assert not shutdown_requested(root)
+
+
+# -- weight broadcast --------------------------------------------------
+
+
+def _arrays(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.standard_normal((3, 4)).astype(np.float32),
+        "b": rng.standard_normal(4).astype(np.float32),
+    }
+
+
+def test_broadcast_publish_fetch_roundtrip_and_retention(tmp_path):
+    wb = WeightBroadcast(str(tmp_path), keep=2)
+    for v in range(3):
+        wb.publish(v, _arrays(v))
+    assert wb.current_version() == 2
+    version, got = wb.fetch()
+    assert version == 2
+    for k, v in _arrays(2).items():
+        np.testing.assert_array_equal(got[k], v)  # bit-exact round-trip
+    names = sorted(e for e in os.listdir(str(tmp_path)) if e.startswith("v"))
+    assert names == ["v00000001", "v00000002"]  # keep=2 reaped v0
+
+
+def test_broadcast_corrupt_rejected_and_counted(tmp_path):
+    from trlx_tpu.utils.chaos import ChaosMonkey
+
+    wb = WeightBroadcast(str(tmp_path), keep=2)
+    path = wb.publish(7, _arrays())
+    # the chaos body flips one bit in the LANDED snapshot — past the
+    # atomic publish, so only manifest verification can catch it
+    assert ChaosMonkey({"seed": 0}).corrupt_broadcast(path)
+    with pytest.raises(BroadcastCorrupt):
+        wb.fetch()
+    assert wb.stats["corrupt_rejected"] == 1
+    # a clean re-publish of the next version recovers the channel
+    wb.publish(8, _arrays(1))
+    version, _ = wb.fetch()
+    assert version == 8
+
+
+# -- serde: everything that crosses the process boundary is exact ------
+
+
+def test_serde_rng_snapshot_and_rollout_roundtrip():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.data import PPORolloutBatch
+    from trlx_tpu.ops.common import running_moments_init
+
+    key = jax.random.PRNGKey(3)
+    back = serde.unpack_rng(serde.pack_rng(key), key)
+    assert jnp.array_equal(
+        jax.random.key_data(back)
+        if jnp.issubdtype(back.dtype, jax.dtypes.prng_key) else back,
+        jax.random.key_data(key)
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key) else key,
+    )
+    snap = {
+        "rng": key,
+        "running_moments": running_moments_init(),
+        "ref_mean": 0.25,
+        "ref_std": None,
+    }
+    wire = json.loads(json.dumps(serde.snapshot_to_wire(snap)))  # JSON-safe
+    back = serde.snapshot_from_wire(wire, key)
+    assert float(back["running_moments"].count) == float(
+        snap["running_moments"].count
+    )
+    assert back["ref_mean"] == 0.25 and back["ref_std"] is None
+    rb = PPORolloutBatch(
+        query_tensors=jnp.arange(6, dtype=jnp.int32).reshape(2, 3),
+        response_tensors=jnp.arange(4, dtype=jnp.int32).reshape(2, 2),
+        logprobs=jnp.asarray([[0.1, -0.2], [0.3, -0.4]], jnp.float32),
+        values=jnp.zeros((2, 2), jnp.float32),
+        rewards=jnp.ones((2, 2), jnp.float32),
+        response_mask=jnp.ones((2, 2), jnp.int32),
+    )
+    back = serde.rollout_from_arrays(serde.rollout_to_arrays(rb))
+    for name in ("query_tensors", "logprobs", "rewards"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(back, name)), np.asarray(getattr(rb, name))
+        )
+    assert back.is_weight is None  # absent leaf stays absent
+
+
+def test_serde_params_roundtrip_and_drift_detection():
+    import jax.numpy as jnp
+
+    params = {
+        "h": {"attn": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)}},
+        "ln": {"b": jnp.ones(3, jnp.float32)},
+    }
+    arrays = serde.params_to_arrays(params)
+    back = serde.load_params_like(params, arrays)
+    np.testing.assert_array_equal(
+        np.asarray(back["h"]["attn"]["w"]),
+        np.asarray(params["h"]["attn"]["w"]),
+    )
+    with pytest.raises(KeyError, match="different models"):
+        serde.load_params_like(
+            {"h": params["h"], "extra": jnp.zeros(1)}, arrays
+        )
+    bad = dict(arrays)
+    bad[serde._jax().tree_util.keystr(
+        serde._jax().tree_util.tree_flatten_with_path(params)[0][0][0]
+    )] = np.zeros((9, 9), np.float32)
+    with pytest.raises(ValueError, match="shape"):
+        serde.load_params_like(params, bad)
+
+
+# -- coordinator: dispatch/poll/clear, attempts, degrade latch ---------
+
+
+def test_coordinator_dispatch_poll_clear_and_attempts(tmp_path):
+    clock = FakeClock()
+    cfg = FleetConfig.from_dict({"enabled": True})
+    fc = FleetCoordinator(cfg, str(tmp_path), owner="learner", clock=clock)
+    assert fc.membership_epoch == 1
+    chunk_id = (0, 1)
+    assert fc.next_attempt(chunk_id) == 1
+    assert fc.next_attempt(chunk_id) == 2  # every dispatch is unique
+    fc.dispatch(chunk_id, 2, "w0", {"iter_count": 0}, {"x": np.zeros(2)})
+    assert fc.poll_delivery(chunk_id) is None  # dispatched != delivered
+    # worker side reads the assignment and commits a delivery
+    msg = serde.read_message_dir(
+        os.path.join(str(tmp_path), "dispatch", "e0_s1_a2"),
+        meta_name="assignment.json",
+    )
+    assert msg is not None and msg[0]["worker"] == "w0"
+    assert serde.commit_message_dir(
+        os.path.join(str(tmp_path), "chunks", "e0_s1"),
+        {"chunk_id": [0, 1]}, {"y": np.ones(3)}, meta_name="chunk.json",
+    )
+    meta, arrays = fc.poll_delivery(chunk_id)
+    assert meta["chunk_id"] == [0, 1]
+    np.testing.assert_array_equal(arrays["y"], np.ones(3))
+    # a duplicate delivery (partitioned worker's late attempt) dedups
+    assert not serde.commit_message_dir(
+        os.path.join(str(tmp_path), "chunks", "e0_s1"),
+        {"chunk_id": [0, 1]}, {"y": np.zeros(3)}, meta_name="chunk.json",
+    )
+    fc.clear_chunk(chunk_id)
+    assert fc.poll_delivery(chunk_id) is None
+    assert not os.path.isdir(
+        os.path.join(str(tmp_path), "dispatch", "e0_s1_a2")
+    )
+    # clear_delivery drops ONLY the payload (a late delivery from an
+    # abandoned attempt) — the outstanding assignment must survive so
+    # the currently-assigned worker isn't stranded
+    fc.dispatch(chunk_id, 3, "w1", {"iter_count": 0}, {"x": np.zeros(2)})
+    serde.commit_message_dir(
+        os.path.join(str(tmp_path), "chunks", "e0_s1"),
+        {"chunk_id": [0, 1], "attempt": 2}, {"y": np.ones(3)},
+        meta_name="chunk.json",
+    )
+    fc.clear_delivery(chunk_id)
+    assert fc.poll_delivery(chunk_id) is None
+    assert os.path.isdir(os.path.join(str(tmp_path), "dispatch", "e0_s1_a3"))
+
+
+def test_coordinator_republish_after_restore(tmp_path):
+    """Guardrail-rollback regression: an in-process restore can move
+    the policy version BACKWARDS; without reset_published the publish
+    cursor would stay ahead and ensure_published would never
+    rebroadcast — workers would keep generating with the
+    rolled-back-over weights, admitted as non-stale (their version
+    reads newer than the learner's). ``_restore_extra_state`` calls
+    reset_published so the restored params republish."""
+    cfg = FleetConfig.from_dict({"enabled": True})
+    fc = FleetCoordinator(cfg, str(tmp_path), clock=FakeClock())
+    fc.ensure_published(5, lambda: _arrays(5))
+    assert fc.broadcast.current_version() == 5
+    fc.ensure_published(2, lambda: _arrays(2))  # cursor ahead: skipped
+    assert fc.broadcast.current_version() == 5
+    fc.reset_published()
+    fc.ensure_published(2, lambda: _arrays(2))
+    version, got = fc.broadcast.fetch()
+    assert version == 2
+    np.testing.assert_array_equal(got["w"], _arrays(2)["w"])
+
+
+def test_coordinator_degrade_latch_and_round_robin(tmp_path):
+    clock = FakeClock()
+    cfg = FleetConfig.from_dict({"enabled": True})
+    fc = FleetCoordinator(cfg, str(tmp_path), clock=clock)
+    # one guardrail trip per healthy->degraded transition, not per call
+    assert fc.note_degraded("no workers")
+    assert not fc.note_degraded("still none")
+    fc.note_recovered()
+    assert fc.note_degraded("down again")
+    assert fc.stats["degradations"] == 2 and fc.stats["recoveries"] == 1
+    for wid in ("w0", "w1", "w2"):
+        write_worker_record(str(tmp_path), wid, 1, 0, clock=clock)
+    picks = {fc.select_worker() for _ in range(6)}
+    assert picks == {"w0", "w1", "w2"}  # round-robin covers the set
+    assert fc.select_worker(exclude=("w0", "w1")) == "w2"
+    assert fc.select_worker(exclude=("w0", "w1", "w2")) is None
+
+
+# -- state.json invariants ---------------------------------------------
+
+
+def test_fleet_state_torn_commit_invariants():
+    from trlx_tpu.utils.checkpointing import check_cursor_invariants
+
+    def state(fleet):
+        return {
+            "iter_count": 4,
+            "prompt_batches_consumed": 3,
+            "exp_queue": {"epoch": 0, "cursor": 2, "policy_version": 5},
+            "fleet": fleet,
+        }
+
+    ok = {"membership_epoch": 2, "broadcast_version": 5,
+          "broadcast_every": 1}
+    assert not check_cursor_invariants(state(ok))
+    # never-published (-1) is a legal young-run state
+    assert not check_cursor_invariants(state(
+        {"membership_epoch": 1, "broadcast_version": -1,
+         "broadcast_every": 1}
+    ))
+    # a snapshot NEWER than the policy the cursor references is torn
+    probs = check_cursor_invariants(state(
+        {"membership_epoch": 2, "broadcast_version": 7,
+         "broadcast_every": 1}
+    ))
+    assert any("NEWER" in p for p in probs)
+    # a cursor policy version further past the committed broadcast than
+    # the publish cadence allows is torn too
+    probs = check_cursor_invariants(state(
+        {"membership_epoch": 2, "broadcast_version": 2,
+         "broadcast_every": 2}
+    ))
+    assert any("torn commit" in p for p in probs)
+    probs = check_cursor_invariants(state(
+        {"membership_epoch": 0, "broadcast_version": 5,
+         "broadcast_every": 1}
+    ))
+    assert any("membership_epoch" in p for p in probs)
+
+
+# -- learn()-level: degraded golden + multi-process integration --------
+
+
+def _tiny_config(ckpt_dir, fleet=None, chaos=None, guardrails=None):
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    return default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=3, eval_interval=100,
+            checkpoint_interval=100, seq_length=24, epochs=64,
+            tracker="jsonl", checkpoint_dir=ckpt_dir, save_best=False,
+            guardrails=guardrails or {}, chaos=chaos,
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    vocab_size=258, hidden_size=32, n_layer=2, n_head=2,
+                    n_positions=64,
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            # overlap off so EVERY chunk routes through the fleet seam
+            # (the cycle prefetch is generated learner-side by design)
+            overlap_rollouts=False,
+            exp=dict(enabled=True), fleet=fleet or {},
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                            do_sample=True),
+        ),
+    )
+
+
+PROMPTS = ["hello world", "the cat", "a b", "xyz",
+           "what is", "I am", "go", "ok"]
+
+
+def _reward(samples, prompts, outputs, **kw):
+    return [float(len(o.split())) for o in outputs]
+
+
+def _stream_and_store(trainer, ckpt_dir):
+    with open(os.path.join(ckpt_dir, "logs", "metrics.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    stream = [
+        {k: v for k, v in r.items()
+         if k.startswith("losses/") or k == "reward/mean"}
+        for r in recs
+    ]
+    store = None
+    if trainer.store.history is not None:
+        store = {
+            "queries": np.asarray(trainer.store.history.query_tensors),
+            "responses": np.asarray(trainer.store.history.response_tensors),
+            "logprobs": np.asarray(trainer.store.history.logprobs),
+            "rewards": np.asarray(trainer.store.history.rewards),
+        }
+    return [s for s in stream if s], store
+
+
+def _run_tiny(ckpt_dir, fleet=None, chaos=None, guardrails=None):
+    import trlx_tpu
+
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    trainer = trlx_tpu.train(
+        reward_fn=_reward, prompts=PROMPTS,
+        config=_tiny_config(ckpt_dir, fleet=fleet, chaos=chaos,
+                            guardrails=guardrails),
+    )
+    return trainer, *_stream_and_store(trainer, ckpt_dir)
+
+
+@pytest.fixture(scope="module")
+def exp_baseline(tmp_path_factory):
+    """One fault-free ``ppo.exp.enabled`` run shared by the golden
+    checks below — the reference stream every fleet path must match."""
+    ckpt = str(tmp_path_factory.mktemp("fleet_baseline") / "ck")
+    _, stream, store = _run_tiny(ckpt)
+    return stream, store
+
+
+def test_below_min_workers_degrades_golden(exp_baseline, tmp_path):
+    """A fleet that never comes up: the startup wait times out, the
+    ``fleet`` guardrail signal trips ONCE, production falls back to the
+    in-process path — and the run is bit-equal to the fleet-less one."""
+    stream_ff, store_ff = exp_baseline
+    ckpt = str(tmp_path / "degraded")
+    trainer, stream, store = _run_tiny(
+        ckpt,
+        fleet=dict(enabled=True, min_workers=1, startup_timeout_s=0.3,
+                   poll_s=0.02),
+        guardrails=dict(enabled=True, loss_spike_sigma=0.0),
+    )
+    assert trainer.iter_count >= 3
+    assert trainer.guardrails.trip_history.count("fleet") == 1
+    summary = trainer._fleet.stats_summary()
+    assert summary["degradations"] == 1 and summary["dispatched"] == 0
+    assert stream == stream_ff, (
+        f"degraded fleet run diverged from the fleet-less exp run:\n"
+        f"{stream_ff}\n{stream}"
+    )
+    for key in store_ff:
+        np.testing.assert_array_equal(store_ff[key], store[key], err_msg=key)
+    # the membership epoch + broadcast version rode the atomic commit
+    with open(os.path.join(ckpt, "checkpoint_3", "state.json")) as f:
+        state = json.load(f)
+    assert state["fleet"]["membership_epoch"] == 1
+    assert state["fleet"]["broadcast_version"] >= 0
+
+
+def test_fleet_requires_exp_transport(tmp_path):
+    import trlx_tpu
+
+    with pytest.raises(ValueError, match="requires ppo.exp.enabled"):
+        config = _tiny_config(
+            str(tmp_path / "noexp"), fleet=dict(enabled=True)
+        ).evolve(method=dict(exp=dict(enabled=False)))
+        trlx_tpu.train(reward_fn=_reward, prompts=PROMPTS, config=config)
+
+
+WORKER_CHILD = """
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+from test_fleet import _tiny_config, _reward
+from trlx_tpu.fleet.worker import run_worker
+
+ckpt, worker_id = sys.argv[1], sys.argv[2]
+chaos = json.loads(sys.argv[3]) if len(sys.argv) > 3 else None
+config = _tiny_config(ckpt, fleet={fleet!r}, chaos=chaos)
+sys.exit(run_worker(config, _reward, worker_id=worker_id))
+"""
+
+_INTEGRATION_FLEET = dict(
+    enabled=True, min_workers=1, startup_timeout_s=90.0,
+    worker_ttl_s=3.0, poll_s=0.05, attach_timeout_s=120.0,
+)
+
+
+def test_fleet_multiprocess_worker_kill_bit_identical(
+    exp_baseline, tmp_path
+):
+    """The tentpole end to end: a real learner process (this one) + two
+    real worker processes; chaos hard-kills worker 0 mid-chunk
+    (generation done, scoring pending). The learner must evict it on
+    the membership TTL, re-dispatch the chunk to worker 1 with the
+    replay snapshot, and finish with a loss stream bit-identical to the
+    fault-free exp baseline. (Also proven by ``bench.py --chaos``'s
+    fleet leg, which is the acceptance gate for this scenario.)"""
+    ckpt = str(tmp_path / "mp")
+    shutil.rmtree(ckpt, ignore_errors=True)
+    child = tmp_path / "worker_child.py"
+    child.write_text(WORKER_CHILD.format(
+        repo=REPO, tests=TESTS, fleet=_INTEGRATION_FLEET,
+    ))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(child), ckpt, "w0",
+             json.dumps(dict(seed=0, faults=[
+                 {"fault": "fleet_worker_death", "at": 1}]))],
+            env=env,
+        ),
+        subprocess.Popen([sys.executable, str(child), ckpt, "w1"], env=env),
+    ]
+    try:
+        trainer, stream, store = _run_tiny(ckpt, fleet=_INTEGRATION_FLEET)
+        codes = [p.wait(timeout=120) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    stream_ff, store_ff = exp_baseline
+    assert stream == stream_ff, (
+        f"fleet run under worker kill diverged from the fault-free exp "
+        f"baseline:\n{stream_ff}\n{stream}"
+    )
+    for key in store_ff:
+        np.testing.assert_array_equal(store_ff[key], store[key], err_msg=key)
+    summary = trainer._fleet.stats_summary()
+    assert summary["membership_evictions"] >= 1, summary
+    assert summary["redispatches"] >= 1, summary
+    assert summary["delivered"] >= 3, summary
+    assert summary["degradations"] == 0, summary
+    assert codes[0] == 3  # chaos os._exit(3) mid-chunk
+    assert codes[1] == 0  # clean exit on the learner's shutdown flag

@@ -29,15 +29,22 @@ TIER1_BUDGETS = {
     "test_configs.py": 5,
     "test_curves.py": 10,
     "test_deferred_stats.py": 5,
-    "test_elastic.py": 70,
+    # trimmed r08 against fresh serial measurements (same playbook as
+    # the r07 trim: measure the biggest budgets, reclaim the slack) to
+    # fit the fleet suite under the unchanged ceiling — elastic 33.8s,
+    # exp_queue 30.8s, gen_engine 37.5s, guardrails 57.3s measured
+    # 2026-08-03; fault_tolerance measured 93.0s and keeps its 90s+
+    # budget unchanged (it has no slack to reclaim)
+    "test_elastic.py": 45,
     "test_examples.py": 20,
-    "test_exp_queue.py": 70,
+    "test_exp_queue.py": 45,
     "test_fault_tolerance.py": 90,
     "test_flash_attention.py": 15,
-    "test_gen_engine.py": 60,
+    "test_fleet.py": 65,
+    "test_gen_engine.py": 50,
     "test_generation.py": 30,
     "test_golden.py": 10,
-    "test_guardrails.py": 75,
+    "test_guardrails.py": 65,
     "test_marker_audit.py": 2,
     "test_mcts_value_branch.py": 15,
     "test_models.py": 20,
@@ -84,6 +91,9 @@ TIER1_BUDGET_CEILING_S = 780
 LEARN_IN_TIER1_ALLOWLIST = {
     "test_elastic.py",          # resharded-resume / quarantine-fallback
     "test_exp_queue.py",        # exp-vs-direct golden needs two tiny learns
+    "test_fleet.py",            # fleet-vs-exp goldens (degraded +
+                                # multi-process worker-kill) are the
+                                # subject under test
     "test_fault_tolerance.py",  # kill/resume + chaos scenarios
     "test_guardrails.py",       # rollback/requeue under chaos
     "test_scanned_epochs.py",   # scanned-vs-looped golden equivalence
@@ -141,6 +151,25 @@ def test_total_budget_fits_tier1_timeout():
         "shrink a suite; raising the ceiling means renegotiating the "
         "870s tier-1 timeout in ROADMAP.md"
     )
+
+
+def test_bench_docs_and_artifacts_in_sync():
+    """The r06-gap closer (ISSUE 8 satellite): a trajectory row that
+    claims a number without its ``BENCH_rNN.json`` artifact — or an
+    artifact with no row — fails tier-1. ``bench.py --record`` writes
+    both in one step so they cannot drift."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_sync",
+        os.path.join(
+            os.path.dirname(TESTS_DIR), "scripts", "check_bench_sync.py"
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    problems = mod.check()
+    assert not problems, "\n".join(problems)
 
 
 def test_learn_loops_outside_allowlist_are_slow_marked():

@@ -129,6 +129,20 @@ class PPOConfig(MethodConfig):
     # (ROADMAP item 1): remote producers plug in behind the same
     # transport the in-process loop chaos-proves.
     exp: dict = field(default_factory=dict)
+    # Fault-tolerant rollout-worker fleet (trlx_tpu/fleet/): route
+    # chunk PRODUCTION to cross-process workers behind the transport
+    # seam — worker membership with heartbeat leases + membership
+    # epochs (a restarted learner re-attaches surviving workers),
+    # versioned weight broadcast with sha256 manifests (a corrupt
+    # snapshot is rejected and the previous version kept; stale chunks
+    # flow through exp.staleness), flap quarantine with doubling
+    # backoff, and degraded-mode fallback to the in-process path (the
+    # `fleet` guardrail signal) when live workers drop below
+    # fleet.min_workers. Parsed by fleet.config.FleetConfig (enabled/
+    # dir/min_workers/worker_ttl_s/flap_limit/...). Default {} =
+    # disabled; requires ppo.exp.enabled; fault-free it is golden-
+    # checked bit-equal to the in-process exp path.
+    fleet: dict = field(default_factory=dict)
 
     def get_advantages_and_returns(self, values, rewards, response_length, use_whitening=True):
         from trlx_tpu.ops.ppo import gae_advantages_and_returns

@@ -94,6 +94,14 @@ class ExperienceTransport:
     def heartbeat(self, lease: Lease) -> None:
         self.leases.heartbeat(lease.chunk_id)
 
+    def reassign(self, lease: Lease, producer: str) -> None:
+        """Relabel WHO is generating the leased chunk (the rollout
+        fleet: the learner keeps holding the lease on the worker's
+        behalf, but expiry logs and postmortems should name the worker
+        actually producing, not the learner process)."""
+        lease.owner = producer
+        self.stats["reassignments"] = self.stats.get("reassignments", 0) + 1
+
     def producer_died(self, lease: Lease) -> None:
         """The producer holding ``lease`` died mid-chunk (chaos
         ``worker_death_mid_lease``): its heartbeats stop; the lease

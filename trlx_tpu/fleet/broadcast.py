@@ -1,0 +1,150 @@
+"""Versioned weight broadcast: learner publishes, workers refresh.
+
+One snapshot per policy version under ``<fleet>/broadcast/``::
+
+    broadcast/
+      vNNNNNNNN/arrays.npz     path-keyed host copies of the params
+      vNNNNNNNN/meta.json      {"version": N, ...}
+      vNNNNNNNN/integrity.json per-file sha256 (the PR 4 machinery)
+      CURRENT.json             {"version": N, "path": "vNNNNNNNN"}
+
+Publication uses the checkpoint commit discipline: write into a temp
+directory, manifest + fsync, one atomic rename, THEN flip the CURRENT
+pointer — a learner dying mid-publish leaves the previous version
+intact and pointed-to. Consumption verifies the manifest BEFORE
+loading: a corrupt or torn snapshot (bit-rot, a half-replicated
+shared-filesystem read) is rejected and the worker KEEPS its previous
+version — broadcast failure degrades to off-policy data the
+``exp.staleness`` gate corrects, never to wrong weights.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.checkpointing import (
+    atomic_json_write,
+    fsync_tree,
+    verify_integrity,
+    write_integrity_manifest,
+)
+
+logger = logging.get_logger(__name__)
+
+CURRENT_FILE = "CURRENT.json"
+ARRAYS_FILE = "arrays.npz"
+META_FILE = "meta.json"
+
+
+class BroadcastCorrupt(RuntimeError):
+    """A published snapshot failed manifest verification on fetch."""
+
+
+def _version_name(version: int) -> str:
+    return f"v{int(version):08d}"
+
+
+class WeightBroadcast:
+    """Filesystem weight-snapshot channel (learner publishes, any
+    number of workers fetch). Host-side and jax-free: params arrive as
+    a path-keyed dict of numpy arrays (``fleet/serde.py`` converts)."""
+
+    def __init__(self, root: str, keep: int = 2):
+        self.root = root
+        self.keep = max(int(keep), 1)
+        os.makedirs(root, exist_ok=True)
+        self.stats: Dict[str, int] = {
+            "published": 0,
+            "fetched": 0,
+            "corrupt_rejected": 0,
+        }
+
+    # -- learner side -----------------------------------------------------
+
+    def publish(
+        self,
+        version: int,
+        arrays: Dict[str, np.ndarray],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Atomically publish ``arrays`` as snapshot ``version`` and
+        flip CURRENT to it. Returns the snapshot directory.
+        Re-publishing an existing version (learner relaunch resuming at
+        the same policy version) replaces it wholesale — the restored
+        params ARE that version; a leftover tree from the previous
+        incarnation may be torn."""
+        name = _version_name(version)
+        final = os.path.join(self.root, name)
+        tmp = os.path.join(self.root, f".tmp_{name}_{os.getpid()}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, ARRAYS_FILE), "wb") as f:
+            np.savez(f, **arrays)
+        atomic_json_write(
+            os.path.join(tmp, META_FILE),
+            {"version": int(version), **(meta or {})},
+        )
+        write_integrity_manifest(tmp)
+        fsync_tree(tmp)
+        if os.path.isdir(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        atomic_json_write(
+            os.path.join(self.root, CURRENT_FILE),
+            {"version": int(version), "path": name},
+        )
+        self.stats["published"] += 1
+        self._apply_retention()
+        logger.info(
+            "weight broadcast: published policy version %d (%s)",
+            version, final,
+        )
+        return final
+
+    def _apply_retention(self) -> None:
+        versions = sorted(
+            e for e in os.listdir(self.root)
+            if e.startswith("v") and e[1:].isdigit()
+        )
+        for stale in versions[: -self.keep]:
+            shutil.rmtree(
+                os.path.join(self.root, stale), ignore_errors=True
+            )
+
+    # -- worker side ------------------------------------------------------
+
+    def current_version(self) -> Optional[int]:
+        import json
+
+        try:
+            with open(os.path.join(self.root, CURRENT_FILE)) as f:
+                return int(json.load(f)["version"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def fetch(self) -> Tuple[int, Dict[str, np.ndarray]]:
+        """Load the CURRENT snapshot, manifest-verified first. Raises
+        :class:`BroadcastCorrupt` on mismatch (the caller keeps its
+        previous version and retries later) and ``FileNotFoundError``
+        when nothing is published yet."""
+        import json
+
+        with open(os.path.join(self.root, CURRENT_FILE)) as f:
+            cur = json.load(f)
+        directory = os.path.join(self.root, cur["path"])
+        status, problems = verify_integrity(directory)
+        if status != "ok":
+            self.stats["corrupt_rejected"] += 1
+            raise BroadcastCorrupt(
+                f"broadcast snapshot {directory} failed verification "
+                f"({status}): {problems[:3]}"
+            )
+        with np.load(os.path.join(directory, ARRAYS_FILE)) as z:
+            arrays = {k: z[k] for k in z.files}
+        self.stats["fetched"] += 1
+        return int(cur["version"]), arrays

@@ -1,0 +1,100 @@
+"""Parsed ``ppo.fleet`` section (plain dict in YAML).
+
+The fleet rides ON TOP of the experience transport (``ppo.exp.*``):
+``fleet.enabled`` routes chunk PRODUCTION to registered cross-process
+rollout workers, while delivery/dedup/staleness/cursor semantics stay
+the transport's. Everything here is host-side and jax-free.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """``ppo.fleet.*`` knobs (default off; requires ``ppo.exp.enabled``).
+
+    enabled            master switch: route chunk production to the
+                       cross-process rollout-worker fleet. Fault-free
+                       the fleet path is golden-checked bit-equal to
+                       the in-process ``ppo.exp.enabled`` path.
+    dir                fleet coordination directory (worker registry,
+                       weight broadcast, chunk dispatch/delivery) —
+                       must be shared between learner and workers.
+                       Empty = ``<train.checkpoint_dir>/fleet``.
+    min_workers        live workers below which the learner DEGRADES:
+                       the ``fleet`` guardrail signal trips and chunk
+                       production falls back to the in-process path
+                       (bit-equal to the fleet-less run) until workers
+                       return.
+    worker_ttl_s       seconds a worker's membership heartbeat may go
+                       silent before it is evicted, its in-flight
+                       chunk re-dispatched (replay snapshot intact),
+                       and a flap recorded.
+    startup_timeout_s  how long the learner's FIRST production waits
+                       for ``min_workers`` to register before
+                       degrading (a fleet that never comes up must not
+                       wedge the run).
+    dispatch_timeout_s hard bound on waiting for a single dispatched
+                       chunk before the learner degrades and produces
+                       it in-process (backstop behind eviction; the
+                       regeneration is bit-identical by the replay
+                       snapshot).
+    poll_s             poll (and watchdog-beat) cadence of the
+                       learner's bounded waits and the worker loop.
+    flap_limit         evictions/rejoins in a row before a worker is
+                       QUARANTINED (excluded from dispatch).
+    flap_backoff_s     first quarantine duration; doubles per repeat
+                       quarantine of the same worker.
+    broadcast_every    publish a weight snapshot every N policy
+                       versions (1 = every optimizer cycle). Workers
+                       between publishes generate with the previous
+                       version; the chunks flow through the
+                       ``exp.staleness`` gate like any stale delivery.
+    broadcast_keep     published snapshot versions retained on disk
+                       (the previous version is what a worker keeps
+                       when a fresh snapshot fails manifest
+                       verification).
+    attach_timeout_s   how long a WORKER waits for the learner's
+                       membership record to appear before giving up.
+    """
+
+    enabled: bool = False
+    dir: str = ""
+    min_workers: int = 1
+    worker_ttl_s: float = 30.0
+    startup_timeout_s: float = 20.0
+    dispatch_timeout_s: float = 600.0
+    poll_s: float = 0.05
+    flap_limit: int = 3
+    flap_backoff_s: float = 5.0
+    broadcast_every: int = 1
+    broadcast_keep: int = 2
+    attach_timeout_s: float = 120.0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FleetConfig":
+        d = dict(d or {})
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"ppo.fleet: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        cfg = cls(**d)
+        if cfg.min_workers < 1:
+            raise ValueError("fleet.min_workers must be >= 1")
+        if cfg.worker_ttl_s <= 0:
+            raise ValueError("fleet.worker_ttl_s must be > 0")
+        if cfg.flap_limit < 1:
+            raise ValueError("fleet.flap_limit must be >= 1")
+        if cfg.broadcast_every < 1:
+            raise ValueError("fleet.broadcast_every must be >= 1")
+        return cfg
+
+    def resolved_dir(self, checkpoint_dir: str) -> str:
+        return self.dir or os.path.join(checkpoint_dir, "fleet")

@@ -1,0 +1,262 @@
+"""Learner-side fleet coordinator: dispatch, collect, degrade.
+
+Sits between the PPO trainer's experience-transport loop and the
+cross-process worker fleet. The trainer keeps owning the transport
+lease for every chunk; the coordinator turns "produce this chunk" into
+a dispatch message a registered worker executes, watches the worker's
+membership heartbeats while it runs, and hands the delivered payload
+back. A silent worker is evicted (flap-tracked, quarantined past
+``fleet.flap_limit``) and the chunk re-dispatched with the SAME replay
+snapshot — regeneration is bit-identical, so a worker death is
+invisible in the consumed stream. When the live fleet falls below
+``fleet.min_workers`` the coordinator reports DEGRADED and the trainer
+falls back to in-process production (the ``fleet`` guardrail signal
+trips once per transition).
+
+Message layout under the fleet dir (all atomic-rename commits,
+``fleet/serde.py``)::
+
+    dispatch/e{epoch}_s{seq}_a{attempt}/   assignment for one worker
+    chunks/e{epoch}_s{seq}/                the delivered chunk payload
+
+Delivery is naturally deduplicating: the chunk dir name carries no
+attempt, so whichever attempt's rename lands first wins and the other
+drops itself (both are bit-identical by the replay contract anyway).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from trlx_tpu.fleet.broadcast import WeightBroadcast
+from trlx_tpu.fleet.config import FleetConfig
+from trlx_tpu.fleet.membership import WorkerRegistry
+from trlx_tpu.fleet import serde
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+DISPATCH_DIR = "dispatch"
+CHUNKS_DIR = "chunks"
+BROADCAST_DIR = "broadcast"
+
+
+def chunk_name(chunk_id: Tuple[int, int]) -> str:
+    return f"e{int(chunk_id[0])}_s{int(chunk_id[1])}"
+
+
+class FleetCoordinator:
+    def __init__(
+        self,
+        cfg: FleetConfig,
+        root: str,
+        owner: str = "learner",
+        clock: Callable[[], float] = time.time,
+    ):
+        self.cfg = cfg
+        self.root = root
+        self._clock = clock
+        os.makedirs(os.path.join(root, DISPATCH_DIR), exist_ok=True)
+        os.makedirs(os.path.join(root, CHUNKS_DIR), exist_ok=True)
+        self.registry = WorkerRegistry(
+            root,
+            worker_ttl_s=cfg.worker_ttl_s,
+            flap_limit=cfg.flap_limit,
+            flap_backoff_s=cfg.flap_backoff_s,
+            clock=clock,
+        )
+        self.broadcast = WeightBroadcast(
+            os.path.join(root, BROADCAST_DIR), keep=cfg.broadcast_keep
+        )
+        # the attach handshake: bump the membership epoch so surviving
+        # workers from a previous learner incarnation re-register
+        self.membership_epoch = self.registry.open_epoch(owner)
+        self.degraded = False
+        self._waited_startup = False
+        self._published_version: Optional[int] = None
+        self._rr = 0  # round-robin cursor over the live set
+        # per-chunk dispatch-attempt counter: every dispatch (first try,
+        # eviction re-dispatch, staleness regeneration) gets a fresh
+        # attempt number, so assignment dirs never collide and "highest
+        # attempt wins" stays well-defined on the worker side
+        self._attempts: Dict[str, int] = {}
+        self.stats: Dict[str, int] = {
+            "dispatched": 0,
+            "delivered": 0,
+            "redispatches": 0,
+            "degradations": 0,
+            "recoveries": 0,
+        }
+
+    # -- weight broadcast -------------------------------------------------
+
+    def ensure_published(
+        self,
+        version: int,
+        arrays_fn: Callable[[], Dict[str, np.ndarray]],
+        post_publish: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        """Publish the policy snapshot for ``version`` if due
+        (``fleet.broadcast_every`` versions since the last publish).
+        ``post_publish(path)`` is the chaos seam (``broadcast_corrupt``
+        bit-flips the landed snapshot)."""
+        if self._published_version is not None and (
+            version - self._published_version < self.cfg.broadcast_every
+        ):
+            return
+        path = self.broadcast.publish(version, arrays_fn())
+        self._published_version = version
+        if post_publish is not None:
+            post_publish(path)
+
+    def reset_published(self) -> None:
+        """Forget the publish cursor. An in-process restore (guardrail
+        rollback, explicit load) can move the policy version BACKWARDS;
+        a cursor left ahead of it would make ensure_published skip
+        forever and workers would keep generating with the discarded
+        weights — admitted as non-stale, since their version reads as
+        newer. The next ensure_published republishes unconditionally
+        (publish() replaces a leftover same-version tree wholesale:
+        the restored params ARE that version)."""
+        self._published_version = None
+
+    @property
+    def broadcast_version(self) -> Optional[int]:
+        return self._published_version
+
+    # -- membership-facing helpers ---------------------------------------
+
+    def live_workers(self) -> List[str]:
+        return self.registry.live_workers()
+
+    def select_worker(self, exclude: Tuple[str, ...] = ()) -> Optional[str]:
+        """Round-robin over the live, non-excluded set (excluded = the
+        worker(s) already tried for this chunk)."""
+        live = [w for w in self.live_workers() if w not in exclude]
+        if not live:
+            return None
+        self._rr += 1
+        return live[self._rr % len(live)]
+
+    def note_degraded(self, detail: str) -> bool:
+        """Record a healthy->degraded transition. Returns True exactly
+        once per transition (the caller trips the ``fleet`` guardrail
+        signal on True, so a long outage is one trip, not thousands)."""
+        if self.degraded:
+            return False
+        self.degraded = True
+        self.stats["degradations"] += 1
+        logger.error("fleet DEGRADED: %s — falling back to in-process "
+                     "rollout production", detail)
+        return True
+
+    def note_recovered(self) -> None:
+        if self.degraded:
+            self.degraded = False
+            self.stats["recoveries"] += 1
+            logger.warning(
+                "fleet recovered: %d live workers — resuming fleet "
+                "production", len(self.live_workers()),
+            )
+
+    # -- chunk dispatch / delivery ---------------------------------------
+
+    def next_attempt(self, chunk_id: Tuple[int, int]) -> int:
+        name = chunk_name(chunk_id)
+        self._attempts[name] = self._attempts.get(name, 0) + 1
+        return self._attempts[name]
+
+    def dispatch(
+        self,
+        chunk_id: Tuple[int, int],
+        attempt: int,
+        worker: str,
+        meta: Dict[str, Any],
+        arrays: Dict[str, np.ndarray],
+    ) -> None:
+        name = f"{chunk_name(chunk_id)}_a{int(attempt)}"
+        serde.commit_message_dir(
+            os.path.join(self.root, DISPATCH_DIR, name),
+            {**meta, "worker": worker, "attempt": int(attempt),
+             "chunk_id": list(chunk_id)},
+            arrays,
+            meta_name="assignment.json",
+        )
+        self.stats["dispatched"] += 1
+        if attempt > 1:
+            self.stats["redispatches"] += 1
+        logger.info(
+            "fleet: dispatched chunk %s attempt %d to worker %r",
+            chunk_id, attempt, worker,
+        )
+
+    def poll_delivery(
+        self, chunk_id: Tuple[int, int]
+    ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
+        msg = serde.read_message_dir(
+            os.path.join(self.root, CHUNKS_DIR, chunk_name(chunk_id)),
+            meta_name="chunk.json",
+        )
+        if msg is not None:
+            self.stats["delivered"] += 1
+        return msg
+
+    def clear_delivery(self, chunk_id: Tuple[int, int]) -> None:
+        """Drop ONLY the delivered payload (a lingering worker's late
+        delivery from an abandoned attempt) — the outstanding dispatch
+        assignment stays, so the currently-assigned worker is not
+        stranded."""
+        shutil.rmtree(
+            os.path.join(self.root, CHUNKS_DIR, chunk_name(chunk_id)),
+            ignore_errors=True,
+        )
+
+    def clear_chunk(self, chunk_id: Tuple[int, int]) -> None:
+        """Drop a consumed chunk's delivery + dispatch messages (the
+        transport queue owns the payload now; leftovers would only
+        confuse a postmortem)."""
+        name = chunk_name(chunk_id)
+        shutil.rmtree(
+            os.path.join(self.root, CHUNKS_DIR, name), ignore_errors=True
+        )
+        ddir = os.path.join(self.root, DISPATCH_DIR)
+        for entry in os.listdir(ddir):
+            if entry.startswith(f"{name}_a"):
+                shutil.rmtree(
+                    os.path.join(ddir, entry), ignore_errors=True
+                )
+
+    # -- persistence / teardown ------------------------------------------
+
+    def state(self) -> Dict[str, Any]:
+        """What the checkpoint persists (state.json ``fleet`` section):
+        the membership epoch a resumed learner must bump past, the
+        last broadcast version (verify_ckpt.py's torn-commit check
+        compares it against the exp cursor's policy version) and the
+        publish cadence that bounds their legal gap."""
+        return {
+            "membership_epoch": int(self.membership_epoch),
+            "broadcast_version": (
+                -1 if self._published_version is None
+                else int(self._published_version)
+            ),
+            "broadcast_every": int(self.cfg.broadcast_every),
+        }
+
+    def shutdown(self, reason: str = "clean finish") -> None:
+        self.registry.shutdown(reason)
+
+    def stats_summary(self) -> Dict[str, Any]:
+        return {
+            **self.stats,
+            **{f"membership_{k}": v for k, v in self.registry.stats.items()},
+            **{f"broadcast_{k}": v for k, v in self.broadcast.stats.items()},
+            "live_workers": len(self.live_workers()),
+            "membership_epoch": self.membership_epoch,
+            "degraded": int(self.degraded),
+        }

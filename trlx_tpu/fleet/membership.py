@@ -1,0 +1,289 @@
+"""Worker membership: heartbeat-leased registry + membership epochs.
+
+The fleet's liveness story, built on the same TTL/heartbeat shape as
+``exp/leases.py`` but across PROCESS boundaries: all state lives as
+atomically-written JSON records under the fleet directory (a shared
+filesystem is the one channel a TPU pod always has), so either side can
+die at any byte boundary and the survivor reads a consistent picture.
+
+  membership.json    the learner's attach record: a MEMBERSHIP EPOCH
+                     bumped every time a learner attaches (fresh start
+                     OR supervisor relaunch). Workers poll it and
+                     re-register whenever the epoch moves — the
+                     handshake that lets a restarted learner re-attach
+                     a surviving fleet instead of orphaning it.
+  workers/<id>.json  one record per worker, rewritten atomically at
+                     every heartbeat (``last_beat`` + the epoch the
+                     worker registered under + the weight version it
+                     holds). A record silent past ``worker_ttl_s`` is
+                     EVICTED: removed, its in-flight chunk
+                     re-dispatched, and a flap recorded.
+  quarantine/<id>.json  learner-side verdict on a flapping worker
+                     (``flap_limit`` evictions in a row): excluded
+                     from dispatch until ``until``, with the backoff
+                     DOUBLING per repeat quarantine. Expiry re-admits.
+  shutdown.json      clean-finish flag: workers exit 0 when it
+                     appears (a crashed/stalled learner never writes
+                     it, so the fleet survives for the relaunch).
+
+Clocks are injectable (tier-1 drives eviction/quarantine on a fake
+clock); the cross-process default is ``time.time`` — wall clock,
+because the records are read by OTHER processes (``time.monotonic`` is
+process-local).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.checkpointing import atomic_json_write
+
+logger = logging.get_logger(__name__)
+
+MEMBERSHIP_FILE = "membership.json"
+SHUTDOWN_FILE = "shutdown.json"
+WORKERS_DIR = "workers"
+QUARANTINE_DIR = "quarantine"
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+    """Parse-safe read: a torn/missing record reads as absent (the
+    writer side is atomic, so this only covers a reader racing the
+    very first write)."""
+    import json
+
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def read_membership(root: str) -> Optional[Dict[str, Any]]:
+    return _read_json(os.path.join(root, MEMBERSHIP_FILE))
+
+
+def shutdown_requested(root: str) -> bool:
+    return os.path.isfile(os.path.join(root, SHUTDOWN_FILE))
+
+
+def write_worker_record(
+    root: str,
+    worker_id: str,
+    epoch: int,
+    weights_version: Optional[int],
+    clock: Callable[[], float] = time.time,
+    joined_at: Optional[float] = None,
+) -> None:
+    """Register/heartbeat in one atomic rewrite (registration IS the
+    first heartbeat; a rejoin after eviction is just the next one)."""
+    now = clock()
+    atomic_json_write(
+        os.path.join(root, WORKERS_DIR, f"{worker_id}.json"),
+        {
+            "worker": worker_id,
+            "epoch": int(epoch),
+            "last_beat": now,
+            "joined_at": now if joined_at is None else joined_at,
+            "weights_version": weights_version,
+            "pid": os.getpid(),
+        },
+    )
+
+
+class WorkerRegistry:
+    """The learner-side view: membership epochs, liveness, eviction and
+    flap quarantine. One instance per attached learner."""
+
+    def __init__(
+        self,
+        root: str,
+        worker_ttl_s: float,
+        flap_limit: int = 3,
+        flap_backoff_s: float = 5.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.root = root
+        self.worker_ttl_s = float(worker_ttl_s)
+        self.flap_limit = int(flap_limit)
+        self.flap_backoff_s = float(flap_backoff_s)
+        self._clock = clock
+        os.makedirs(os.path.join(root, WORKERS_DIR), exist_ok=True)
+        os.makedirs(os.path.join(root, QUARANTINE_DIR), exist_ok=True)
+        self.epoch = 0
+        # flap accounting is learner-side in-memory state: an eviction
+        # streak per worker, and how many quarantines it has served
+        # (the backoff doubles per served quarantine)
+        self._flap_streak: Dict[str, int] = {}
+        self._quarantines_served: Dict[str, int] = {}
+        self.stats: Dict[str, int] = {
+            "evictions": 0,
+            "quarantines": 0,
+            "readmissions": 0,
+        }
+
+    # -- membership epoch (learner attach/re-attach handshake) -----------
+
+    def open_epoch(self, learner: str = "learner") -> int:
+        """Attach this learner: bump the membership epoch. Every worker
+        registered under an older epoch re-registers when it sees the
+        bump — the re-attach handshake that survives a supervisor
+        relaunch (exit 87 path) without orphaning the fleet."""
+        prev = read_membership(self.root)
+        self.epoch = int(prev.get("epoch", 0)) + 1 if prev else 1
+        atomic_json_write(
+            os.path.join(self.root, MEMBERSHIP_FILE),
+            {"epoch": self.epoch, "learner": learner,
+             "stamped_at": self._clock()},
+        )
+        # a previous clean finish must not make re-attached workers exit
+        try:
+            os.remove(os.path.join(self.root, SHUTDOWN_FILE))
+        except OSError:
+            pass
+        logger.info(
+            "fleet membership: learner %r opened epoch %d", learner,
+            self.epoch,
+        )
+        return self.epoch
+
+    # -- liveness ---------------------------------------------------------
+
+    def worker_records(self) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        wdir = os.path.join(self.root, WORKERS_DIR)
+        for entry in sorted(os.listdir(wdir)):
+            if not entry.endswith(".json"):
+                continue
+            rec = _read_json(os.path.join(wdir, entry))
+            if rec and "worker" in rec:
+                out[rec["worker"]] = rec
+        return out
+
+    def live_workers(self) -> List[str]:
+        """Workers registered under the CURRENT epoch, beating within
+        the TTL, and not quarantined — the dispatchable set."""
+        now = self._clock()
+        return [
+            wid
+            for wid, rec in self.worker_records().items()
+            if rec.get("epoch") == self.epoch
+            and now - rec.get("last_beat", 0.0) <= self.worker_ttl_s
+            and not self.is_quarantined(wid)
+        ]
+
+    def evict_silent(self) -> List[str]:
+        """Remove current-epoch records whose heartbeat is older than
+        the TTL (worker death, partition, wedge) and record a flap for
+        each. The caller re-dispatches any chunk the evicted worker
+        held. Stale-epoch records are garbage-collected silently (the
+        worker either re-registers or is gone)."""
+        now = self._clock()
+        evicted = []
+        for wid, rec in self.worker_records().items():
+            age = now - rec.get("last_beat", 0.0)
+            if age <= self.worker_ttl_s:
+                continue
+            try:
+                os.remove(
+                    os.path.join(self.root, WORKERS_DIR, f"{wid}.json")
+                )
+            except OSError:
+                continue
+            if rec.get("epoch") != self.epoch:
+                continue  # stale-epoch leftover, not a live-fleet flap
+            evicted.append(wid)
+            self.stats["evictions"] += 1
+            self._record_flap(wid)
+            logger.warning(
+                "fleet membership: evicted worker %r (silent %.3gs > "
+                "ttl %.3gs)", wid, age, self.worker_ttl_s,
+            )
+        return evicted
+
+    def evict(self, worker_id: str, reason: str) -> bool:
+        """Force-evict one worker (the dispatch-timeout backstop: alive
+        and beating but not producing). Flap-tracked like a silent
+        eviction; the worker's next beat re-registers it (rejoin)."""
+        try:
+            os.remove(
+                os.path.join(self.root, WORKERS_DIR, f"{worker_id}.json")
+            )
+        except OSError:
+            return False
+        self.stats["evictions"] += 1
+        self._record_flap(worker_id)
+        logger.warning(
+            "fleet membership: force-evicted worker %r (%s)",
+            worker_id, reason,
+        )
+        return True
+
+    # -- flap quarantine --------------------------------------------------
+
+    def _quarantine_path(self, worker_id: str) -> str:
+        return os.path.join(self.root, QUARANTINE_DIR, f"{worker_id}.json")
+
+    def _record_flap(self, worker_id: str) -> None:
+        streak = self._flap_streak.get(worker_id, 0) + 1
+        self._flap_streak[worker_id] = streak
+        if streak < self.flap_limit:
+            return
+        served = self._quarantines_served.get(worker_id, 0)
+        backoff = self.flap_backoff_s * (2 ** served)
+        self._quarantines_served[worker_id] = served + 1
+        self._flap_streak[worker_id] = 0  # streak restarts post-quarantine
+        self.stats["quarantines"] += 1
+        atomic_json_write(
+            self._quarantine_path(worker_id),
+            {"worker": worker_id, "until": self._clock() + backoff,
+             "flaps": streak, "backoff_s": backoff},
+        )
+        logger.error(
+            "fleet membership: worker %r QUARANTINED for %.3gs (%d "
+            "evictions in a row >= flap_limit %d); re-admitted with "
+            "doubled backoff on the next quarantine", worker_id, backoff,
+            streak, self.flap_limit,
+        )
+
+    def note_healthy(self, worker_id: str) -> None:
+        """A consumed delivery from this worker breaks its eviction
+        streak: ``flap_limit`` evictions IN A ROW means consecutive.
+        Without the reset, unrelated transient evictions hours apart
+        would accumulate and eventually quarantine a healthy worker
+        with ever-doubling backoff."""
+        if self._flap_streak.get(worker_id):
+            self._flap_streak[worker_id] = 0
+
+    def is_quarantined(self, worker_id: str) -> bool:
+        """Quarantine verdict, with expiry = re-admission (the record
+        is removed so a re-admitted worker reads as clean)."""
+        rec = _read_json(self._quarantine_path(worker_id))
+        if rec is None:
+            return False
+        if self._clock() >= rec.get("until", 0.0):
+            try:
+                os.remove(self._quarantine_path(worker_id))
+            except OSError:
+                pass
+            self.stats["readmissions"] += 1
+            logger.warning(
+                "fleet membership: quarantine on worker %r expired — "
+                "re-admitted", worker_id,
+            )
+            return False
+        return True
+
+    # -- shutdown ---------------------------------------------------------
+
+    def shutdown(self, reason: str = "clean finish") -> None:
+        """Clean-finish flag: workers exit 0 when they see it. A
+        crashed or stalled learner never writes this, so a surviving
+        fleet waits for the relaunch's epoch bump instead."""
+        atomic_json_write(
+            os.path.join(self.root, SHUTDOWN_FILE),
+            {"reason": reason, "stamped_at": self._clock()},
+        )

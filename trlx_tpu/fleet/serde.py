@@ -1,0 +1,270 @@
+"""Wire conversions for the fleet: pytrees <-> path-keyed numpy.
+
+Everything that crosses the learner/worker process boundary goes
+through here, and every conversion is EXACT (float32 arrays round-trip
+through ``.npz`` bit-for-bit) — that is what makes a fleet-produced
+chunk bit-identical to the in-process one. The jax imports live here
+so ``membership``/``broadcast``/``config`` stay host-only.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# -- PRNG keys (mirrors base.py _pack_rng/_unpack_rng) -----------------
+
+
+def pack_rng(rng) -> list:
+    jax = _jax()
+    try:
+        data = jax.random.key_data(rng)
+    except Exception:  # old-style raw uint32 key array
+        data = rng
+    return np.asarray(data).astype(np.uint32).tolist()
+
+
+def unpack_rng(data, like):
+    """Rebuild a key with the same flavor (typed/raw) as ``like``."""
+    import jax.numpy as jnp
+
+    jax = _jax()
+    arr = jnp.asarray(np.asarray(data, np.uint32))
+    try:
+        if jnp.issubdtype(like.dtype, jax.dtypes.prng_key):
+            arr = jax.random.wrap_key_data(arr)
+    except Exception:
+        pass
+    return arr
+
+
+# -- producer replay snapshot (rng + reward accounting) ----------------
+
+
+def snapshot_to_wire(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """The PPO ``_exp_snapshot`` dict (rng, running moments, ref
+    stats) as JSON-safe values. float32 scalars widen to python floats
+    exactly (float64 is a superset), so the round-trip is bit-free."""
+    rm = snap["running_moments"]
+    return {
+        "rng": pack_rng(snap["rng"]),
+        "running_moments": {
+            "mean": float(np.asarray(rm.mean)),
+            "var": float(np.asarray(rm.var)),
+            "std": float(np.asarray(rm.std)),
+            "count": float(np.asarray(rm.count)),
+        },
+        "ref_mean": (
+            None if snap.get("ref_mean") is None else float(snap["ref_mean"])
+        ),
+        "ref_std": (
+            None if snap.get("ref_std") is None else float(snap["ref_std"])
+        ),
+    }
+
+
+def snapshot_from_wire(wire: Dict[str, Any], like_rng) -> Dict[str, Any]:
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.common import RunningMoments
+
+    rm = wire["running_moments"]
+    return {
+        "rng": unpack_rng(wire["rng"], like_rng),
+        "running_moments": RunningMoments(
+            mean=jnp.float32(rm["mean"]), var=jnp.float32(rm["var"]),
+            std=jnp.float32(rm["std"]), count=jnp.float32(rm["count"]),
+        ),
+        "ref_mean": wire["ref_mean"],
+        "ref_std": wire["ref_std"],
+    }
+
+
+# -- prompt batches and rollout batches --------------------------------
+
+
+def prompt_batch_to_arrays(batch) -> Tuple[Dict[str, np.ndarray], Any]:
+    """PromptBatch device arrays -> numpy (+ the host-side metadata,
+    which rides the JSON half of the assignment)."""
+    return (
+        {
+            "prompt_input_ids": np.asarray(batch.input_ids),
+            "prompt_attention_mask": np.asarray(batch.attention_mask),
+        },
+        batch.metadata,
+    )
+
+
+def prompt_batch_from_arrays(arrays: Dict[str, np.ndarray], metadata):
+    import jax.numpy as jnp
+
+    from trlx_tpu.data import PromptBatch
+
+    return PromptBatch(
+        input_ids=jnp.asarray(arrays["prompt_input_ids"]),
+        attention_mask=jnp.asarray(arrays["prompt_attention_mask"]),
+        metadata=metadata,
+    )
+
+
+_ROLLOUT_FIELDS = (
+    "query_tensors",
+    "response_tensors",
+    "logprobs",
+    "values",
+    "rewards",
+    "response_mask",
+    "is_weight",  # None outside staleness clip mode
+)
+
+
+def rollout_to_arrays(rb) -> Dict[str, np.ndarray]:
+    out = {}
+    for name in _ROLLOUT_FIELDS:
+        leaf = getattr(rb, name)
+        if leaf is not None:
+            out[f"rollout_{name}"] = np.asarray(leaf)
+    return out
+
+
+def rollout_from_arrays(arrays: Dict[str, np.ndarray]):
+    import jax.numpy as jnp
+
+    from trlx_tpu.data import PPORolloutBatch
+
+    kw = {}
+    for name in _ROLLOUT_FIELDS:
+        key = f"rollout_{name}"
+        if key in arrays:
+            kw[name] = jnp.asarray(arrays[key])
+    return PPORolloutBatch(**kw)
+
+
+# -- params <-> path-keyed numpy (weight broadcast) --------------------
+
+
+def params_to_arrays(params) -> Dict[str, np.ndarray]:
+    """Flatten a param pytree to ``{keystr: host array}``. ``keystr``
+    is jax's canonical path string, so learner and worker agree on
+    names as long as they built the same model (same config)."""
+    jax = _jax()
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    return {
+        jax.tree_util.keystr(path): np.asarray(leaf)
+        for path, leaf in leaves
+    }
+
+
+def load_params_like(params, arrays: Dict[str, np.ndarray]):
+    """Rebuild a device param tree shaped like ``params`` from a
+    broadcast snapshot: every leaf keeps its dtype and sharding (the
+    snapshot's bytes, the holder's placement)."""
+    jax = _jax()
+
+    def restore(path, old):
+        key = jax.tree_util.keystr(path)
+        if key not in arrays:
+            raise KeyError(
+                f"broadcast snapshot is missing param leaf {key} — "
+                "learner and worker built different models (config "
+                "drift between processes)"
+            )
+        new = np.asarray(arrays[key])
+        if new.shape != old.shape:
+            raise ValueError(
+                f"broadcast leaf {key} has shape {new.shape}, the "
+                f"worker's model expects {old.shape}"
+            )
+        return jax.device_put(new.astype(old.dtype), old.sharding)
+
+    return jax.tree_util.tree_map_with_path(restore, params)
+
+
+# -- chunk payload stats ------------------------------------------------
+
+
+def stats_to_wire(stats: Dict[str, Any]) -> Dict[str, float]:
+    """Chunk stats (host floats + device scalars) -> plain floats.
+    Device scalars materialize here — on the WORKER, so the learner
+    never blocks on a fleet chunk's stats."""
+    return {k: float(np.asarray(v)) for k, v in stats.items()}
+
+
+# -- atomic directory commit (dispatch + delivery messages) ------------
+
+
+def commit_message_dir(
+    final_dir: str,
+    meta: Dict[str, Any],
+    arrays: Dict[str, np.ndarray],
+    meta_name: str = "meta.json",
+) -> bool:
+    """Write a message as ``<dir>/{meta.json,arrays.npz}`` via the
+    tmp-dir + rename pattern: the destination appears atomically and
+    complete, or not at all. Returns False when the destination
+    already exists (a racing duplicate — e.g. a partitioned worker
+    delivering a chunk its replacement already delivered); the caller
+    treats that as success-by-dedup."""
+    import json as _json
+    import shutil as _shutil
+
+    from trlx_tpu.utils.checkpointing import fsync_tree
+
+    if os.path.isdir(final_dir):
+        return False
+    parent = os.path.dirname(final_dir)
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{final_dir}.tmp_{os.getpid()}"
+    _shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    with open(os.path.join(tmp, "arrays.npz"), "wb") as f:
+        np.savez(f, **arrays)
+    with open(os.path.join(tmp, meta_name), "w") as f:
+        _json.dump(meta, f)
+    fsync_tree(tmp)
+    try:
+        os.rename(tmp, final_dir)
+    except OSError:
+        _shutil.rmtree(tmp, ignore_errors=True)
+        return False
+    return True
+
+
+def read_message_meta(
+    final_dir: str, meta_name: str = "meta.json"
+) -> Optional[Dict[str, Any]]:
+    """Meta-only read of a committed message dir — for callers that
+    route on the metadata (which worker an assignment addresses)
+    without paying the arrays load on every poll tick."""
+    import json as _json
+
+    meta_fp = os.path.join(final_dir, meta_name)
+    if not (
+        os.path.isfile(meta_fp)
+        and os.path.isfile(os.path.join(final_dir, "arrays.npz"))
+    ):
+        return None
+    with open(meta_fp) as f:
+        return _json.load(f)
+
+
+def read_message_dir(
+    final_dir: str, meta_name: str = "meta.json"
+) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
+    """Read a committed message dir; None when absent (rename not
+    landed yet)."""
+    meta = read_message_meta(final_dir, meta_name)
+    if meta is None:
+        return None
+    with np.load(os.path.join(final_dir, "arrays.npz")) as z:
+        arrays = {k: z[k] for k in z.files}
+    return meta, arrays

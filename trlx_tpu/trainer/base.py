@@ -2207,6 +2207,10 @@ class TPUBaseTrainer(BaseRLTrainer):
             # learn() exits: drop it and rewind its prompt cursor so a
             # resumed run replays those prompts
             self._abandon_prefetch()
+            # external producer fleets (ppo.fleet.*): signal clean
+            # finish when the budget is done, leave the fleet attached
+            # for the relaunch handshake otherwise
+            self._shutdown_producers()
 
     def _learn(self):
         logger.info("Starting training")
@@ -2433,6 +2437,12 @@ class TPUBaseTrainer(BaseRLTrainer):
 
     def _state_tree(self) -> Dict:
         return {"params": self.params, "opt_state": self.opt_state}
+
+    def _shutdown_producers(self) -> None:
+        """Subclass hook, called from learn()'s ``finally``: tear down
+        any external rollout-producer fleet when the run is over for
+        good, and leave it alive for re-attach when this exit is a
+        preemption / stall / crash a supervisor will relaunch."""
 
     def _extra_state(self) -> Dict[str, Any]:
         """Subclass hook: extra JSON-serializable resumable state (KL

@@ -32,7 +32,8 @@ from trlx_tpu.data import PPORolloutBatch, PromptBatch
 from trlx_tpu.data.method_configs import PPOConfig
 from trlx_tpu.exp import ExpConfig, ExperienceTransport
 from trlx_tpu.exp import transport as exp_transport
-from trlx_tpu.utils.guardrails import STALENESS_SIGNAL
+from trlx_tpu.fleet.config import FleetConfig
+from trlx_tpu.utils.guardrails import FLEET_SIGNAL, STALENESS_SIGNAL
 from trlx_tpu.models.wrappers import CausalLMWithValueHead, Seq2SeqLMWithValueHead
 from trlx_tpu.ops.common import (
     chunked_logprobs,
@@ -198,6 +199,34 @@ class TPUPPOTrainer(TPUBaseTrainer):
         # generated at (the chunk is consumed one optimizer cycle later,
         # so its recorded version must be the generation-time one)
         self._prefetch_policy_version = 0
+        # fault-tolerant rollout fleet (ppo.fleet.*, trlx_tpu/fleet/):
+        # chunk production routed to cross-process workers behind the
+        # transport seam — membership heartbeats, versioned weight
+        # broadcast, degraded-mode fallback to the in-process path
+        self._fleet_cfg = FleetConfig.from_dict(
+            getattr(config.method, "fleet", None)
+        )
+        self._fleet = None
+        if self._fleet_cfg.enabled:
+            if self._exp is None:
+                raise ValueError(
+                    "ppo.fleet.enabled requires ppo.exp.enabled: the "
+                    "fleet produces chunks BEHIND the experience "
+                    "transport (delivery/dedup/staleness stay its job)"
+                )
+            if mh.process_count() > 1:
+                raise NotImplementedError(
+                    "ppo.fleet with a multi-process learner mesh is not "
+                    "supported yet (run one learner process; workers "
+                    "scale horizontally instead)"
+                )
+            from trlx_tpu.fleet.coordinator import FleetCoordinator
+
+            self._fleet = FleetCoordinator(
+                self._fleet_cfg,
+                self._fleet_cfg.resolved_dir(config.train.checkpoint_dir),
+                owner=f"learner-{mh.process_index()}",
+            )
 
     # -- model -----------------------------------------------------------
 
@@ -1054,6 +1083,13 @@ class TPUPPOTrainer(TPUBaseTrainer):
             if batch is None:
                 batch = self._next_prompt_batch()
                 snap["batch"] = batch
+            if self._fleet is not None and self._fleet_produce(
+                lease, snap, batch, iter_count
+            ):
+                # produced + delivered by a fleet worker (the learner
+                # adopted its post-production snapshot); the transport
+                # consumer loop takes it from here
+                return
             exp.heartbeat(lease)
             t0 = time()
             gen_out = self.generate(batch.input_ids, batch.attention_mask)
@@ -1091,6 +1127,237 @@ class TPUPPOTrainer(TPUBaseTrainer):
                     lease, version, payload, meta={"snapshot": snap},
                     wait=self._exp_wait(iter_count),
                 )
+
+    # -- rollout fleet (ppo.fleet.*) -------------------------------------
+
+    def _fleet_post_publish(self, path: str) -> None:
+        """Chaos seam for ``broadcast_corrupt``: fired once per landed
+        weight-snapshot publish, AFTER the atomic rename — only the
+        workers' manifest verification can catch the flipped bit."""
+        if self.chaos is not None and self.chaos.consult("broadcast_corrupt"):
+            self.chaos.corrupt_broadcast(path)
+
+    def _fleet_degrade(self, why: str) -> bool:
+        """Record a healthy->degraded transition and trip the ``fleet``
+        guardrail signal (once per transition — a long outage must not
+        spam the escalation ladder). Always returns False so callers
+        can ``return self._fleet_degrade(...)`` out of the fleet path."""
+        if self._fleet.note_degraded(why):
+            self.guardrails.trip(
+                FLEET_SIGNAL,
+                f"rollout fleet degraded: {why} — falling back to "
+                "in-process production (bit-equal to the fleet-less run)",
+            )
+        return False
+
+    def _fleet_ready(self, iter_count: int) -> bool:
+        """Evict silent workers, then gate on ``fleet.min_workers``.
+        The FIRST production waits out ``fleet.startup_timeout_s`` for
+        the fleet to register (workers launch in parallel with the
+        learner's compile, so "not there yet" is the common case) — a
+        fleet that never comes up degrades instead of wedging the run."""
+        import time as _time
+
+        fleet, cfg = self._fleet, self._fleet_cfg
+        deadline = (
+            None if fleet._waited_startup
+            else _time.time() + cfg.startup_timeout_s
+        )
+        fleet._waited_startup = True
+        while True:
+            fleet.registry.evict_silent()
+            if len(fleet.live_workers()) >= cfg.min_workers:
+                return True
+            if deadline is None or _time.time() >= deadline:
+                return False
+            self.watchdog.beat("rollout", step=iter_count)
+            _time.sleep(cfg.poll_s)
+
+    def _fleet_produce(
+        self, lease, snap: Dict[str, Any], batch, iter_count: int
+    ) -> bool:
+        """Produce the leased chunk on the worker fleet: publish the
+        policy snapshot if due, dispatch the prompt batch + replay
+        snapshot to a live worker, watch its membership heartbeats
+        while it generates, and hand the delivered payload to the
+        transport under the learner's own lease. A worker that goes
+        silent mid-chunk is evicted and the chunk re-dispatched with
+        the SAME snapshot (bit-identical regeneration). Returns False
+        — after tripping the ``fleet`` signal once per transition —
+        when the fleet is below ``min_workers`` (or a dispatch timed
+        out); the caller then produces the chunk in-process from the
+        same snapshot, so degradation is invisible in the loss stream."""
+        import time as _time
+
+        from trlx_tpu.fleet import serde as fleet_serde
+
+        fleet, cfg, exp = self._fleet, self._fleet_cfg, self._exp
+        # publish before the readiness gate: workers that are still
+        # attaching need the snapshot to produce anything at all. But a
+        # DEGRADED fleet with no registered workers at all has no
+        # consumers — skip the full-model snapshot (host copy + npz +
+        # sha256 + fsync per policy version) until a registration
+        # reappears, or a dead fleet taxes every remaining cycle
+        if not fleet.degraded or fleet.registry.worker_records():
+            fleet.ensure_published(
+                self._policy_version,
+                lambda: fleet_serde.params_to_arrays(self.params),
+                post_publish=self._fleet_post_publish,
+            )
+        if not self._fleet_ready(iter_count):
+            return self._fleet_degrade(
+                f"{len(fleet.live_workers())} live workers < "
+                f"fleet.min_workers={cfg.min_workers}"
+            )
+        fleet.note_recovered()
+        chunk_id = lease.chunk_id
+
+        def degrade_dispatched(why: str) -> bool:
+            # abandon the outstanding dispatch: a later-rejoining
+            # evicted worker must not burn a generation on a chunk the
+            # learner is about to produce in-process, and its late
+            # delivery must not linger to collide with a future
+            # regeneration of the same id. The lease goes back to the
+            # learner — IT is the producer from here on, and expiry
+            # logs should say so
+            fleet.clear_chunk(chunk_id)
+            exp.reassign(lease, exp.owner)
+            return self._fleet_degrade(why)
+        # a previous incarnation/attempt may have left a delivery for
+        # this seq (learner restart, staleness re-dispatch): the replay
+        # contract makes a same-snapshot leftover bit-identical, but a
+        # staleness regeneration must NOT consume the old samples —
+        # clear and regenerate, which is correct for both
+        fleet.clear_chunk(chunk_id)
+        arrays, prompt_meta = fleet_serde.prompt_batch_to_arrays(batch)
+        # self state == the replay snapshot at this point (a re-dispatch
+        # restored it at the top of _exp_produce), so the wire snapshot
+        # is exactly what an in-process production would consume
+        wire_meta = {
+            "iter_count": int(iter_count),
+            "snapshot": fleet_serde.snapshot_to_wire(self._exp_snapshot()),
+            "prompt_metadata": prompt_meta,
+        }
+        tried: Tuple[str, ...] = ()
+        worker = fleet.select_worker()
+        if worker is None:
+            return self._fleet_degrade("no dispatchable worker")
+        attempt = fleet.next_attempt(chunk_id)
+        valid_attempts = {attempt}
+        exp.reassign(lease, worker)
+        fleet.dispatch(chunk_id, attempt, worker, wire_meta, arrays)
+        deadline = _time.time() + cfg.dispatch_timeout_s
+        # delivery is polled every tick, but the membership scan
+        # (dir listing + one JSON parse per worker record) only needs
+        # the TTL's resolution — on a shared/remote filesystem the
+        # difference is thousands of metadata reads per chunk
+        scan_every = max(cfg.worker_ttl_s / 4.0, cfg.poll_s)
+        next_scan = 0.0
+        while True:
+            self.watchdog.beat("rollout", step=iter_count)
+            exp.heartbeat(lease)
+            msg = fleet.poll_delivery(chunk_id)
+            if msg is not None:
+                if int(msg[0].get("attempt", -1)) in valid_attempts:
+                    break
+                # a lingering worker's late delivery from an attempt
+                # ABANDONED before this production (a staleness
+                # regeneration reuses the chunk id with a NEW snapshot):
+                # consuming it would replay the exact payload the gate
+                # refused. Drop the payload only — the outstanding
+                # assignment stays so the current worker isn't stranded
+                fleet.clear_delivery(chunk_id)
+                msg = None
+            if _time.time() >= next_scan:
+                next_scan = _time.time() + scan_every
+                fleet.registry.evict_silent()
+                lost = worker not in fleet.live_workers()
+            else:
+                lost = False
+            if lost:
+                # the producing worker died / partitioned / got
+                # quarantined mid-chunk: re-dispatch elsewhere with the
+                # same snapshot (regeneration is bit-identical, so the
+                # consumed stream never sees the loss)
+                tried = tried + (worker,)
+                if len(fleet.live_workers()) < cfg.min_workers:
+                    return degrade_dispatched(
+                        f"worker {worker!r} lost mid-chunk {chunk_id} "
+                        "and the live fleet fell below min_workers"
+                    )
+                worker = (
+                    fleet.select_worker(exclude=tried)
+                    or fleet.select_worker()  # all live ones tried: retry the set
+                )
+                if worker is None:
+                    return degrade_dispatched(
+                        f"no dispatchable worker for chunk {chunk_id}"
+                    )
+                attempt = fleet.next_attempt(chunk_id)
+                valid_attempts.add(attempt)
+                exp.reassign(lease, worker)
+                fleet.dispatch(chunk_id, attempt, worker, wire_meta, arrays)
+                deadline = _time.time() + cfg.dispatch_timeout_s
+                continue
+            if _time.time() >= deadline:
+                # alive-but-wedged worker: the membership TTL never
+                # fires, so this bound is the backstop. Evict (flap-
+                # tracked) and degrade; the in-process regeneration is
+                # bit-identical via the replay snapshot.
+                fleet.registry.evict(
+                    worker,
+                    f"dispatch timeout: chunk {chunk_id} undelivered "
+                    f"after {cfg.dispatch_timeout_s:g}s",
+                )
+                return degrade_dispatched(
+                    f"chunk {chunk_id} timed out on worker {worker!r}"
+                )
+            _time.sleep(cfg.poll_s)
+        meta_d, arrays_d = msg
+        # a consumed delivery breaks the producing worker's eviction
+        # streak — flap quarantine means consecutive evictions, not
+        # cumulative-forever
+        fleet.registry.note_healthy(str(meta_d.get("worker", "")))
+        rollout_batch = fleet_serde.rollout_from_arrays(arrays_d)
+        stats: Dict[str, Any] = dict(meta_d.get("stats") or {})
+        rows_local = int(meta_d["rows_local"])
+        version = int(meta_d["policy_version"])
+        # adopt the worker's post-production snapshot: the learner's
+        # RNG/moments chain continues exactly as if it had produced the
+        # chunk in-process — that adoption is what keeps the fleet path
+        # bit-equal to ppo.exp.enabled
+        self._exp_restore_snapshot(
+            fleet_serde.snapshot_from_wire(meta_d["post_snapshot"], self.rng)
+        )
+        exp.heartbeat(lease)
+        with self.watchdog.phase("exp_wait", step=iter_count):
+            exp.deliver(
+                lease, version, (rollout_batch, stats, rows_local),
+                meta={"snapshot": snap}, wait=self._exp_wait(iter_count),
+            )
+        fleet.clear_chunk(chunk_id)
+        return True
+
+    def _shutdown_producers(self) -> None:
+        """learn()-exit hook (trainer/base.py): write the fleet's
+        clean-finish flag ONLY when the step budget is actually done —
+        a preemption / stall / crash exit leaves the workers alive for
+        the relaunched learner's membership-epoch re-attach handshake."""
+        if self._fleet is None:
+            return
+        total = getattr(self, "total_steps", None)
+        budget = self.config.train.total_steps if total is None else total
+        if self.iter_count >= budget:
+            self._fleet.shutdown("train budget reached")
+            logger.info(
+                "fleet: clean finish — %s", self._fleet.stats_summary()
+            )
+        else:
+            logger.info(
+                "fleet: learner exiting at step %d < %d with the fleet "
+                "left ATTACHED (workers re-register on the relaunch's "
+                "membership epoch)", self.iter_count, budget,
+            )
 
     def _make_experience_exp(self, num_rollouts: int, iter_count: int) -> None:
         """The experience-transport rollout loop: the in-process PPO
@@ -1239,6 +1506,14 @@ class TPUPPOTrainer(TPUBaseTrainer):
             for k, v in exp.stats_summary().items()
             if isinstance(v, (int, float))
         })
+        if self._fleet is not None:
+            # fleet health rides the same ledger: dispatches/evictions/
+            # quarantines/degradations per cycle, all host ints
+            agg.update({
+                f"fleet/{k}": float(v)
+                for k, v in self._fleet.stats_summary().items()
+                if isinstance(v, (int, float))
+            })
         if hasattr(pbar, "close"):
             pbar.close()
         self._deferred_rollout.stage(agg, step=iter_count, meta=self.kl_ctl.value)
@@ -1554,6 +1829,13 @@ class TPUPPOTrainer(TPUBaseTrainer):
                 "policy_version": self._policy_version,
                 "staleness_mode": self._exp_cfg.staleness.mode,
             }
+        if self._fleet is not None:
+            # membership epoch + last broadcast version, committed by
+            # the SAME atomic state.json write as the exp cursor —
+            # verify_ckpt.py's torn-commit detector holds the pair to
+            # the publish-cadence invariant (a cursor referencing a
+            # policy the committed snapshot never broadcast is torn)
+            state["fleet"] = self._fleet.state()
         return state
 
     def _restore_extra_state(self, state) -> None:
@@ -1574,6 +1856,13 @@ class TPUPPOTrainer(TPUBaseTrainer):
         if eq and self._exp is not None:
             self._exp.load_state_dict(eq)
             self._policy_version = int(eq.get("policy_version", 0))
+        if self._fleet is not None:
+            # the restore may have moved _policy_version backwards
+            # (rollback): drop the publish cursor so the next cycle
+            # rebroadcasts the restored params — otherwise workers keep
+            # the rolled-back-over weights and their chunks admit as
+            # non-stale (generation version ahead of the learner's)
+            self._fleet.reset_published()
         self._resume_prompt_cursor = state.get("prompt_batches_consumed", 0)
         if (
             self._resume_prompt_cursor

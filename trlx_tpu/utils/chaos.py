@@ -80,6 +80,27 @@ to an exact cycle/call):
                   beats — must ride it out; consulted once per
                   delivery.
 
+  Rollout-fleet sites (``ppo.fleet.enabled``; trlx_tpu/fleet/):
+  fleet_worker_death  the WORKER process hard-exits mid-chunk
+                  (generation done, scoring pending): its membership
+                  beats stop, the learner evicts it after
+                  ``fleet.worker_ttl_s`` and re-dispatches the chunk
+                  with the replay snapshot (bit-identical
+                  regeneration); consulted in the worker, once per
+                  assignment.
+  fleet_partition the worker is alive but PARTITIONED: its beat
+                  thread pauses for ``stall_delay`` seconds, the
+                  learner evicts + re-dispatches, then the worker
+                  rejoins (its late delivery dedups away); consulted
+                  in the worker, once per assignment.
+  broadcast_corrupt  one byte of the just-published weight snapshot is
+                  flipped (a torn/bit-rotted shared-filesystem write):
+                  workers must REJECT it on manifest verification and
+                  keep the previous version — their chunks then carry
+                  the older policy version and flow through the
+                  ``exp.staleness`` gate; consulted in the learner,
+                  once per broadcast publish.
+
 Schedule entries select by count: ``{"fault": "nan_loss", "at": 2}``
 fires on the 2nd consult (1-based), ``{"fault": ..., "at": 2, "span": 3}``
 on consults 2..4, and ``{"fault": ..., "every": 5}`` on every 5th.
@@ -120,6 +141,10 @@ FAULT_SITES = (
     "duplicate_delivery",
     "stale_flood",
     "queue_wedge",
+    # rollout-fleet sites (appended, same reason)
+    "fleet_worker_death",
+    "fleet_partition",
+    "broadcast_corrupt",
 )
 
 
@@ -272,6 +297,23 @@ class ChaosMonkey:
             f.seek(size // 2)
             f.write(bytes([byte[0] ^ 0x01]))
         logger.warning("chaos: bit-flipped committed shard %s", victim)
+        return victim
+
+    def corrupt_broadcast(self, directory: str) -> Optional[str]:
+        """``broadcast_corrupt`` body: flip one bit in the middle of
+        the published snapshot's ``arrays.npz`` — AFTER the atomic
+        publish landed, so only manifest verification (not the commit
+        protocol) can catch it. Returns the path flipped."""
+        victim = os.path.join(directory, "arrays.npz")
+        if not os.path.isfile(victim) or os.path.getsize(victim) == 0:
+            return None
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([byte[0] ^ 0x01]))
+        logger.warning("chaos: bit-flipped broadcast snapshot %s", victim)
         return victim
 
     def perturb_fingerprint(self, fingerprint):

@@ -344,6 +344,42 @@ def check_cursor_invariants(state: Dict[str, Any]) -> List[str]:
         problems.append(
             f"exp_queue.epoch={epoch!r} is not a non-negative integer"
         )
+    # rollout fleet (trlx_tpu/fleet/): the broadcast snapshot version
+    # and the trainer's policy version are written by the same atomic
+    # state.json commit, and the learner publishes at the top of every
+    # rollout cycle — so the policy can only ever be ahead of the last
+    # committed broadcast by the publish cadence. A checkpoint whose
+    # exp cursor references a policy version further past the committed
+    # snapshot is torn (its halves came from different moments), and a
+    # resume from it would hand workers weights that never generated
+    # the cursor's chunks.
+    fleet = state.get("fleet")
+    if isinstance(fleet, dict):
+        bver = fleet.get("broadcast_version")
+        pver = eq.get("policy_version")
+        lag_max = max(int(fleet.get("broadcast_every", 1) or 1), 1)
+        if isinstance(bver, int) and isinstance(pver, int) and bver >= 0:
+            if bver > pver:
+                problems.append(
+                    f"fleet.broadcast_version={bver} is NEWER than the "
+                    f"exp cursor's policy version ({pver}): a snapshot "
+                    "cannot be published for a policy the optimizer "
+                    "never produced — this state.json is torn"
+                )
+            elif pver - bver > lag_max:
+                problems.append(
+                    f"exp_queue.policy_version={pver} references a "
+                    f"policy {pver - bver} versions past the committed "
+                    f"broadcast snapshot (v{bver}, publish cadence "
+                    f"{lag_max}): the two halves of this state.json "
+                    "were written at different moments (torn commit)"
+                )
+        me = fleet.get("membership_epoch")
+        if me is not None and (not isinstance(me, int) or me < 1):
+            problems.append(
+                f"fleet.membership_epoch={me!r} is not a positive "
+                "integer (the learner bumps it to >= 1 on attach)"
+            )
     return problems
 
 

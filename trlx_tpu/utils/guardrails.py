@@ -77,6 +77,14 @@ STALL_SIGNAL = "stall"
 # means the rollout fleet is falling behind the learner.
 STALENESS_SIGNAL = "staleness"
 
+# the rollout fleet's trip kind (trlx_tpu/fleet/): live workers fell
+# below ``fleet.min_workers`` (evictions, quarantine, a fleet that
+# never came up) and the learner DEGRADED to the in-process rollout
+# path — training continues bit-equal to the fleet-less run, but the
+# disaggregation the operator paid for is gone. One trip per
+# healthy->degraded transition, not per chunk.
+FLEET_SIGNAL = "fleet"
+
 
 def _finite(x) -> bool:
     try:
