@@ -316,6 +316,177 @@ def bench_tpu() -> tuple:
     return NUM_ROLLOUTS / median_dt, split, spread
 
 
+def _train_state_bytes(trainer) -> int:
+    """Train-phase resident state: params + optimizer state + frozen
+    reference + the device rollout store, exact nbytes. This is the
+    state a train step must keep alive — the GRPO-vs-PPO memory
+    contrast sums it identically for both trainers."""
+    import jax
+
+    trees = [trainer.params, trainer.opt_state]
+    ref = getattr(trainer, "ref_params", None)
+    if ref is not None:
+        trees.append(ref)
+    hist = getattr(getattr(trainer, "store", None), "history", None)
+    if hist is not None:
+        trees.append(hist)
+    return int(
+        sum(
+            int(getattr(leaf, "nbytes", 0) or 0)
+            for tree in trees
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+    )
+
+
+def _device_peak_bytes():
+    """Backend-reported peak allocation (TPU/GPU); None when the
+    backend doesn't track it (CPU)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("peak_bytes_in_use"):
+            return int(stats["peak_bytes_in_use"])
+    except Exception:
+        pass
+    return None
+
+
+def bench_grpo() -> dict:
+    """GRPO leg on the PPO headline workload (ISSUE 9): the same
+    GPT2-small geometry, prompts, rollout count, train batch and inner
+    epochs — method half swapped to critic-free GRPO (8 samples per
+    prompt, group-relative advantages, no value head). Reports
+    samples/s and train-phase state/peak memory for BOTH trainers,
+    measured in one process with identical accounting; both run
+    WITHOUT overlap_rollouts so the contrast isolates the method half
+    (the headline PPO number stays bench_tpu's overlapped one)."""
+    _enable_compile_cache()
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trlx_tpu.data.default_configs import (
+        default_grpo_config,
+        default_ppo_config,
+    )
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.utils.loading import get_trainer
+
+    train_cfg = dict(
+        batch_size=BATCH, total_steps=10_000, eval_interval=10_000,
+        checkpoint_interval=10_000, seq_length=SEQ, epochs=10_000,
+        tracker=None, checkpoint_dir=os.path.join("/tmp", "bench_grpo_ckpts"),
+        compute_dtype="bfloat16",
+    )
+    model_cfg = dict(
+        model_path="random", num_layers_unfrozen=-1,
+        model_extra_configs={
+            "transformer": dict(
+                vocab_size=VOCAB, hidden_size=H, n_layer=L, n_head=HEADS,
+                n_positions=1024,
+            )
+        },
+    )
+    gen_kwargs = dict(max_new_tokens=NEW_TOKENS, top_k=0, top_p=1.0, do_sample=True)
+    ppo_config = default_ppo_config().evolve(
+        train=train_cfg, model=model_cfg, tokenizer=dict(tokenizer_path="byte"),
+        method=dict(num_rollouts=NUM_ROLLOUTS, chunk_size=CHUNK,
+                    ppo_epochs=PPO_EPOCHS, gen_kwargs=gen_kwargs),
+    )
+    grpo_config = default_grpo_config().evolve(
+        train=train_cfg, model=model_cfg, tokenizer=dict(tokenizer_path="byte"),
+        method=dict(num_rollouts=NUM_ROLLOUTS, chunk_size=CHUNK,
+                    group_size=8, grpo_epochs=PPO_EPOCHS,
+                    gen_kwargs=gen_kwargs),
+    )
+
+    def build(config):
+        trainer = get_trainer(config.train.trainer)(
+            config=config, reward_fn=reward_fn
+        )
+        trainer.tokenizer = WideByteTokenizer()
+        pipeline = PromptPipeline(PROMPTS, PROMPT_LEN, trainer.tokenizer)
+        trainer.add_prompt_pipeline(pipeline)
+        return trainer
+
+    def run(trainer, inner_epochs):
+        rng = np.random.default_rng(0)
+
+        def cycle():
+            trainer.store.clear_history()
+            trainer.make_experience(NUM_ROLLOUTS)
+            mark = time.time()
+            full, n = trainer._fused_epoch_batch()
+            if trainer._fused_train_step is None:
+                trainer._fused_train_step = trainer.make_fused_train_steps()
+            perms = np.stack(
+                [rng.permutation(n)[:BATCH]
+                 for _ in range(inner_epochs * (n // BATCH))]
+            ).astype(np.int32)
+            device_full = trainer.place_batch(full)
+            with trainer.mesh:
+                trainer.params, trainer.opt_state, loss, _ = (
+                    trainer._fused_train_step(
+                        trainer.params, trainer.opt_state, device_full,
+                        jnp.asarray(perms),
+                    )
+                )
+            float(loss)  # sync
+            return mark
+
+        cycle()  # warmup: compiles sampler, experience fn, train step
+        times, trains = [], []
+        for _ in range(3):
+            t0 = time.time()
+            mark = cycle()
+            dt = time.time() - t0
+            times.append(dt)
+            trains.append(t0 + dt - mark)
+        med = sorted(times)[1]
+        return {
+            "samples_per_sec": NUM_ROLLOUTS / med,
+            "train_s": sorted(trains)[1],
+            "state_bytes": _train_state_bytes(trainer),
+            "peak_bytes": _device_peak_bytes(),
+        }
+
+    # GRPO first: peak_bytes_in_use is a cumulative PROCESS peak, so
+    # the first trainer's reading is uncontaminated. PPO runs second —
+    # its reported peak is max(both), which is its own peak exactly
+    # when PPO genuinely peaks higher (the hypothesis under test; a
+    # reported ppo peak EQUAL to grpo's would disprove it, not hide it)
+    grpo = build(grpo_config)
+    g = run(grpo, PPO_EPOCHS)
+    del grpo
+    gc.collect()
+    ppo = build(ppo_config)
+    p = run(ppo, PPO_EPOCHS)
+
+    out = {
+        "grpo_samples_per_sec": round(g["samples_per_sec"], 3),
+        "grpo_train_s": round(g["train_s"], 3),
+        "grpo_train_state_mb": round(g["state_bytes"] / 2**20, 2),
+        "grpo_ppo_samples_per_sec": round(p["samples_per_sec"], 3),
+        "grpo_ppo_train_s": round(p["train_s"], 3),
+        "grpo_ppo_train_state_mb": round(p["state_bytes"] / 2**20, 2),
+        # < 1.0 = GRPO's train-phase state is smaller at the same
+        # workload (no value head params/opt-state, no values/rewards
+        # rollout columns). At this geometry the critic is a HEAD on
+        # the shared trunk, so the resident delta is modest — the
+        # activation-side saving (no value forward, no GAE) shows in
+        # peak_mb where the backend reports it.
+        "grpo_mem_vs_ppo": round(g["state_bytes"] / max(p["state_bytes"], 1), 6),
+    }
+    if g["peak_bytes"] and p["peak_bytes"]:
+        out["grpo_train_peak_mb"] = round(g["peak_bytes"] / 2**20, 2)
+        out["grpo_ppo_train_peak_mb"] = round(p["peak_bytes"] / 2**20, 2)
+    return out
+
+
 # 1.32B GPT-NeoX-class geometry (24 layers x 2048 hidden, vocab 50257 —
 # the reference's megatron_1.3b.yaml: ref configs/nemo_configs/
 # megatron_1.3b.yaml:50-57) at seq 2048 on one chip.
@@ -2086,6 +2257,9 @@ def _run_section(name: str, fn_name: str, timeout_s: float) -> dict:
 # blow any in-process budget — run scripts/warm_bench_cache.py after
 # the last code edit to populate the persistent cache).
 SECTIONS = [
+    # GRPO-vs-PPO on the headline workload: two trainers, but the
+    # compile cache shares the sampler/train-step HLO between them
+    ("grpo", "bench_grpo", 120.0, "BENCH_GRPO"),
     ("large_ppo", "bench_large_ppo", 160.0, "BENCH_LARGE"),
     # engine pillars compile 3 extra 1.3B executables (one per
     # configuration) — warm-cache sized; cold, the section self-trims
@@ -2173,6 +2347,8 @@ def _headline_result() -> dict:
         except Exception as exc:  # auxiliary; never sink the bench
             extras["randomwalks_error"] = f"{type(exc).__name__}: {exc}"[:200]
 
+    import jax
+
     return {
         "metric": "ppo_gpt2s_samples_per_sec",
         "value": round(value, 3),
@@ -2180,6 +2356,10 @@ def _headline_result() -> dict:
         "vs_baseline": round(value / baseline, 2) if baseline else None,
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": round(mfu, 4),
+        # provenance: rounds recorded on different hardware are not
+        # comparable — the trajectory table annotates by these keys
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
         **extras,
     }
 
@@ -2199,6 +2379,15 @@ def bench_record(result: dict) -> None:
         for m in [re.match(r"BENCH_r(\d+)\.json$", e)]
         if m
     ]
+    # a docs row without its artifact (an honest "*artifact missing*"
+    # gap, e.g. the unrecorded r06–r08 driver rounds) still CLAIMS its
+    # round number: recording must not collide with it — number past
+    # the maximum of both sets
+    with open(os.path.join(REPO, "docs", "benchmarks.md")) as f:
+        rounds += [
+            int(m.group(1))
+            for m in re.finditer(r"^\|\s*r(\d+)\s*\|", f.read(), re.M)
+        ]
     nn = (max(rounds) + 1) if rounds else 1
     artifact_path = os.path.join(REPO, f"BENCH_r{nn:02d}.json")
     with open(artifact_path, "w") as f:

@@ -1,0 +1,101 @@
+"""GRPO GPT2 on IMDB sentiment continuation: the PPO sentiments
+workload (examples/ppo_sentiments.py) with the critic-free
+group-relative trainer — 8 samples per prompt, advantages are the
+per-group reward z-scores, no value head. Requires HF hub access
+(gpt2-imdb weights + a sentiment classifier).
+
+SMOKE=1 runs the SAME wiring air-gapped: a tiny random-init transformer
+via model_extra_configs, the byte tokenizer, fixed prompts, and a
+synthetic lexical-positivity reward standing in for the classifier —
+so CI executes this example's full train loop end to end.
+"""
+
+import os
+from typing import Dict, List
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import TRLConfig, default_grpo_config
+
+SMOKE = os.environ.get("SMOKE", "0") == "1"
+
+
+def get_positive_score(scores: List[Dict[str, float]]) -> float:
+    return dict(map(lambda x: tuple(x.values()), scores))["POSITIVE"]
+
+
+def smoke_config() -> TRLConfig:
+    """CI-sized smoke configuration: tiny random model, byte tokenizer,
+    2 steps, groups of 4 — everything else identical to the real run's
+    wiring."""
+    return default_grpo_config().evolve(
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    hidden_size=16, n_layer=2, n_head=2, n_positions=64
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(
+            batch_size=8, total_steps=2, seq_length=16, eval_interval=2,
+            checkpoint_interval=2, tracker=None,
+        ),
+        method=dict(
+            num_rollouts=8, chunk_size=8, group_size=4, grpo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=4, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+
+
+def main(hparams={}):
+    if SMOKE:
+        config = TRLConfig.update(smoke_config().to_dict(), hparams)
+
+        def reward_fn(samples: List[str], **kwargs) -> List[float]:
+            # lexical positivity stand-in for the sentiment classifier
+            return [float(s.count("a")) - 0.05 * len(s) for s in samples]
+
+        prompts = ["the movie was", "I watched this and", "a review:",
+                   "honestly the plot", "the acting", "what a film,",
+                   "two hours of", "the director"] * 2
+        return trlx_tpu.train(
+            reward_fn=reward_fn,
+            prompts=prompts,
+            eval_prompts=prompts[:8],
+            config=config,
+        )
+
+    config = TRLConfig.update(default_grpo_config().to_dict(), hparams)
+
+    from datasets import load_dataset
+    from transformers import pipeline as hf_pipeline
+
+    sentiment_fn = hf_pipeline(
+        "sentiment-analysis",
+        "lvwerra/distilbert-imdb",
+        top_k=2,
+        truncation=True,
+        batch_size=256,
+    )
+
+    def reward_fn(samples: List[str], **kwargs) -> List[float]:
+        return list(map(get_positive_score, sentiment_fn(samples)))
+
+    imdb = load_dataset("imdb", split="train+test")
+    prompts = [" ".join(review.split()[:4]) for review in imdb["text"]]
+
+    return trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=["I don't know much about Hungarian underground"] * 256,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -2,6 +2,8 @@ import pytest
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.default_configs import (
+    default_dpo_config,
+    default_grpo_config,
     default_ilql_config,
     default_ppo_config,
     default_rft_config,
@@ -12,7 +14,8 @@ from trlx_tpu.data.method_configs import ILQLConfig, PPOConfig, get_method
 
 @pytest.mark.parametrize(
     "factory",
-    [default_ppo_config, default_ilql_config, default_sft_config, default_rft_config],
+    [default_ppo_config, default_ilql_config, default_sft_config,
+     default_rft_config, default_grpo_config, default_dpo_config],
 )
 def test_roundtrip(factory):
     cfg = factory()
@@ -135,3 +138,77 @@ def test_method_loss_delegates_match_ops():
     assert set(stats_c) == set(stats_o)
     for k in stats_o:
         np.testing.assert_array_equal(np.asarray(stats_c[k]), np.asarray(stats_o[k]))
+
+
+# ---------------------------------------------------------------------------
+# registry invariants (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_trainer_registration_raises():
+    """register_trainer must refuse to silently overwrite an existing
+    name — two trainers shadowing each other under one key was a latent
+    registry footgun."""
+    from trlx_tpu.trainer import register_trainer
+    from trlx_tpu.utils.loading import get_trainer
+
+    get_trainer("TPUPPOTrainer")  # ensure the registry is populated
+    with pytest.raises(ValueError, match="already registered"):
+
+        @register_trainer("TPUPPOTrainer")
+        class NotPPO:  # pragma: no cover - never constructed
+            pass
+
+    # the original registration survived the refused overwrite
+    assert get_trainer("TPUPPOTrainer").__name__ == "TPUPPOTrainer"
+
+
+def test_duplicate_method_registration_raises():
+    from trlx_tpu.data.method_configs import register_method
+
+    with pytest.raises(ValueError, match="already registered"):
+
+        @register_method("PPOConfig")
+        class NotPPOConfig:  # pragma: no cover - never constructed
+            pass
+
+    assert get_method("PPOConfig") is PPOConfig
+
+
+def test_registry_trainer_method_default_config_consistency():
+    """Every registered trainer has a matching default_*_config entry
+    whose method config resolves through the method registry — the
+    three registries (trainers, method configs, programmatic defaults)
+    cannot drift apart as the algorithm matrix grows."""
+    import trlx_tpu.data.default_configs as dc
+    import trlx_tpu.data.method_configs as mc
+    import trlx_tpu.trainer as trainer_pkg
+    from trlx_tpu.utils.loading import get_trainer
+
+    get_trainer("TPUPPOTrainer")  # import side effects populate registry
+    defaults = {
+        name: getattr(dc, name)()
+        for name in dir(dc)
+        if name.startswith("default_") and name.endswith("_config")
+    }
+    assert len(defaults) >= 6  # ppo/ilql/sft/rft/grpo/dpo
+    by_trainer = {}
+    for name, cfg in defaults.items():
+        key = cfg.train.trainer.lower()
+        assert key not in by_trainer, (
+            f"{name} and {by_trainer[key][0]} both target {key}"
+        )
+        by_trainer[key] = (name, cfg)
+    # every registered trainer <- exactly one default config
+    assert set(by_trainer) == set(trainer_pkg._TRAINERS), (
+        "trainer registry and default_*_config entries drifted: "
+        f"defaults={sorted(by_trainer)} registered="
+        f"{sorted(trainer_pkg._TRAINERS)}"
+    )
+    for key, (name, cfg) in sorted(by_trainer.items()):
+        # the method config is registered and its name key resolves
+        # back to the exact class the default constructed
+        assert mc.get_method(cfg.method.name) is type(cfg.method), name
+        # and the trainer class actually constructs with this method
+        # type (the trainer-side isinstance gate names the same class)
+        assert type(cfg.method).__name__.lower() in mc._METHODS

@@ -1,0 +1,241 @@
+"""DPO (offline direct preference optimization) tests.
+
+Unit layer: the sigmoid loss pinned against hand-computed goldens on
+fixed logprobs (plus the conservative label-smoothing mix), the
+sequence-logprob mask contract on golden logits, and the pairwise
+storage's tokenization/collation invariants.
+
+Integration layer (ISSUE 9 acceptance): DPO converges on a separable
+synthetic preference set (accuracy > 0.9) through the public
+``trlx_tpu.train()`` API, with the frozen-reference margin verified —
+the reference tree is bit-identical to the initial policy after
+training while the policy itself moved.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import trlx_tpu
+from trlx_tpu.data.default_configs import default_dpo_config
+from trlx_tpu.ops.dpo import dpo_loss, sequence_logprobs
+
+# ---------------------------------------------------------------------------
+# ops layer
+# ---------------------------------------------------------------------------
+
+# pinned golden (computed once by hand from the closed form):
+# margins = beta * [((-1)-(-1.5)) - ((-3)-(-2.5)), ((-4)-(-3)) - ((-2)-(-2.5))]
+#         = 0.1 * [1.0, -1.5] = [0.1, -0.15]
+# loss    = mean(-log sigmoid(margin)) = 0.7076768539315514
+PC = jnp.asarray([-1.0, -4.0], jnp.float32)
+PR = jnp.asarray([-3.0, -2.0], jnp.float32)
+RC = jnp.asarray([-1.5, -3.0], jnp.float32)
+RR = jnp.asarray([-2.5, -2.5], jnp.float32)
+
+
+def test_dpo_loss_pinned_golden():
+    loss, stats = dpo_loss(PC, PR, RC, RR, beta=0.1)
+    np.testing.assert_allclose(float(loss), 0.7076768539315514, rtol=1e-6)
+    assert float(stats["dpo/accuracy"]) == 0.5  # one pair each way
+    np.testing.assert_allclose(float(stats["dpo/margin"]), -0.025, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(stats["dpo/chosen_reward"]), -0.025, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(stats["dpo/rejected_reward"]), 0.0, atol=1e-7
+    )
+
+
+def test_dpo_loss_label_smoothing_golden():
+    loss, _ = dpo_loss(PC, PR, RC, RR, beta=0.1, label_smoothing=0.1)
+    np.testing.assert_allclose(float(loss), 0.7051768539315515, rtol=1e-6)
+
+
+def test_dpo_loss_reference_gradient_is_blocked():
+    """The frozen reference enters stop-gradiented: d loss / d ref == 0
+    while d loss / d policy != 0."""
+
+    def loss_of(pc, rc):
+        return dpo_loss(pc, PR, rc, RR, beta=0.1)[0]
+
+    g_policy = jax.grad(loss_of, argnums=0)(PC, RC)
+    g_ref = jax.grad(loss_of, argnums=1)(PC, RC)
+    assert float(jnp.abs(g_policy).max()) > 0
+    np.testing.assert_array_equal(np.asarray(g_ref), np.zeros_like(g_ref))
+
+
+def test_sequence_logprobs_golden_logits():
+    """Hand-computed: only response positions (mask=1) past the shift
+    contribute, each the log-softmax of its label."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(1, 4, 5)), jnp.float32)
+    ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    resp = jnp.asarray([[0, 0, 1, 1]], jnp.int32)  # completion = last two
+    got = float(sequence_logprobs(logits, ids, resp)[0])
+    logp = np.asarray(jax.nn.log_softmax(logits[0], axis=-1))
+    # position t's label is ids[t+1]: response tokens 3 (from pos 1) and
+    # 4 (from pos 2) — the shifted mask keeps exactly those
+    expected = logp[1, 3] + logp[2, 4]
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_dpo_config_validation():
+    from trlx_tpu.data.method_configs import DPOConfig
+
+    with pytest.raises(ValueError, match="beta"):
+        DPOConfig(name="d", beta=0.0)
+    with pytest.raises(ValueError, match="label_smoothing"):
+        DPOConfig(name="d", label_smoothing=0.5)
+
+
+# ---------------------------------------------------------------------------
+# pairwise pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_dpo_pair_storage_collation():
+    from trlx_tpu.pipeline.dpo_pipeline import DPOPairStorage
+    from trlx_tpu.utils.tokenizers import ByteTokenizer
+
+    tok = ByteTokenizer()
+    store = DPOPairStorage(
+        [("ab", "cd", "x"), ("p", "longer chosen", "r")], tok, max_length=32
+    )
+    batch = store.collate([store[0], store[1]])
+    # both sides share ONE static width (the trainer stacks them)
+    assert batch.chosen_ids.shape == batch.rejected_ids.shape
+    # response masks mark completion tokens only — never prompt tokens
+    for ids, am, rm in (
+        (batch.chosen_ids, batch.chosen_attention_mask,
+         batch.chosen_response_mask),
+        (batch.rejected_ids, batch.rejected_attention_mask,
+         batch.rejected_response_mask),
+    ):
+        assert rm.shape == ids.shape
+        # response tokens are a subset of real tokens
+        assert np.all(rm <= am)
+        assert rm.sum() > 0
+    # the prompt prefix of chosen and rejected rows is identical
+    n_prompt = int(
+        (batch.chosen_response_mask[0] == 0).argmin()
+    )  # first response position
+    np.testing.assert_array_equal(
+        batch.chosen_ids[0, :n_prompt], batch.rejected_ids[0, :n_prompt]
+    )
+
+
+def test_dpo_pair_storage_rejects_malformed():
+    from trlx_tpu.pipeline.dpo_pipeline import DPOPairStorage
+    from trlx_tpu.utils.tokenizers import ByteTokenizer
+
+    tok = ByteTokenizer()
+    with pytest.raises(ValueError, match="triples"):
+        DPOPairStorage([("prompt", "chosen")], tok)
+    with pytest.raises(ValueError, match="at least one"):
+        DPOPairStorage([], tok)
+
+
+# ---------------------------------------------------------------------------
+# learn() integration (ISSUE 9 acceptance)
+# ---------------------------------------------------------------------------
+
+
+def dpo_tiny_config(ckpt_dir, *, train=None, method=None):
+    return default_dpo_config().evolve(
+        train=dict(
+            dict(batch_size=8, total_steps=24, eval_interval=1000,
+                 checkpoint_interval=1000, seq_length=16, epochs=100,
+                 tracker="jsonl", save_best=False,
+                 checkpoint_dir=str(ckpt_dir)),
+            **(train or {}),
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    hidden_size=32, n_layer=2, n_head=2, n_positions=64
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        optimizer=dict(kwargs=dict(lr=5e-3)),
+        scheduler=dict(kwargs=dict(eta_min=5e-3)),
+        method=dict(
+            dict(beta=0.5,
+                 gen_kwargs=dict(max_new_tokens=4, do_sample=False)),
+            **(method or {}),
+        ),
+    )
+
+
+# a separable synthetic preference set: chosen completions are runs of
+# one byte, rejected of another — linearly separable for a tiny model
+SEPARABLE_PAIRS = [
+    (p, "aaaa", "zzzz") for p in
+    ("the", "a b", "go", "ok", "hi", "q", "xy", "meh")
+] * 2
+
+
+def test_dpo_converges_on_separable_preferences(tmp_path):
+    """ISSUE 9 acceptance: accuracy > 0.9 on a separable synthetic
+    set, and the frozen-reference margin is real — the reference tree
+    is BIT-IDENTICAL to the initial policy after training while the
+    policy itself moved."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    config = dpo_tiny_config(ckpt_dir)
+    # capture the initial policy: the trainer's reference must still
+    # equal it after training (frozen), while the policy departs
+    trainer = trlx_tpu.train(samples=SEPARABLE_PAIRS, config=config)
+    assert trainer.iter_count == config.train.total_steps
+
+    recs = [
+        json.loads(line)
+        for line in open(os.path.join(ckpt_dir, "logs", "metrics.jsonl"))
+    ]
+    accs = [r["dpo/accuracy"] for r in recs if "dpo/accuracy" in r]
+    margins = [r["dpo/margin"] for r in recs if "dpo/margin" in r]
+    assert accs, "no dpo/accuracy metrics logged"
+    assert accs[-1] > 0.9, f"final accuracy {accs[-1]} (trajectory {accs})"
+    # the implicit-reward margin grew monotonically enough to separate
+    assert margins[-1] > margins[0]
+
+    # frozen-reference check: ref == the initial policy bit-for-bit.
+    # The init is deterministic in the config seed, so a fresh trainer
+    # reproduces it exactly — no snapshot needed.
+    from trlx_tpu.utils.loading import get_trainer
+
+    fresh = get_trainer(config.train.trainer)(config=config)
+    ref = jax.tree_util.tree_map(np.asarray, trainer.ref_params)
+    init = jax.tree_util.tree_map(np.asarray, fresh.params["base"])
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(ref)[0],
+        jax.tree_util.tree_flatten_with_path(init)[0],
+    ):
+        np.testing.assert_array_equal(a, b, err_msg=jax.tree_util.keystr(pa))
+    # ... while the policy moved away from it
+    moved = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(trainer.params["base"]),
+            jax.tree_util.tree_leaves(trainer.ref_params),
+        )
+    )
+    assert moved, "policy params never departed the reference"
+
+
+def test_dpo_rejects_rewards_argument(tmp_path):
+    """DPO's signal is the pair ordering — passing rewards is a usage
+    error the trainer must name, not silently ignore."""
+    config = dpo_tiny_config(
+        str(tmp_path / "ckpts"), train=dict(total_steps=1)
+    )
+    with pytest.raises(ValueError, match="preference ordering"):
+        trlx_tpu.train(
+            samples=SEPARABLE_PAIRS, rewards=[1.0] * len(SEPARABLE_PAIRS),
+            config=config,
+        )

@@ -70,3 +70,42 @@ def test_ppo_sentiments_smoke_executes(tmp_path, monkeypatch):
         # module must see the real (non-smoke) path again
         monkeypatch.delenv("SMOKE")
         importlib.reload(mod)
+
+
+@pytest.mark.slow
+def test_grpo_sentiments_smoke_executes(tmp_path, monkeypatch):
+    """The GRPO flagship example's full wiring end to end under
+    SMOKE=1: random-init tiny model + byte tokenizer + synthetic
+    reward, trains 2 steps through the shared online core."""
+    monkeypatch.setenv("SMOKE", "1")
+    import importlib
+
+    import examples.grpo_sentiments as mod
+
+    mod = importlib.reload(mod)  # re-evaluate the SMOKE flag
+    try:
+        trainer = mod.main({"train.checkpoint_dir": str(tmp_path / "ckpts")})
+        assert trainer.iter_count == 2
+        assert set(trainer.params.keys()) == {"base"}  # critic-free
+    finally:
+        monkeypatch.delenv("SMOKE")
+        importlib.reload(mod)
+
+
+@pytest.mark.slow
+def test_dpo_sentiments_smoke_executes(tmp_path, monkeypatch):
+    """The DPO example's full wiring end to end under SMOKE=1: a
+    synthetic separable preference set through the offline pairwise
+    pipeline, trains 2 steps."""
+    monkeypatch.setenv("SMOKE", "1")
+    import importlib
+
+    import examples.dpo_sentiments as mod
+
+    mod = importlib.reload(mod)
+    try:
+        trainer = mod.main({"train.checkpoint_dir": str(tmp_path / "ckpts")})
+        assert trainer.iter_count == 2
+    finally:
+        monkeypatch.delenv("SMOKE")
+        importlib.reload(mod)

@@ -531,7 +531,7 @@ def test_below_min_workers_degrades_golden(exp_baseline, tmp_path):
 def test_fleet_requires_exp_transport(tmp_path):
     import trlx_tpu
 
-    with pytest.raises(ValueError, match="requires ppo.exp.enabled"):
+    with pytest.raises(ValueError, match="requires method.exp.enabled"):
         config = _tiny_config(
             str(tmp_path / "noexp"), fleet=dict(enabled=True)
         ).evolve(method=dict(exp=dict(enabled=False)))

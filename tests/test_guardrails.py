@@ -597,3 +597,141 @@ def test_chaos_reward_timeout_fallback_keeps_run_alive(tmp_path):
     # the fallback held the reward distribution stationary: running
     # moments stayed finite
     assert np.isfinite(float(np.asarray(trainer.running_moments.mean)))
+
+
+# ---------------------------------------------------------------------------
+# learn() under chaos: the preference-RL trainers (ISSUE 9 satellite —
+# GRPO/DPO get the same coverage PR 5 gave ILQL/SFT/RFT)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_grpo_config(ckpt_dir, *, chaos, train=None, method=None):
+    from tests.test_grpo import grpo_tiny_config
+
+    base_train = dict(
+        total_steps=6, epochs=48, eval_interval=100, checkpoint_interval=2,
+        save_best=False, keep_last_n=3, tracker=None,
+        guardrails=dict(enabled=True, min_history=2,
+                        ladder=["requeue", "rollback", "abort"],
+                        cooldown_cycles=2, max_rollbacks=3),
+        chaos=chaos, **FAST_RETRY,
+    )
+    base_train.update(train or {})
+    return grpo_tiny_config(ckpt_dir, train=base_train, method=method)
+
+
+def test_chaos_grpo_nan_burst_rollback_recovers(tmp_path):
+    """GRPO under the PR 3 chaos recipe: an injected NaN burst in the
+    fused block -> in-graph skip-guard -> ladder walks requeue ->
+    rollback -> the run still completes its full step budget with
+    finite params, all through the SHARED online experience core."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    config = _chaos_grpo_config(
+        ckpt_dir,
+        chaos=dict(seed=0, faults=[{"fault": "nan_loss", "at": 3, "span": 2}]),
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS, config=config
+    )
+    assert trainer.iter_count == 6  # full budget, no human intervention
+    assert trainer.guardrails.rollbacks >= 1
+    assert trainer.guardrails.actions_taken[:2] == ["requeue", "rollback"]
+    fired = [f["fault"] for f in trainer.chaos.fired]
+    assert fired.count("nan_loss") == 2
+    import jax
+
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("checkpoint_"):
+            assert is_committed(os.path.join(ckpt_dir, name)), name
+    assert all(
+        np.all(np.isfinite(np.asarray(x)))
+        for x in jax.tree_util.tree_leaves(trainer.params)
+    )
+
+
+def test_chaos_grpo_sigterm_mid_fused_block_commits_final(tmp_path):
+    """A SIGTERM landing while GRPO's fused block is mid-flight must
+    end in ONE final committed checkpoint at the preempted step and a
+    clean return — the coordinated-preemption contract every other
+    trainer already holds."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    config = _chaos_grpo_config(
+        ckpt_dir,
+        chaos=dict(seed=0, faults=[{"fault": "sigterm", "at": 2}]),
+        train=dict(total_steps=4, checkpoint_interval=100),
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=word_count_reward, prompts=PPO_PROMPTS, config=config
+    )
+    assert trainer.iter_count == 2  # stopped at the preempted step
+    mgr = CheckpointManager(ckpt_dir)
+    last = mgr.latest_committed()
+    assert last is not None and is_committed(last)
+    with open(os.path.join(last, "state.json")) as f:
+        state = json.load(f)
+    assert state["iter_count"] == 2
+    # the online core's cursor rode the final commit: the resumed run
+    # replays the abandoned cycle's prompts instead of skipping them
+    assert state["prompt_batches_consumed"] >= 1
+
+
+def _chaos_dpo_config(ckpt_dir, *, chaos, train=None):
+    from tests.test_dpo import dpo_tiny_config
+
+    base_train = dict(
+        total_steps=4, epochs=16, eval_interval=100, checkpoint_interval=2,
+        save_best=False, tracker=None,
+        guardrails=dict(enabled=True, ladder=["rollback", "abort"],
+                        cooldown_cycles=2, max_rollbacks=3),
+        chaos=chaos, **FAST_RETRY,
+    )
+    base_train.update(train or {})
+    return dpo_tiny_config(ckpt_dir, train=base_train)
+
+
+def test_chaos_dpo_nan_burst_rollback_recovers(tmp_path):
+    """DPO batches carry int-only leaves, so the chaos poison swaps
+    token ids for out-of-range indices — the embedding gather goes NaN
+    IN-GRAPH, the traced skip-guard keeps the pre-update params, and
+    the ladder walks to an auto-rollback; the run must still complete
+    its full step budget."""
+    from tests.test_dpo import SEPARABLE_PAIRS
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    config = _chaos_dpo_config(
+        ckpt_dir,
+        chaos=dict(seed=0, faults=[{"fault": "nan_loss", "at": 3, "span": 2}]),
+    )
+    trainer = trlx_tpu.train(samples=SEPARABLE_PAIRS, config=config)
+    assert trainer.iter_count == 4  # full budget, no human intervention
+    assert trainer.guardrails.rollbacks >= 1
+    assert "loss" in trainer.guardrails.trip_history
+    fired = [f["fault"] for f in trainer.chaos.fired]
+    assert fired.count("nan_loss") == 2
+    import jax
+
+    assert all(
+        np.all(np.isfinite(np.asarray(x)))
+        for x in jax.tree_util.tree_leaves(trainer.params)
+    )
+
+
+def test_chaos_dpo_sigterm_mid_step_commits_final(tmp_path):
+    """DPO's per-step loop under the sigterm chaos site: a preemption
+    mid-step ends in ONE final committed checkpoint at the preempted
+    step and a clean return."""
+    from tests.test_dpo import SEPARABLE_PAIRS
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    config = _chaos_dpo_config(
+        ckpt_dir,
+        chaos=dict(seed=0, faults=[{"fault": "sigterm", "at": 2}]),
+        train=dict(checkpoint_interval=100),
+    )
+    trainer = trlx_tpu.train(samples=SEPARABLE_PAIRS, config=config)
+    assert trainer.iter_count == 2  # stopped at the preempted step
+    mgr = CheckpointManager(ckpt_dir)
+    last = mgr.latest_committed()
+    assert last is not None and is_committed(last)
+    with open(os.path.join(last, "state.json")) as f:
+        assert json.load(f)["iter_count"] == 2

@@ -29,22 +29,27 @@ TIER1_BUDGETS = {
     "test_configs.py": 5,
     "test_curves.py": 10,
     "test_deferred_stats.py": 5,
-    # trimmed r08 against fresh serial measurements (same playbook as
-    # the r07 trim: measure the biggest budgets, reclaim the slack) to
-    # fit the fleet suite under the unchanged ceiling — elastic 33.8s,
-    # exp_queue 30.8s, gen_engine 37.5s, guardrails 57.3s measured
-    # 2026-08-03; fault_tolerance measured 93.0s and keeps its 90s+
-    # budget unchanged (it has no slack to reclaim)
-    "test_elastic.py": 45,
+    "test_dpo.py": 15,
+    # r09 re-baseline: every touched-or-large budget re-measured
+    # SERIALLY on the idle 8-way CPU mesh (2026-08-03) to pay for the
+    # preference-RL suites under the unchanged ceiling — elastic 32.0s,
+    # exp_queue 28.2s, gen_engine 32.6s, fleet 33.7s, fault_tolerance
+    # 62.4s, scanned_epochs 42.4s (RAISED 40->50: it was already over),
+    # generation 11.5s, seq2seq 16.6s, remat 0.3s, models 16.2s
+    # (raised 15->20), peft 13.9s, trainers 7.9s
+    "test_elastic.py": 40,
     "test_examples.py": 20,
-    "test_exp_queue.py": 45,
-    "test_fault_tolerance.py": 90,
+    "test_exp_queue.py": 35,
+    "test_fault_tolerance.py": 75,
     "test_flash_attention.py": 15,
-    "test_fleet.py": 65,
-    "test_gen_engine.py": 50,
-    "test_generation.py": 30,
+    "test_fleet.py": 40,
+    "test_gen_engine.py": 40,
+    "test_generation.py": 15,
     "test_golden.py": 10,
-    "test_guardrails.py": 65,
+    "test_grpo.py": 55,
+    # r09: +4 preference-RL chaos learn() tests (GRPO nan/sigterm, DPO
+    # nan/sigterm); whole file re-measured 99.9s serial
+    "test_guardrails.py": 110,
     "test_marker_audit.py": 2,
     "test_mcts_value_branch.py": 15,
     "test_models.py": 20,
@@ -55,21 +60,21 @@ TIER1_BUDGETS = {
     # sharding 6.1s, properties 0.06s measured 2026-08-03
     "test_multihost.py": 5,
     "test_ops.py": 10,
-    "test_peft.py": 25,
+    "test_peft.py": 18,
     "test_pipeline_parallel.py": 10,
     "test_pipelines.py": 10,
     "test_properties.py": 5,
     "test_reference_harness.py": 10,
-    "test_remat.py": 20,
+    "test_remat.py": 5,
     "test_resilient.py": 5,
     "test_ring_attention.py": 10,
-    "test_scanned_epochs.py": 40,
-    "test_seq2seq.py": 25,
+    "test_scanned_epochs.py": 50,
+    "test_seq2seq.py": 20,
     "test_sharding.py": 10,
     "test_summarize_eval.py": 5,
     "test_supervisor.py": 15,
     "test_sweep.py": 15,
-    "test_trainers.py": 15,
+    "test_trainers.py": 10,
     "test_utils.py": 5,
     "test_watchdog.py": 10,
 }
@@ -90,6 +95,10 @@ TIER1_BUDGET_CEILING_S = 780
 # are tiny (documented tradeoff; everything else slow-marks them)
 LEARN_IN_TIER1_ALLOWLIST = {
     "test_elastic.py",          # resharded-resume / quarantine-fallback
+    "test_grpo.py",             # engine+transport golden + resume need
+                                # tiny learns (the subject under test)
+    "test_dpo.py",              # separable-preference convergence IS
+                                # the acceptance criterion
     "test_exp_queue.py",        # exp-vs-direct golden needs two tiny learns
     "test_fleet.py",            # fleet-vs-exp goldens (degraded +
                                 # multi-process worker-kill) are the

@@ -3,6 +3,14 @@
 Parity: /root/reference/trlx/trlx.py:15-143 — same signature and the same
 argument-driven algorithm selection: `reward_fn` -> online PPO,
 `rewards`/`dataset` -> offline ILQL, otherwise SFT.
+
+Beyond the reference's four algorithms the registry also carries the
+critic-free preference-RL pair: `train.trainer="TPUGRPOTrainer"` runs
+GRPO through the online branch (same `reward_fn` + `prompts` contract
+as PPO, riding the shared experience core), and
+`train.trainer="TPUDPOTrainer"` runs DPO through the offline branch
+with `samples` as (prompt, chosen, rejected) preference triples and no
+`rewards`.
 """
 
 from __future__ import annotations
@@ -103,12 +111,14 @@ def train(
             eval_prompts = [trainer.tokenizer.bos_token] * batch_size
         trainer.make_experience(samples, rewards, config.train.seq_length)
 
-    # --- supervised ------------------------------------------------------
+    # --- supervised / offline preference pairs ---------------------------
     else:
         if samples is None:
             raise ValueError("Either `samples`, `rewards` or `reward_fn` must be given")
         if eval_prompts is None:
             eval_prompts = [trainer.tokenizer.bos_token] * batch_size
+        # SFT takes strings or (prompt, output) dialogues; DPO takes
+        # (prompt, chosen, rejected) triples — the trainer validates
         trainer.make_experience(samples, None, config.train.seq_length)
 
     eval_pipeline = get_pipeline(config.train.pipeline)(

@@ -12,7 +12,7 @@ All arrays carry static padded shapes (XLA requirement).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Optional
 
 import flax.struct
 import jax.numpy as jnp
@@ -52,6 +52,40 @@ class PPORolloutBatch:
     # pytree-empty leaf, so every existing path (store concat, device
     # gathers, fused-scan perms) is untouched when the feature is off.
     is_weight: Optional[jnp.ndarray] = None  # [batch, resp_len] f32
+
+
+@flax.struct.dataclass
+class GRPORolloutBatch:
+    """Batched GRPO experience: PPO's rollout layout minus the value
+    column. No ``values``, no ``rewards`` tensor — the sequence-level
+    group-relative advantage replaces both, and the KL regularizer is
+    computed in-loss from the stored reference logprobs instead of
+    being folded into a per-token reward."""
+
+    query_tensors: jnp.ndarray  # [batch, prompt_len] int32, left-padded
+    response_tensors: jnp.ndarray  # [batch, resp_len] int32, right-padded
+    logprobs: jnp.ndarray  # [batch, resp_len] f32, behavior logprobs
+    ref_logprobs: jnp.ndarray  # [batch, resp_len] f32, frozen reference
+    advantages: jnp.ndarray  # [batch] f32, per-group reward z-score
+    response_mask: jnp.ndarray  # [batch, resp_len] f32 (1 = real token)
+    # experience-transport staleness correction (exp.staleness.mode:
+    # clip) — same contract as PPORolloutBatch.is_weight
+    is_weight: Optional[jnp.ndarray] = None  # [batch, resp_len] f32
+
+
+@flax.struct.dataclass
+class DPOBatch:
+    """One collated batch of preference pairs: prompt+chosen and
+    prompt+rejected rows, right-padded to the dataset's static widths.
+    ``*_response_mask`` marks exactly the completion tokens (prompt and
+    pad positions contribute nothing to the sequence logprob)."""
+
+    chosen_ids: jnp.ndarray  # [batch, seq] int32
+    chosen_attention_mask: jnp.ndarray  # [batch, seq] int32
+    chosen_response_mask: jnp.ndarray  # [batch, seq] int32
+    rejected_ids: jnp.ndarray  # [batch, seq] int32
+    rejected_attention_mask: jnp.ndarray  # [batch, seq] int32
+    rejected_response_mask: jnp.ndarray  # [batch, seq] int32
 
 
 @flax.struct.dataclass

@@ -21,10 +21,25 @@ _METHODS: Dict[str, type] = {}
 
 
 def register_method(name_or_cls):
-    """Register a method config class under a lowercase name (decorator)."""
+    """Register a method config class under a lowercase name (decorator).
+
+    A duplicate name raises: two configs silently shadowing each other
+    under one key is exactly the bug a registry exists to prevent.
+    Re-registering the SAME class is a no-op (module reloads)."""
 
     def _register(cls, name: str):
-        _METHODS[name.lower()] = cls
+        key = name.lower()
+        existing = _METHODS.get(key)
+        if existing is not None and (
+            (existing.__module__, existing.__qualname__)
+            != (cls.__module__, cls.__qualname__)
+        ):
+            raise ValueError(
+                f"method config {name!r} is already registered to "
+                f"{existing.__module__}.{existing.__qualname__}; refusing "
+                "to overwrite it silently — pick a distinct name"
+            )
+        _METHODS[key] = cls
         return cls
 
     if isinstance(name_or_cls, str):
@@ -158,6 +173,100 @@ class PPOConfig(MethodConfig):
             logprobs, values, old_logprobs, old_values, advantages, returns, mask,
             cliprange=self.cliprange, cliprange_value=self.cliprange_value,
             vf_coef=self.vf_coef,
+        )
+
+
+@dataclass
+@register_method
+class GRPOConfig(MethodConfig):
+    """GRPO hyperparameters (Group Relative Policy Optimization,
+    arXiv:2402.03300): PPO's clipped surrogate with a critic-free
+    group-relative advantage — ``group_size`` samples per prompt,
+    advantage = per-group reward z-score (ops/grpo.py). No value head,
+    no value loss, no critic optimizer state; the KL regularizer sits
+    in the LOSS against the frozen reference (``kl_coef``) instead of
+    riding the reward. The rollout engine — prompt stream, chunked
+    generation, overlap prefetch, decode engine, experience transport,
+    rollout fleet — is the shared online core (trainer.base.
+    TPUOnlineTrainer): the ``overlap_rollouts`` / ``gen_engine`` /
+    ``exp`` / ``fleet`` knobs below carry PPO's exact semantics
+    (documented on PPOConfig)."""
+
+    group_size: int = 8
+    grpo_epochs: int = 4
+    num_rollouts: int = 128
+    # samples generated per chunk: chunk_size/group_size prompts are
+    # pulled from the stream and each tiled group_size times, so every
+    # group's members are consecutive rows of one chunk
+    chunk_size: int = 128
+    kl_coef: float = 0.001
+    cliprange: float = 0.2
+    scale_reward: Optional[str] = "ignored"
+    ref_mean: Optional[float] = None
+    ref_std: Optional[float] = None
+    cliprange_reward: float = 10.0
+    gen_kwargs: dict = field(default_factory=lambda: dict(max_new_tokens=40))
+    gen_experience_kwargs: Optional[dict] = None
+    overlap_rollouts: bool = False
+    gen_engine: dict = field(default_factory=dict)
+    exp: dict = field(default_factory=dict)
+    fleet: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.group_size < 2:
+            raise ValueError(
+                f"grpo.group_size must be >= 2 (got {self.group_size}): a "
+                "group of one has no relative baseline"
+            )
+        if self.chunk_size % self.group_size:
+            raise ValueError(
+                f"grpo.chunk_size {self.chunk_size} must be divisible by "
+                f"group_size {self.group_size} (whole groups per chunk)"
+            )
+        if self.num_rollouts % self.chunk_size:
+            raise ValueError(
+                f"grpo.num_rollouts {self.num_rollouts} must be divisible "
+                f"by chunk_size {self.chunk_size}: a partial final chunk "
+                "would split a group across cycles"
+            )
+
+    def loss(self, logprobs, old_logprobs, ref_logprobs, advantages, mask):
+        from trlx_tpu.ops.grpo import grpo_loss
+
+        return grpo_loss(
+            logprobs, old_logprobs, ref_logprobs, advantages, mask,
+            cliprange=self.cliprange, kl_coef=self.kl_coef,
+        )
+
+
+@dataclass
+@register_method
+class DPOConfig(MethodConfig):
+    """DPO hyperparameters (Direct Preference Optimization,
+    arXiv:2305.18290): offline sigmoid preference loss over
+    policy-vs-frozen-reference logprob margins on (prompt, chosen,
+    rejected) pairs. ``beta`` scales the implicit reward;
+    ``label_smoothing`` is the conservative-DPO flip probability."""
+
+    beta: float = 0.1
+    label_smoothing: float = 0.0
+    gen_kwargs: dict = field(default_factory=lambda: dict(max_new_tokens=40))
+
+    def __post_init__(self):
+        if self.beta <= 0:
+            raise ValueError(f"dpo.beta must be > 0 (got {self.beta})")
+        if not 0.0 <= self.label_smoothing < 0.5:
+            raise ValueError(
+                "dpo.label_smoothing must be in [0, 0.5) (got "
+                f"{self.label_smoothing}): past 0.5 the labels invert"
+            )
+
+    def loss(self, policy_chosen, policy_rejected, ref_chosen, ref_rejected):
+        from trlx_tpu.ops.dpo import dpo_loss
+
+        return dpo_loss(
+            policy_chosen, policy_rejected, ref_chosen, ref_rejected,
+            beta=self.beta, label_smoothing=self.label_smoothing,
         )
 
 

@@ -1,0 +1,73 @@
+"""DPO numerics: the sigmoid preference loss over policy-vs-frozen-
+reference logprob margins (Rafailov et al., arXiv:2305.18290).
+
+DPO is offline preference RL without a reward model or sampling: for
+each (prompt, chosen, rejected) pair the implicit reward of a
+completion is ``beta * (log pi(y|x) - log pi_ref(y|x))`` and the loss
+is binary logistic regression on the reward margin. Both functions are
+pure and jittable; ``dpo_loss`` runs unchanged inside the fused-block
+``lax.scan`` train path (the scanned epoch machinery is loss-agnostic).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.ops.common import flatten_dict, logprobs_of_labels
+
+
+def sequence_logprobs(
+    logits: jnp.ndarray, input_ids: jnp.ndarray, response_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Summed next-token logprob of each row's RESPONSE tokens.
+
+    logits: [batch, seq, vocab]; input_ids / response_mask: [batch,
+    seq] with response_mask = 1 exactly on completion tokens (the
+    prompt and padding contribute nothing). Position ``t``'s label is
+    ``input_ids[t+1]`` — the standard shift."""
+    lp = logprobs_of_labels(logits[:, :-1], input_ids[:, 1:])
+    return (lp * response_mask[:, 1:].astype(jnp.float32)).sum(axis=-1)
+
+
+def dpo_loss(
+    policy_chosen_logps: jnp.ndarray,
+    policy_rejected_logps: jnp.ndarray,
+    ref_chosen_logps: jnp.ndarray,
+    ref_rejected_logps: jnp.ndarray,
+    beta: float,
+    label_smoothing: float = 0.0,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Sigmoid DPO objective on per-sequence summed logprobs [batch].
+
+    ``-log sigmoid(beta * margin)`` where ``margin = (pi_c - ref_c) -
+    (pi_r - ref_r)``; ``label_smoothing`` is the conservative-DPO mix
+    (arXiv:2305.18290 eq. 7 footnote / cDPO): probability the
+    preference label is flipped. The reference logps enter
+    stop-gradiented — the frozen reference never trains.
+    """
+    ref_chosen_logps = jax.lax.stop_gradient(ref_chosen_logps)
+    ref_rejected_logps = jax.lax.stop_gradient(ref_rejected_logps)
+    chosen_rewards = beta * (policy_chosen_logps - ref_chosen_logps)
+    rejected_rewards = beta * (policy_rejected_logps - ref_rejected_logps)
+    margin = chosen_rewards - rejected_rewards
+
+    loss = (
+        -jax.nn.log_sigmoid(margin) * (1.0 - label_smoothing)
+        - jax.nn.log_sigmoid(-margin) * label_smoothing
+    ).mean()
+
+    stats = dict(
+        losses=dict(total_loss=loss),
+        dpo=dict(
+            accuracy=(margin > 0).astype(jnp.float32).mean(),
+            margin=margin.mean(),
+            chosen_reward=chosen_rewards.mean(),
+            rejected_reward=rejected_rewards.mean(),
+            logprob_chosen=policy_chosen_logps.mean(),
+            logprob_rejected=policy_rejected_logps.mean(),
+        ),
+    )
+    return loss, flatten_dict(stats)

@@ -1,0 +1,107 @@
+"""Pairwise preference pipeline for DPO.
+
+Offline (prompt, chosen, rejected) triples tokenized through the same
+dialogue machinery SFT/ILQL use (`tokenize_dialogue`: BOS/EOS
+guarantees, whole-message-aware truncation), stored as two parallel
+rows per pair and collated to ONE dataset-wide static width shared by
+both sides — the trainer concatenates chosen and rejected rows into a
+single forward, so a per-side width would double the compiled shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+from trlx_tpu.data import DPOBatch
+from trlx_tpu.pipeline import BaseRolloutStore, DataLoader
+from trlx_tpu.pipeline.offline_pipeline import _pad_id, _pad_right, tokenize_dialogue
+
+
+def _pair_row(prompt: str, completion: str, tokenizer, max_length: int):
+    """One side of a pair as (input_ids, response_mask): dialogue
+    tokenization marks exactly the completion tokens as outputs."""
+    msgs = tokenize_dialogue([prompt, completion], tokenizer, max_length)
+    ids = [t for m in msgs for t in m.tokens]
+    resp = [1 if m.is_output else 0 for m in msgs for _ in m.tokens]
+    if not any(resp):
+        raise ValueError(
+            f"preference completion tokenized to zero tokens under "
+            f"max_length={max_length}: {completion!r}"
+        )
+    return ids, resp
+
+
+class DPOPairStorage(BaseRolloutStore):
+    """Offline preference dataset: per-pair chosen/rejected token rows
+    with response masks, padded at collate time to one static width."""
+
+    def __init__(
+        self,
+        pairs: Iterable[Sequence[str]],
+        tokenizer,
+        max_length: int = 2048,
+    ):
+        super().__init__()
+        self.tokenizer = tokenizer
+        self.history: List[dict] = []
+        for i, pair in enumerate(pairs):
+            if len(pair) != 3:
+                raise ValueError(
+                    "DPO samples must be (prompt, chosen, rejected) "
+                    f"triples; sample {i} has {len(pair)} elements"
+                )
+            prompt, chosen, rejected = pair
+            c_ids, c_resp = _pair_row(prompt, chosen, tokenizer, max_length)
+            r_ids, r_resp = _pair_row(prompt, rejected, tokenizer, max_length)
+            self.history.append(
+                dict(
+                    chosen_ids=c_ids, chosen_response=c_resp,
+                    rejected_ids=r_ids, rejected_response=r_resp,
+                )
+            )
+        if not self.history:
+            raise ValueError("DPO needs at least one preference pair")
+        # ONE width for both sides: the trainer stacks [chosen; rejected]
+        # into a single forward
+        self.seq_width = max(
+            max(len(h["chosen_ids"]), len(h["rejected_ids"]))
+            for h in self.history
+        )
+
+    def push(self, exps):
+        raise NotImplementedError(
+            "DPO storage is built once from offline preference pairs"
+        )
+
+    def __getitem__(self, ix: int) -> dict:
+        return self.history[ix]
+
+    def __len__(self) -> int:
+        return len(self.history)
+
+    def collate(self, elems: List[dict]) -> DPOBatch:
+        width = self.seq_width
+        pad = _pad_id(self.tokenizer)
+        c_ids, c_mask = _pad_right([e["chosen_ids"] for e in elems], width, pad)
+        c_resp, _ = _pad_right([e["chosen_response"] for e in elems], width, 0)
+        r_ids, r_mask = _pad_right([e["rejected_ids"] for e in elems], width, pad)
+        r_resp, _ = _pad_right([e["rejected_response"] for e in elems], width, 0)
+        return DPOBatch(
+            chosen_ids=np.asarray(c_ids, np.int32),
+            chosen_attention_mask=np.asarray(c_mask, np.int32),
+            chosen_response_mask=np.asarray(c_resp, np.int32),
+            rejected_ids=np.asarray(r_ids, np.int32),
+            rejected_attention_mask=np.asarray(r_mask, np.int32),
+            rejected_response_mask=np.asarray(r_resp, np.int32),
+        )
+
+    def create_loader(
+        self, batch_size: int, shuffle: bool = True, drop_last: bool = True,
+        seed: int = 0,
+    ) -> DataLoader:
+        return DataLoader(
+            self, batch_size, collate_fn=self.collate, shuffle=shuffle,
+            drop_last=drop_last, seed=seed,
+        )

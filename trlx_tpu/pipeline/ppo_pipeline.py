@@ -120,13 +120,17 @@ class PPORolloutStorage(BaseRolloutStore):
         history = jax.tree_util.tree_map(np.asarray, self.history)
 
         def exp_to_dict(i: int):
+            # field set varies by batch type (GRPO rollouts carry no
+            # values/rewards columns): export what the pytree holds
             d = {
                 "query_tensor": history.query_tensors[i].tolist(),
                 "response_tensor": history.response_tensors[i].tolist(),
-                "logprobs": history.logprobs[i].tolist(),
-                "values": history.values[i].tolist(),
-                "rewards": history.rewards[i].tolist(),
             }
+            for fname in ("logprobs", "values", "rewards", "ref_logprobs",
+                          "advantages"):
+                field = getattr(history, fname, None)
+                if field is not None:
+                    d[fname] = field[i].tolist()
             if tokenizer is not None:
                 d["query"] = tokenizer.decode(d["query_tensor"])
                 d["response"] = tokenizer.decode(d["response_tensor"])

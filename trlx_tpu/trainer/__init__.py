@@ -13,10 +13,25 @@ _TRAINERS: Dict[str, type] = {}
 
 
 def register_trainer(name_or_cls):
-    """Register a trainer class under its (lowercased) name (decorator)."""
+    """Register a trainer class under its (lowercased) name (decorator).
+
+    A duplicate name raises: two trainers silently shadowing each other
+    under one key is exactly the bug a registry exists to prevent.
+    Re-registering the same class (module reload) stays a no-op."""
 
     def _register(cls, name: str):
-        _TRAINERS[name.lower()] = cls
+        key = name.lower()
+        existing = _TRAINERS.get(key)
+        if existing is not None and (
+            (existing.__module__, existing.__qualname__)
+            != (cls.__module__, cls.__qualname__)
+        ):
+            raise ValueError(
+                f"trainer {name!r} is already registered to "
+                f"{existing.__module__}.{existing.__qualname__}; refusing "
+                "to overwrite it silently — pick a distinct name"
+            )
+        _TRAINERS[key] = cls
         return cls
 
     if isinstance(name_or_cls, str):

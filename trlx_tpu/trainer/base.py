@@ -36,7 +36,12 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from trlx_tpu.data import PromptBatch
 from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.exp import ExpConfig, ExperienceTransport
+from trlx_tpu.exp import transport as exp_transport
+from trlx_tpu.fleet.config import FleetConfig
+from trlx_tpu.ops.common import running_moments_init, running_moments_update
 from trlx_tpu.models.generation import (
     HF_GEN_KWARGS_UNIMPLEMENTED,
     SamplerSettings,
@@ -48,11 +53,19 @@ from trlx_tpu.parallel import (
     data_sharding,
     init_sharded_opt_state,
     make_mesh,
-    shard_params,
 )
 from trlx_tpu.parallel import multihost as mh
+from trlx_tpu.parallel.mesh import replicated_sharding, vector_sharding
+from trlx_tpu.pipeline import DataLoader
 from trlx_tpu.trainer import BaseRLTrainer
-from trlx_tpu.utils import Clock, build_optimizer, logging, significant, to_scalar
+from trlx_tpu.utils import (
+    Clock,
+    build_optimizer,
+    infinite_loader,
+    logging,
+    significant,
+    to_scalar,
+)
 from trlx_tpu.utils.chaos import build_chaos, poison_batch
 from trlx_tpu.utils.checkpointing import (
     TOPOLOGY_MANIFEST,
@@ -63,7 +76,12 @@ from trlx_tpu.utils.checkpointing import (
     atomic_json_write,
     verify_or_quarantine,
 )
-from trlx_tpu.utils.guardrails import STALL_SIGNAL, build_monitor
+from trlx_tpu.utils.guardrails import (
+    FLEET_SIGNAL,
+    STALENESS_SIGNAL,
+    STALL_SIGNAL,
+    build_monitor,
+)
 from trlx_tpu.utils.resilient import (
     ChaosFault,
     CircuitBreaker,
@@ -536,9 +554,12 @@ class TPUBaseTrainer(BaseRLTrainer):
     def place_batch(self, batch):
         """Host batch -> device arrays sharded batch-dim over (dp, fsdp),
         and — when the mesh has an `sp` axis — seq-dim over sp for every
-        rank>=2 leaf whose dim 1 divides evenly (context parallelism)."""
+        rank>=2 leaf whose dim 1 divides evenly (context parallelism).
+        Rank-1 leaves (per-row scalars, e.g. GRPO's sequence-level
+        advantages) shard their single dim over (dp, fsdp)."""
         sp = self.mesh.shape["sp"]
         base = data_sharding(self.mesh)
+        vec = vector_sharding(self.mesh)
         seq = data_sharding(self.mesh, shard_seq=True) if sp > 1 else base
 
         def put(x):
@@ -546,6 +567,8 @@ class TPUBaseTrainer(BaseRLTrainer):
             # device-to-device; only host leaves pay the upload
             if not isinstance(x, jax.Array):
                 x = np.asarray(x)
+            if x.ndim < 2:
+                return jax.device_put(x, vec)
             s = seq if (sp > 1 and x.ndim >= 2 and x.shape[1] % sp == 0) else base
             return jax.device_put(x, s)
 
@@ -2826,6 +2849,1323 @@ class TPUBaseTrainer(BaseRLTrainer):
             "save_pretrained",
             self.watchdog.cfg.barrier_timeout_s if self.watchdog.enabled else 0,
         )
+
+
+# ---------------------------------------------------------------------------
+# the trainer-agnostic online experience core
+# ---------------------------------------------------------------------------
+
+
+class _GroupChunkLoader(DataLoader):
+    """Per-data-group view of the GLOBAL prompt-chunk order: every
+    process draws the SAME shuffle stream a plain ``DataLoader`` over
+    the full prompt list would (one shuffle of the global index order
+    per epoch, same RNG consumption), chunks it at the global chunk
+    size, then collates ONLY this group's strided rows of each chunk.
+
+    This is what makes the prompt stream topology-invariant: the chunk
+    composition is fixed by (seed, chunk_size) alone, so a checkpoint
+    cursor saved under G data groups replays the exact same prompts
+    under G' groups — while each host still pays only 1/G of the
+    per-pull collation (the index slice happens BEFORE collate).
+    Groups are padded to equal row counts by wrapping within the chunk
+    (SPMD lockstep needs equal-shape pulls; the repeated row is the
+    same compromise `shard_list` made)."""
+
+    def __init__(
+        self, dataset, batch_size, collate_fn, group, group_count,
+        seed, shuffle=True, drop_last=True,
+    ):
+        super().__init__(
+            dataset, batch_size, collate_fn=collate_fn, shuffle=shuffle,
+            drop_last=drop_last, seed=seed,
+        )
+        self.group = group
+        self.group_count = group_count
+
+    def _select_rows(self, idxs) -> List[int]:
+        # DataLoader.__iter__ hook: shuffle/chunking stay the base
+        # class's (the parity-critical RNG stream is written ONCE);
+        # only the row selection differs
+        local = [int(i) for i in idxs[self.group :: self.group_count]]
+        want = (len(idxs) + self.group_count - 1) // self.group_count
+        i = 0
+        while len(local) < want:
+            local.append(int(idxs[(self.group + i * self.group_count) % len(idxs)]))
+            i += 1
+        return local
+
+
+class TPUOnlineTrainer(TPUBaseTrainer):
+    """The trainer-agnostic online experience core.
+
+    Everything an on-policy RLHF trainer needs to COLLECT experience
+    lives here, independent of the algorithm that scores it: the
+    topology-invariant prompt stream + cursors, the chunked
+    ``generate()`` rollout loop with one-chunk lookahead, the
+    cross-cycle prefetch (``method.overlap_rollouts``), the decode
+    engine seam (``method.gen_engine.*``, inherited from the base
+    generate()), the resilient experience transport
+    (``method.exp.*``, trlx_tpu/exp/) and the rollout fleet
+    (``method.fleet.*``, trlx_tpu/fleet/), plus the reward
+    running-moment machinery and the honest rollout accounting.
+
+    Subclasses provide exactly one method-specific seam:
+    ``_score_and_assemble`` — decode + reward + the algorithm's
+    experience assembly for one generated chunk — and the usual
+    ``loss``/``setup_model``. PPO and GRPO are both this class plus a
+    seam; neither copies a line of the transport/fleet/prefetch
+    machinery.
+    """
+
+    def __init__(self, config, **kwargs):
+        super().__init__(config, **kwargs)
+
+        data_ways = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        if config.method.chunk_size % data_ways:
+            raise ValueError(
+                f"method.chunk_size {config.method.chunk_size} must be divisible "
+                f"by dp*fsdp={data_ways}"
+            )
+        self.store = self._make_store()
+        self.running_moments = running_moments_init()
+        self.ref_mean = getattr(config.method, "ref_mean", None)
+        self.ref_std = getattr(config.method, "ref_std", None)
+
+        self._deferred_rollout = DeferredStats()
+        # rollout-data cursor: how many prompt chunks this run has pulled
+        # off the (deterministically shuffled) prompt stream. Saved in
+        # state.json so a resumed run fast-forwards to the exact position
+        # instead of replaying the stream from its start.
+        self._prompt_batches_consumed = 0
+        self._resume_prompt_cursor = 0
+        # cross-cycle rollout prefetch (method.overlap_rollouts): the
+        # next cycle's first chunk, generated ahead of the current fused
+        # optimization block, plus the prompt cursor it must rewind to
+        # if it never trains (preemption / run end)
+        self._prefetched_gen: Optional[Tuple] = None
+        self._prefetch_cursor_start: Optional[int] = None
+        self.log_rollouts = config.train.rollout_logging_dir is not None
+        if self.log_rollouts:
+            self.setup_rollout_logging(config)
+        # resilient experience transport (method.exp.*, trlx_tpu/exp/):
+        # rollout chunks travel through a leased, deduplicating queue
+        # with a staleness admission gate; default off = the direct
+        # rollout loop, and fault-free the transport path is golden-
+        # checked bit-equal to it (tests/test_exp_queue.py)
+        self._exp_cfg = ExpConfig.from_dict(getattr(config.method, "exp", None))
+        self._exp: Optional[ExperienceTransport] = None
+        if self._exp_cfg.enabled:
+            self._exp = ExperienceTransport(
+                self._exp_cfg, owner=f"proc{mh.process_index()}"
+            )
+        # policy version the in-flight overlap_rollouts prefetch was
+        # generated at (the chunk is consumed one optimizer cycle later,
+        # so its recorded version must be the generation-time one)
+        self._prefetch_policy_version = 0
+        # fault-tolerant rollout fleet (method.fleet.*, trlx_tpu/fleet/):
+        # chunk production routed to cross-process workers behind the
+        # transport seam — membership heartbeats, versioned weight
+        # broadcast, degraded-mode fallback to the in-process path
+        self._fleet_cfg = FleetConfig.from_dict(
+            getattr(config.method, "fleet", None)
+        )
+        self._fleet = None
+        if self._fleet_cfg.enabled:
+            if self._exp is None:
+                raise ValueError(
+                    "method.fleet.enabled requires method.exp.enabled: the "
+                    "fleet produces chunks BEHIND the experience "
+                    "transport (delivery/dedup/staleness stay its job)"
+                )
+            if mh.process_count() > 1:
+                raise NotImplementedError(
+                    "method.fleet with a multi-process learner mesh is not "
+                    "supported yet (run one learner process; workers "
+                    "scale horizontally instead)"
+                )
+            from trlx_tpu.fleet.coordinator import FleetCoordinator
+
+            self._fleet = FleetCoordinator(
+                self._fleet_cfg,
+                self._fleet_cfg.resolved_dir(config.train.checkpoint_dir),
+                owner=f"learner-{mh.process_index()}",
+            )
+
+    # -- method-specific seams -------------------------------------------
+
+    def _make_store(self):
+        """The rollout store. Default: the rectangular device-resident
+        pytree store (works for any flax.struct batch with a
+        ``query_tensors`` leading field)."""
+        from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+
+        return PPORolloutStorage(
+            pad_token_id=self.generate_settings.pad_token_id
+        )
+
+    def _inner_epochs(self) -> int:
+        """Optimization epochs per collected rollout batch (PPO:
+        ``method.ppo_epochs``)."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def _score_and_assemble(
+        self, batch: PromptBatch, gen_out, stats: Dict[str, Any],
+        iter_count: int, clock: Clock,
+    ):
+        """The method-specific half of one rollout chunk: decode +
+        reward_fn, the algorithm's experience assembly (teacher-forced
+        forwards, advantages, ...), running-moment update and the
+        chunk's stats (mutated into ``stats``). Shared verbatim by the
+        direct rollout loop, the experience-transport producer AND the
+        fleet worker, so the paths cannot numerically diverge. Returns
+        ``(rollout_batch, rows_local)``."""
+
+    def _apply_staleness_clip(self, rollout_batch):
+        """IMPACT-style admission correction for an over-stale chunk
+        (``exp.staleness.mode: clip``): recompute behavior terms with
+        the CURRENT policy and thread the mismatch into the surrogate
+        as a clipped per-token importance weight. Method-specific."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement "
+            "exp.staleness.mode='clip'; use mode='reject'"
+        )
+
+    def _rollout_stage_meta(self):
+        """Metadata staged with each cycle's deferred rollout stats
+        (PPO: the adaptive KL controller value at collection time)."""
+        return None
+
+    # -- rollout engine --------------------------------------------------
+
+    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0) -> None:
+        """Collect `num_rollouts` rollouts into the store (parity:
+        reference make_experience :251-525; §3.2 call stack)."""
+        # hang doctor: the rollout phase heartbeats per chunk inside the
+        # loop, so a many-chunk collection stays healthy while a single
+        # wedged generate/score goes silent past the rollout deadline
+        with self.watchdog.phase("rollout", step=iter_count):
+            self._make_experience(num_rollouts, iter_count)
+
+    def _make_experience(self, num_rollouts: int, iter_count: int) -> None:
+        from time import time
+
+        if self._exp is not None:
+            return self._make_experience_exp(num_rollouts, iter_count)
+        logger.info("Collecting rollouts")
+        self._rollout_abandoned = False
+        # snapshot the prompt cursor: an abandoned (preempted) rollout
+        # discards its partial store, so the cursor must rewind to here
+        # or the resumed run would skip prompts that never trained. When
+        # the cycle starts from a prefetched chunk (overlap_rollouts),
+        # the rewind target is the cursor BEFORE that chunk's prompts
+        # were pulled — the prefetch pull already advanced it.
+        prompt_cursor_start = (
+            self._prefetch_cursor_start
+            if self._prefetched_gen is not None
+            else self._prompt_batches_consumed
+        )
+        # guardrail `requeue` rewinds to here: the whole cycle's prompts
+        # replay when its rollout batch turns out poisoned
+        self._cycle_cursor_start = prompt_cursor_start
+        self._finish_rollout_stats()  # flush any deferred previous-cycle stats
+        clock = Clock()
+        n_collected = 0
+        accumulated_stats: List[Dict[str, float]] = []
+
+        pbar = logging.progress(total=num_rollouts, desc="rollouts")
+        # one-chunk lookahead: generation for chunk i+1 is DISPATCHED
+        # before chunk i's host work (decode + reward_fn), so the device
+        # samples while the host scores — the reference's rollout loop is
+        # fully serial here (SURVEY §7 "host-device choreography")
+        if self._prefetched_gen is not None:
+            # cycle-level overlap: chunk 0 was dispatched ahead of the
+            # previous cycle's fused optimization block and sampled
+            # under it on-device (pre_optimization_hook)
+            next_batch, next_gen, next_gen_time = self._prefetched_gen
+            self._prefetched_gen = None
+            self._prefetch_cursor_start = None
+        else:
+            next_batch = self._next_prompt_batch()
+            rollout_generate_time = time()
+            next_gen = self.generate(
+                next_batch.input_ids, next_batch.attention_mask
+            )
+            next_gen_time = time() - rollout_generate_time
+        chunk_rows = len(next_batch.input_ids) * mh.data_group_count(self.mesh)
+        while n_collected < num_rollouts:
+            self.watchdog.beat("rollout", step=iter_count)
+            if self.chaos is not None:
+                # chaos: the sampler wedges at the top of this chunk —
+                # the rollout phase goes silent and the watchdog's
+                # deadline (not the scheduler) must end the run
+                self.chaos.stall("stall_rollout")
+            # rollout collection dominates on-policy wall-clock: a
+            # preemption landing here must not wait out the remaining
+            # chunks (the grace period would expire before the final
+            # save). Abandon the rollout — learn()'s epoch-top check
+            # saves and exits. Forced sync: every host runs this loop in
+            # lockstep.
+            if self._should_stop(force=True):
+                logger.warning(
+                    "preemption during rollout collection: abandoning "
+                    "after %d/%d rollouts", n_collected, num_rollouts,
+                )
+                # flags the store as truncated: the total_steps that
+                # prepare_learning derives from it must not be persisted
+                # as this run's real budget. The cursor rewinds to the
+                # cycle start — this cycle's chunks never train, so the
+                # resumed run must replay them.
+                self._rollout_abandoned = True
+                self._prompt_batches_consumed = prompt_cursor_start
+                break
+            stats: Dict[str, float] = {}
+            batch, gen_out = next_batch, next_gen
+            stats["time/rollout_generate"] = next_gen_time
+            if n_collected + chunk_rows < num_rollouts:
+                next_batch = self._next_prompt_batch()
+                rollout_generate_time = time()
+                next_gen = self.generate(
+                    next_batch.input_ids, next_batch.attention_mask
+                )
+                next_gen_time = time() - rollout_generate_time
+            else:
+                next_batch, next_gen = None, None
+
+            rollout_batch, rows_local = self._score_and_assemble(
+                batch, gen_out, stats, iter_count, clock
+            )
+            accumulated_stats.append(stats)
+
+            self.push_to_store(rollout_batch)
+            n_collected += rows_local * mh.data_group_count(self.mesh)
+            if hasattr(pbar, "update"):
+                pbar.update(rows_local * mh.data_group_count(self.mesh))
+            logger.info("[rollout %d / %d]", n_collected, num_rollouts)
+
+        if not accumulated_stats:
+            # rollout abandoned before the first chunk completed
+            # (preemption): nothing to log, nothing pending
+            if hasattr(pbar, "close"):
+                pbar.close()
+            return
+        agg = {
+            k: sum(xs[k] for xs in accumulated_stats) / len(accumulated_stats)
+            for k in accumulated_stats[-1]
+        }
+        # ONE packed async device->host copy for every accumulated device
+        # scalar, materialized lazily (post_backward / next
+        # make_experience): on a remote-tunneled chip the blocking read
+        # costs a full ~100ms round trip, which this way overlaps the
+        # train step instead of extending the rollout phase
+        if hasattr(pbar, "close"):
+            pbar.close()
+        self._deferred_rollout.stage(
+            agg, step=iter_count, meta=self._rollout_stage_meta()
+        )
+
+    # -- shared score/assemble helpers -----------------------------------
+
+    def _update_reward_moments(self, scores, scores_mask, stats):
+        """Fold one chunk's host-computed scores into the running reward
+        moments and pick the reward-scaling divisor (``method.
+        scale_reward``). Local per-row sums -> one GLOBAL vector; the
+        running-moment update then reduces over every host's rows
+        in-graph (the reference all-gathers scores to rank 0 instead).
+        A short final chunk (prompt dataset smaller than chunk_size)
+        may not divide dp*fsdp — keep the tiny vector replicated then
+        (padding would bias the running reward moments). Multi-host
+        replication of per-group-DIFFERENT rows needs a host-side
+        allgather first, so every process places the same full vector
+        (parity: the reference pads across processes,
+        accelerate_ppo_trainer.py:292-300). Returns ``scale_div`` (a
+        device scalar)."""
+        method = self.config.method
+        local_sums = (scores * scores_mask).sum(axis=1)
+        rows = len(local_sums) * mh.data_group_count(self.mesh)
+        if rows % self.data_ways() == 0:
+            score_sums = mh.global_from_local(
+                local_sums, vector_sharding(self.mesh)
+            )
+        elif mh.is_multihost():
+            score_sums = jax.device_put(
+                np.asarray(
+                    mh.allgather_group_rows(
+                        local_sums.astype(np.float32), self.mesh
+                    ),
+                    np.float32,
+                ),
+                replicated_sharding(self.mesh),
+            )
+        else:
+            score_sums = mh.global_from_local(
+                local_sums, replicated_sharding(self.mesh)
+            )
+        if self.ref_mean is None:
+            self.ref_mean = float(score_sums.mean())
+            self.ref_std = float(score_sums.std())
+        new_moments, scores_mean, scores_std = running_moments_update(
+            self.running_moments, score_sums
+        )
+        # a NaN-poisoned chunk must not permanently poison the
+        # running reward moments (they scale every later reward and
+        # persist across checkpoints): keep the pre-chunk moments
+        # when the chunk's sums are non-finite. The chunk's OWN
+        # stats still report the poison, so the guardrails see it.
+        keep = jnp.all(jnp.isfinite(score_sums))
+        self.running_moments = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(keep, n, o),
+            new_moments, self.running_moments,
+        )
+        # stats stay DEVICE scalars until the single packed fetch at
+        # the end of make_experience (each host read costs a full
+        # round-trip on a remote-tunneled chip)
+        stats["rollout_scores/mean"] = scores_mean
+        stats["rollout_scores/std"] = scores_std
+        stats["rollout_scores/running_mean"] = self.running_moments.mean
+        stats["rollout_scores/running_std"] = self.running_moments.std
+
+        # reward scaling happens inside the experience fn: pass the
+        # divisor as a device scalar instead of fetching the running
+        # std to the host
+        scale_reward = getattr(method, "scale_reward", None)
+        if scale_reward == "running":
+            return self.running_moments.std
+        if scale_reward == "ref":
+            return jnp.float32(max(self.ref_std, 1e-8))
+        return jnp.float32(1.0)
+
+    def _rollout_accounting_stats(
+        self, response_ids, response_mask, gen_out, stats, iter_count,
+    ) -> None:
+        """Honest rollout accounting: pad emissions from finished rows
+        are NOT generated tokens — report mask-weighted real tokens
+        plus batch occupancy, and a truncation rate (rows that ran to
+        max_new_tokens without an EOS: a degenerate policy that stops
+        emitting EOS shows up here, and the guardrails can trip on it
+        via truncation_max). Plus the decode-engine per-chunk ledger
+        when ``gen_stats`` rode along."""
+        rm_np = np.asarray(response_mask)
+        ri_np = np.asarray(response_ids)
+        N_resp = rm_np.shape[1]
+        real_toks = float(rm_np.sum())
+        stats["rollout/real_tokens"] = real_toks
+        stats["rollout/token_occupancy"] = real_toks / max(
+            rm_np.shape[0] * N_resp, 1
+        )
+        eos_id = self.generate_settings.eos_token_id
+        full_rows = rm_np.sum(axis=1) >= N_resp
+        hit_eos = (
+            ((ri_np == eos_id) & (rm_np > 0)).any(axis=1)
+            if eos_id >= 0
+            else np.zeros(len(full_rows), bool)
+        )
+        stats["rollout/truncation_rate"] = (
+            float((full_rows & ~hit_eos).mean()) if len(full_rows) else 0.0
+        )
+        gstats = gen_out.get("gen_stats")
+        if gstats is not None:
+            g = {k: float(np.asarray(v)) for k, v in gstats.items()}
+            # per-refill heartbeat accounting (host-side,
+            # post-dispatch): with the decode engine a chunk is ONE
+            # device dispatch, so the refills all land at once —
+            # batch them into a single annotated beat (count=N)
+            # instead of N same-instant beats that would evict the
+            # other phases from the watchdog's bounded timeline
+            refills = int(g.get("refills", 0))
+            if refills:
+                self.watchdog.beat(
+                    "rollout", step=iter_count, count=refills
+                )
+            stats["rollout/engine_occupancy"] = g.get("occupancy", 0.0)
+            stats["rollout/engine_refills"] = g.get("refills", 0.0)
+            stats["rollout/engine_decode_steps"] = g.get("decode_steps", 0.0)
+            if "drafted" in g:
+                stats["rollout/spec_accept_rate"] = g["accepted"] / max(
+                    g["drafted"], 1.0
+                )
+            if g.get("oom_truncated") or g.get("unserved"):
+                logger.warning(
+                    "gen_engine: page pool exhausted (%d lanes "
+                    "truncated, %d prompts unserved) — raise "
+                    "method.gen_engine.pool_pages",
+                    int(g.get("oom_truncated", 0)),
+                    int(g.get("unserved", 0)),
+                )
+
+    # -- experience transport (method.exp.*) -----------------------------
+
+    def _exp_snapshot(self) -> Dict[str, Any]:
+        """Replay state for a production lease, taken BEFORE the chunk
+        touches anything: the trainer RNG key and the host-side reward
+        accounting (running moments, ref stats). jax arrays are
+        immutable, so holding references is free; restoring them makes
+        a re-dispatched production bit-identical to the original
+        attempt (same key -> same samples, same moments -> same reward
+        scaling), which is what lets a producer death leave the
+        consumed stream untouched. (The prompt batch itself is stashed
+        on the lease at pull time — ``snap["batch"]`` — so a replay
+        never re-pulls the stream.)"""
+        return {
+            "rng": self.rng,
+            "running_moments": self.running_moments,
+            "ref_mean": self.ref_mean,
+            "ref_std": self.ref_std,
+        }
+
+    def _exp_restore_snapshot(self, snap: Dict[str, Any]) -> None:
+        self.rng = snap["rng"]
+        self.running_moments = snap["running_moments"]
+        self.ref_mean = snap["ref_mean"]
+        self.ref_std = snap["ref_std"]
+
+    def _exp_wait(self, iter_count: int):
+        """Bounded-wait callback for transport waits (back-pressure,
+        lease expiry): beat the ``exp_wait`` watchdog phase and sleep
+        one poll — a genuinely wedged queue then trips the watchdog
+        deadline instead of hanging undiagnosed."""
+        import time as _time
+
+        def wait(poll_s: float) -> None:
+            self.watchdog.beat("exp_wait", step=iter_count)
+            _time.sleep(poll_s)
+
+        return wait
+
+    def _exp_produce(self, lease, iter_count: int, clock: Clock) -> None:
+        """Produce one chunk under ``lease`` and deliver it: pull the
+        prompt chunk (or consume the cycle's overlap prefetch), sample,
+        score+assemble, then offer to the queue with the lease's
+        heartbeats at each milestone. Re-dispatched leases (attempt > 1
+        or a staleness re-dispatch) restore the replay snapshot first,
+        so the regenerated chunk is bit-identical to the lost one."""
+        from time import time
+
+        exp = self._exp
+        snap = lease.meta if lease.meta is not None else {}
+        lease.meta = snap
+        if snap.get("rng") is not None:
+            # no-op on a fresh attempt (the snapshot IS the live state);
+            # on a re-dispatch it rewinds the producer-side effects so
+            # the replay is bit-identical
+            self._exp_restore_snapshot(snap)
+        stats: Dict[str, float] = {}
+        if snap.get("gen") is not None:
+            # replaying a chunk originally produced from the cycle
+            # prefetch: the generation (old params, old key) cannot be
+            # re-run — redeliver the retained samples wholesale
+            batch, gen_out, gen_time, version = snap["gen"]
+        elif self._prefetched_gen is not None:
+            batch, gen_out, gen_time = self._prefetched_gen
+            self._prefetched_gen = None
+            self._prefetch_cursor_start = None
+            version = self._prefetch_policy_version
+            snap["gen"] = (batch, gen_out, gen_time, version)
+        else:
+            batch = snap.get("batch")
+            if batch is None:
+                batch = self._next_prompt_batch()
+                snap["batch"] = batch
+            if self._fleet is not None and self._fleet_produce(
+                lease, snap, batch, iter_count
+            ):
+                # produced + delivered by a fleet worker (the learner
+                # adopted its post-production snapshot); the transport
+                # consumer loop takes it from here
+                return
+            exp.heartbeat(lease)
+            t0 = time()
+            gen_out = self.generate(batch.input_ids, batch.attention_mask)
+            gen_time = time() - t0
+            version = self._policy_version
+        stats["time/rollout_generate"] = gen_time
+        exp.heartbeat(lease)
+        rollout_batch, rows_local = self._score_and_assemble(
+            batch, gen_out, stats, iter_count, clock
+        )
+        exp.heartbeat(lease)
+        if self.chaos is not None and self.chaos.consult("stale_flood"):
+            # chaos: the chunk's staleness metadata is corrupted — its
+            # recorded generation version lands far behind the live
+            # policy, so the admission gate must reject (or clip) it
+            version = version - (self._exp_cfg.staleness.max_staleness + 10)
+        if self.chaos is not None and self.chaos.consult("queue_wedge"):
+            # chaos: the learner stops draining — the next offers see a
+            # full queue and the bounded back-pressure wait must ride
+            # it out under exp_wait heartbeats
+            exp.wedge()
+        payload = (rollout_batch, stats, rows_local)
+        with self.watchdog.phase("exp_wait", step=iter_count):
+            exp.deliver(
+                lease, version, payload, meta={"snapshot": snap},
+                wait=self._exp_wait(iter_count),
+            )
+            if self.chaos is not None and self.chaos.consult(
+                "duplicate_delivery"
+            ):
+                # chaos: the producer's retry races its own success —
+                # the same finished chunk is delivered twice; consumer
+                # dedup must drop the redelivery
+                exp.deliver(
+                    lease, version, payload, meta={"snapshot": snap},
+                    wait=self._exp_wait(iter_count),
+                )
+
+    # -- rollout fleet (method.fleet.*) ----------------------------------
+
+    def _fleet_post_publish(self, path: str) -> None:
+        """Chaos seam for ``broadcast_corrupt``: fired once per landed
+        weight-snapshot publish, AFTER the atomic rename — only the
+        workers' manifest verification can catch the flipped bit."""
+        if self.chaos is not None and self.chaos.consult("broadcast_corrupt"):
+            self.chaos.corrupt_broadcast(path)
+
+    def _fleet_degrade(self, why: str) -> bool:
+        """Record a healthy->degraded transition and trip the ``fleet``
+        guardrail signal (once per transition — a long outage must not
+        spam the escalation ladder). Always returns False so callers
+        can ``return self._fleet_degrade(...)`` out of the fleet path."""
+        if self._fleet.note_degraded(why):
+            self.guardrails.trip(
+                FLEET_SIGNAL,
+                f"rollout fleet degraded: {why} — falling back to "
+                "in-process production (bit-equal to the fleet-less run)",
+            )
+        return False
+
+    def _fleet_ready(self, iter_count: int) -> bool:
+        """Evict silent workers, then gate on ``fleet.min_workers``.
+        The FIRST production waits out ``fleet.startup_timeout_s`` for
+        the fleet to register (workers launch in parallel with the
+        learner's compile, so "not there yet" is the common case) — a
+        fleet that never comes up degrades instead of wedging the run."""
+        import time as _time
+
+        fleet, cfg = self._fleet, self._fleet_cfg
+        deadline = (
+            None if fleet._waited_startup
+            else _time.time() + cfg.startup_timeout_s
+        )
+        fleet._waited_startup = True
+        while True:
+            fleet.registry.evict_silent()
+            if len(fleet.live_workers()) >= cfg.min_workers:
+                return True
+            if deadline is None or _time.time() >= deadline:
+                return False
+            self.watchdog.beat("rollout", step=iter_count)
+            _time.sleep(cfg.poll_s)
+
+    def _fleet_produce(
+        self, lease, snap: Dict[str, Any], batch, iter_count: int
+    ) -> bool:
+        """Produce the leased chunk on the worker fleet: publish the
+        policy snapshot if due, dispatch the prompt batch + replay
+        snapshot to a live worker, watch its membership heartbeats
+        while it generates, and hand the delivered payload to the
+        transport under the learner's own lease. A worker that goes
+        silent mid-chunk is evicted and the chunk re-dispatched with
+        the SAME snapshot (bit-identical regeneration). Returns False
+        — after tripping the ``fleet`` signal once per transition —
+        when the fleet is below ``min_workers`` (or a dispatch timed
+        out); the caller then produces the chunk in-process from the
+        same snapshot, so degradation is invisible in the loss stream."""
+        import time as _time
+
+        from trlx_tpu.fleet import serde as fleet_serde
+
+        fleet, cfg, exp = self._fleet, self._fleet_cfg, self._exp
+        # publish before the readiness gate: workers that are still
+        # attaching need the snapshot to produce anything at all. But a
+        # DEGRADED fleet with no registered workers at all has no
+        # consumers — skip the full-model snapshot (host copy + npz +
+        # sha256 + fsync per policy version) until a registration
+        # reappears, or a dead fleet taxes every remaining cycle
+        if not fleet.degraded or fleet.registry.worker_records():
+            fleet.ensure_published(
+                self._policy_version,
+                lambda: fleet_serde.params_to_arrays(self.params),
+                post_publish=self._fleet_post_publish,
+            )
+        if not self._fleet_ready(iter_count):
+            return self._fleet_degrade(
+                f"{len(fleet.live_workers())} live workers < "
+                f"fleet.min_workers={cfg.min_workers}"
+            )
+        fleet.note_recovered()
+        chunk_id = lease.chunk_id
+
+        def degrade_dispatched(why: str) -> bool:
+            # abandon the outstanding dispatch: a later-rejoining
+            # evicted worker must not burn a generation on a chunk the
+            # learner is about to produce in-process, and its late
+            # delivery must not linger to collide with a future
+            # regeneration of the same id. The lease goes back to the
+            # learner — IT is the producer from here on, and expiry
+            # logs should say so
+            fleet.clear_chunk(chunk_id)
+            exp.reassign(lease, exp.owner)
+            return self._fleet_degrade(why)
+        # a previous incarnation/attempt may have left a delivery for
+        # this seq (learner restart, staleness re-dispatch): the replay
+        # contract makes a same-snapshot leftover bit-identical, but a
+        # staleness regeneration must NOT consume the old samples —
+        # clear and regenerate, which is correct for both
+        fleet.clear_chunk(chunk_id)
+        arrays, prompt_meta = fleet_serde.prompt_batch_to_arrays(batch)
+        # self state == the replay snapshot at this point (a re-dispatch
+        # restored it at the top of _exp_produce), so the wire snapshot
+        # is exactly what an in-process production would consume
+        wire_meta = {
+            "iter_count": int(iter_count),
+            "snapshot": fleet_serde.snapshot_to_wire(self._exp_snapshot()),
+            "prompt_metadata": prompt_meta,
+        }
+        tried: Tuple[str, ...] = ()
+        worker = fleet.select_worker()
+        if worker is None:
+            return self._fleet_degrade("no dispatchable worker")
+        attempt = fleet.next_attempt(chunk_id)
+        valid_attempts = {attempt}
+        exp.reassign(lease, worker)
+        fleet.dispatch(chunk_id, attempt, worker, wire_meta, arrays)
+        deadline = _time.time() + cfg.dispatch_timeout_s
+        # delivery is polled every tick, but the membership scan
+        # (dir listing + one JSON parse per worker record) only needs
+        # the TTL's resolution — on a shared/remote filesystem the
+        # difference is thousands of metadata reads per chunk
+        scan_every = max(cfg.worker_ttl_s / 4.0, cfg.poll_s)
+        next_scan = 0.0
+        while True:
+            self.watchdog.beat("rollout", step=iter_count)
+            exp.heartbeat(lease)
+            msg = fleet.poll_delivery(chunk_id)
+            if msg is not None:
+                if int(msg[0].get("attempt", -1)) in valid_attempts:
+                    break
+                # a lingering worker's late delivery from an attempt
+                # ABANDONED before this production (a staleness
+                # regeneration reuses the chunk id with a NEW snapshot):
+                # consuming it would replay the exact payload the gate
+                # refused. Drop the payload only — the outstanding
+                # assignment stays so the current worker isn't stranded
+                fleet.clear_delivery(chunk_id)
+                msg = None
+            if _time.time() >= next_scan:
+                next_scan = _time.time() + scan_every
+                fleet.registry.evict_silent()
+                lost = worker not in fleet.live_workers()
+            else:
+                lost = False
+            if lost:
+                # the producing worker died / partitioned / got
+                # quarantined mid-chunk: re-dispatch elsewhere with the
+                # same snapshot (regeneration is bit-identical, so the
+                # consumed stream never sees the loss)
+                tried = tried + (worker,)
+                if len(fleet.live_workers()) < cfg.min_workers:
+                    return degrade_dispatched(
+                        f"worker {worker!r} lost mid-chunk {chunk_id} "
+                        "and the live fleet fell below min_workers"
+                    )
+                worker = (
+                    fleet.select_worker(exclude=tried)
+                    or fleet.select_worker()  # all live ones tried: retry the set
+                )
+                if worker is None:
+                    return degrade_dispatched(
+                        f"no dispatchable worker for chunk {chunk_id}"
+                    )
+                attempt = fleet.next_attempt(chunk_id)
+                valid_attempts.add(attempt)
+                exp.reassign(lease, worker)
+                fleet.dispatch(chunk_id, attempt, worker, wire_meta, arrays)
+                deadline = _time.time() + cfg.dispatch_timeout_s
+                continue
+            if _time.time() >= deadline:
+                # alive-but-wedged worker: the membership TTL never
+                # fires, so this bound is the backstop. Evict (flap-
+                # tracked) and degrade; the in-process regeneration is
+                # bit-identical via the replay snapshot.
+                fleet.registry.evict(
+                    worker,
+                    f"dispatch timeout: chunk {chunk_id} undelivered "
+                    f"after {cfg.dispatch_timeout_s:g}s",
+                )
+                return degrade_dispatched(
+                    f"chunk {chunk_id} timed out on worker {worker!r}"
+                )
+            _time.sleep(cfg.poll_s)
+        meta_d, arrays_d = msg
+        # a consumed delivery breaks the producing worker's eviction
+        # streak — flap quarantine means consecutive evictions, not
+        # cumulative-forever
+        fleet.registry.note_healthy(str(meta_d.get("worker", "")))
+        rollout_batch = fleet_serde.rollout_from_arrays(arrays_d)
+        stats: Dict[str, Any] = dict(meta_d.get("stats") or {})
+        rows_local = int(meta_d["rows_local"])
+        version = int(meta_d["policy_version"])
+        # adopt the worker's post-production snapshot: the learner's
+        # RNG/moments chain continues exactly as if it had produced the
+        # chunk in-process — that adoption is what keeps the fleet path
+        # bit-equal to method.exp.enabled
+        self._exp_restore_snapshot(
+            fleet_serde.snapshot_from_wire(meta_d["post_snapshot"], self.rng)
+        )
+        exp.heartbeat(lease)
+        with self.watchdog.phase("exp_wait", step=iter_count):
+            exp.deliver(
+                lease, version, (rollout_batch, stats, rows_local),
+                meta={"snapshot": snap}, wait=self._exp_wait(iter_count),
+            )
+        fleet.clear_chunk(chunk_id)
+        return True
+
+    def _shutdown_producers(self) -> None:
+        """learn()-exit hook: write the fleet's clean-finish flag ONLY
+        when the step budget is actually done — a preemption / stall /
+        crash exit leaves the workers alive for the relaunched
+        learner's membership-epoch re-attach handshake."""
+        if self._fleet is None:
+            return
+        total = getattr(self, "total_steps", None)
+        budget = self.config.train.total_steps if total is None else total
+        if self.iter_count >= budget:
+            self._fleet.shutdown("train budget reached")
+            logger.info(
+                "fleet: clean finish — %s", self._fleet.stats_summary()
+            )
+        else:
+            logger.info(
+                "fleet: learner exiting at step %d < %d with the fleet "
+                "left ATTACHED (workers re-register on the relaunch's "
+                "membership epoch)", self.iter_count, budget,
+            )
+
+    def _make_experience_exp(self, num_rollouts: int, iter_count: int) -> None:
+        """The experience-transport rollout loop: the in-process trainer
+        acting as the first producer/consumer pair behind the leased
+        queue (ROADMAP item 1's remote rollout fleet plugs in behind
+        the same seam). Fault-free it is bit-equal to the direct loop:
+        the same prompt pulls, the same RNG splits per generate, the
+        same score math (shared ``_score_and_assemble``), consumed in
+        the same order (the queue is in-order by construction)."""
+        import time as _time
+
+        logger.info("Collecting rollouts (experience transport)")
+        self._rollout_abandoned = False
+        exp = self._exp
+        prompt_cursor_start = (
+            self._prefetch_cursor_start
+            if self._prefetched_gen is not None
+            else self._prompt_batches_consumed
+        )
+        self._cycle_cursor_start = prompt_cursor_start
+        self._finish_rollout_stats()
+        clock = Clock()
+        n_collected = 0
+        accumulated_stats: List[Dict[str, float]] = []
+        pbar = logging.progress(total=num_rollouts, desc="rollouts")
+        scfg = self._exp_cfg.staleness
+        pending_redispatch = None  # a reclaimed/re-leased chunk to produce
+        while n_collected < num_rollouts:
+            self.watchdog.beat("rollout", step=iter_count)
+            if self.chaos is not None:
+                # chaos: same wedge site as the direct loop — the
+                # producer stalls at the top of a chunk and the
+                # watchdog deadline must end the run
+                self.chaos.stall("stall_rollout")
+            if self._should_stop(force=True):
+                logger.warning(
+                    "preemption during rollout collection: abandoning "
+                    "after %d/%d rollouts", n_collected, num_rollouts,
+                )
+                self._rollout_abandoned = True
+                self._prompt_batches_consumed = prompt_cursor_start
+                # in-flight chunks and leases never train: void them so
+                # the resumed run's replayed prompts produce fresh
+                # chunks under a new epoch
+                exp.abort_epoch()
+                break
+            chunk = exp.poll()
+            if chunk is None:
+                lease = pending_redispatch
+                pending_redispatch = None
+                if lease is None:
+                    gap = exp.queue.next_undelivered()
+                    gap_lease = exp.leases.get((exp.queue.epoch, gap))
+                    if gap_lease is not None:
+                        # the next in-order chunk is leased but not
+                        # delivered: its producer died (or is slow).
+                        # Wait out the lease TTL under the exp_wait
+                        # phase, then reclaim + re-dispatch.
+                        with self.watchdog.phase("exp_wait", step=iter_count):
+                            while True:
+                                reclaimed = exp.reclaim_expired()
+                                if reclaimed:
+                                    lease = reclaimed[0]
+                                    break
+                                self.watchdog.beat(
+                                    "exp_wait", step=iter_count
+                                )
+                                _time.sleep(self._exp_cfg.wait_poll_s)
+                    else:
+                        lease = exp.begin_chunk(snapshot=self._exp_snapshot())
+                        if self.chaos is not None and self.chaos.consult(
+                            "worker_death_mid_lease"
+                        ):
+                            # chaos: the producer dies right after
+                            # taking the lease — before any side
+                            # effect. Heartbeats stop; the consumer
+                            # loop above waits out the TTL and
+                            # re-dispatches the chunk.
+                            exp.producer_died(lease)
+                            continue
+                self._exp_produce(lease, iter_count, clock)
+                continue
+            verdict, staleness = exp.admit(chunk, self._policy_version)
+            if staleness > scfg.max_staleness and self.guardrails.enabled:
+                self.guardrails.trip(
+                    STALENESS_SIGNAL,
+                    f"chunk {chunk.chunk_id} is {staleness} policy "
+                    f"versions stale (> max {scfg.max_staleness}; "
+                    f"verdict: {verdict}) — the rollout producers are "
+                    "falling behind the learner",
+                )
+            if verdict == exp_transport.REJECT:
+                # over-stale: drop the delivery and regenerate the
+                # chunk's prompts with the current policy (the replay
+                # snapshot keeps the regeneration deterministic). A
+                # chunk born from the cycle prefetch retains its old
+                # samples in snap["gen"] for lost-delivery replay —
+                # but a staleness reject must NOT redeliver those
+                # verbatim (same samples, same version -> an infinite
+                # reject/redeliver loop): strip the retained
+                # generation, keep its prompt batch, so the produce
+                # path re-samples with the live policy and stamps the
+                # live version
+                snap = chunk.meta.get("snapshot")
+                if snap is not None and snap.get("gen") is not None:
+                    snap["batch"] = snap["gen"][0]
+                    snap["gen"] = None
+                pending_redispatch = exp.redispatch_rejected(chunk)
+                continue
+            rollout_batch, stats, rows_local = chunk.payload
+            if verdict == exp_transport.ADMIT_CLIP:
+                rollout_batch = self._apply_staleness_clip(rollout_batch)
+                stats["exp/staleness_clipped"] = 1.0
+            elif scfg.mode == "clip":
+                # uniform store pytree structure: every batch of a
+                # clip-mode run carries weights (fresh chunks at 1)
+                rollout_batch = rollout_batch.replace(
+                    is_weight=jnp.ones_like(rollout_batch.response_mask)
+                )
+            stats["exp/staleness"] = float(staleness)
+            self.push_to_store(rollout_batch)
+            exp.committed(chunk)
+            accumulated_stats.append(stats)
+            n_collected += rows_local * mh.data_group_count(self.mesh)
+            if hasattr(pbar, "update"):
+                pbar.update(rows_local * mh.data_group_count(self.mesh))
+            logger.info("[rollout %d / %d]", n_collected, num_rollouts)
+
+        if not accumulated_stats:
+            if hasattr(pbar, "close"):
+                pbar.close()
+            return
+        # aggregate over the UNION of keys: conditional keys (a clip
+        # admission mid-cycle) must not vanish just because the final
+        # chunk was fresh — that telemetry is exactly what the
+        # staleness ledger exists to surface
+        all_keys = [k for xs in accumulated_stats for k in xs]
+        agg = {
+            k: sum(xs.get(k, 0.0) for xs in accumulated_stats) / len(accumulated_stats)
+            for k in dict.fromkeys(all_keys)
+        }
+        # transport health ledger rides the same deferred stage as the
+        # rollout stats (host ints — free)
+        agg.update({
+            f"exp/{k}": float(v)
+            for k, v in exp.stats_summary().items()
+            if isinstance(v, (int, float))
+        })
+        if self._fleet is not None:
+            # fleet health rides the same ledger: dispatches/evictions/
+            # quarantines/degradations per cycle, all host ints
+            agg.update({
+                f"fleet/{k}": float(v)
+                for k, v in self._fleet.stats_summary().items()
+                if isinstance(v, (int, float))
+            })
+        if hasattr(pbar, "close"):
+            pbar.close()
+        self._deferred_rollout.stage(
+            agg, step=iter_count, meta=self._rollout_stage_meta()
+        )
+
+    def _extra_consistency_checks(self) -> None:
+        """Every host must hold the SAME experience-transport consumer
+        cursor — a drifted cursor means hosts silently trained
+        different chunks. Asserted through ``multihost.cursor_consensus``
+        at the guardrails consistency cadence; disagreement trips the
+        ladder like any other divergence."""
+        if self._exp is None or not self.guardrails.enabled:
+            return
+        result = mh.cursor_consensus(
+            "exp", self._exp.queue.epoch, self._exp.queue.cursor
+        )
+        if not result.agree:
+            self.guardrails.trip(
+                "consistency",
+                f"experience-transport cursor diverged at step "
+                f"{self.iter_count}: {result.detail}",
+            )
+
+    def _finish_rollout_stats(self) -> None:
+        """Materialize + log the deferred make_experience stats, feeding
+        the guardrails the rollout-side health signals. Trainers with
+        controller state riding the flush (PPO's adaptive KL) override.
+        Idempotent."""
+        for stats, step, meta in self._deferred_rollout.flush():
+            if meta is not None:
+                stats["kl_coef"] = float(meta)
+            if self.guardrails.enabled:
+                kl = stats.get("policy/sqrt_kl")
+                self.guardrails.observe_rollout(
+                    kl=None if kl is None else float(kl) ** 2,
+                    kl_target=None,
+                    reward_mean=stats.get("rollout_scores/mean"),
+                    running_mean=stats.get("rollout_scores/running_mean"),
+                    running_std=stats.get("rollout_scores/running_std"),
+                    truncation_rate=stats.get("rollout/truncation_rate"),
+                )
+            self._tracker_log(stats, step=step)
+
+    # -- loop hooks ------------------------------------------------------
+
+    def setup_rollout_logging(self, config) -> None:
+        import uuid
+
+        assert os.path.isdir(config.train.rollout_logging_dir)
+        self.run_id = f"run-{uuid.uuid4()}"
+        self.rollout_logging_dir = os.path.join(
+            config.train.rollout_logging_dir, self.run_id
+        )
+        os.mkdir(self.rollout_logging_dir)
+        with open(os.path.join(self.rollout_logging_dir, "config.json"), "w") as f:
+            f.write(json.dumps(config.to_dict(), indent=2))
+
+    def add_prompt_pipeline(self, pipeline) -> None:
+        # the pipeline is retained so guardrail interventions (requeue /
+        # rollback) can rebuild the stream and replay untrained prompts
+        self._prompt_pipeline = pipeline
+        self._build_prompt_iterator()
+        self._fast_forward_prompts()
+
+    def _prompt_chunk_rows(self) -> int:
+        """Prompts pulled from the stream per chunk (GRPO pulls
+        chunk_size/group_size prompts and repeats each one)."""
+        return self.config.method.chunk_size
+
+    def _build_prompt_iterator(self) -> None:
+        """(Re)create the prompt stream from position zero. The loader
+        draws its shuffles from the config seed, so a rebuild replays
+        the exact chunk sequence — fast-forwarding then restores any
+        cursor, including one BEHIND the live position (streams only
+        advance; rewind = rebuild + replay).
+
+        TOPOLOGY-INVARIANT: the stream is one GLOBAL shuffle over the
+        full prompt list, chunked at the global chunk_size; each data
+        group then collates only its own rows of every global chunk
+        (`_GroupChunkLoader`). The chunk sequence — and therefore the
+        saved `prompt_batches_consumed` cursor — means the SAME prompts
+        regardless of how many hosts/data groups the run has, so an
+        elastic resume onto a different topology neither drops nor
+        double-trains a prompt. (The previous scheme shuffled each
+        group's strided slice independently, which re-partitioned the
+        stream whenever the group count changed.) Single-group runs are
+        byte-identical to the old behavior: same loader, same RNG
+        stream, no slicing."""
+        pipeline = self._prompt_pipeline
+        # drop_last keeps chunk shapes static: one compiled sampler;
+        # a prompt list smaller than one chunk degrades to a single
+        # kept-ragged chunk (the historical len(loader)==0 fallback)
+        chunk, drop_last = self._prompt_chunk_rows(), True
+        if len(pipeline) < chunk:
+            chunk, drop_last = len(pipeline), False
+        group, group_count = mh.data_group_info(self.mesh)
+        if group_count > 1:
+            loader = _GroupChunkLoader(
+                pipeline, chunk, pipeline.collate, group, group_count,
+                seed=self.config.train.seed, drop_last=drop_last,
+            )
+        else:
+            loader = pipeline.create_loader(
+                chunk, shuffle=True, drop_last=drop_last,
+                seed=self.config.train.seed,
+            )
+        self.prompt_iterator = infinite_loader(loader)
+        self._prompt_batches_consumed = 0
+
+    def _rewind_prompt_stream(self, cursor: int) -> None:
+        """Rebuild the stream and advance it so the NEXT pull is chunk
+        ``cursor`` — the replay path for prompts whose rollouts never
+        trained (host-side batch pulls only: no generation, no scoring)."""
+        self._build_prompt_iterator()
+        for _ in range(cursor):
+            next(self.prompt_iterator)
+        self._prompt_batches_consumed = cursor
+
+    def _reset_data_stream(self) -> None:
+        """Guardrail-rollback hook: stream back to zero; the subsequent
+        load() fast-forwards to the checkpoint's saved cursor."""
+        if getattr(self, "_prompt_pipeline", None) is None:
+            return
+        self._resume_prompt_cursor = 0
+        if self._exp is not None:
+            # in-flight transport chunks belong to the discarded live
+            # state; the load() that follows restores the committed
+            # cursor on top of the bumped epoch
+            self._exp.abort_epoch()
+        self._build_prompt_iterator()
+
+    def _requeue_poisoned_batch(self) -> bool:
+        """Guardrail `requeue` rung: drop the poisoned rollout store and
+        rewind the prompt stream to the cycle start, so the same prompts
+        are re-collected with the CURRENT policy (their poisoned
+        rollouts never train; recomputed importance ratios make the
+        replay sound — IMPACT, arXiv:1912.00167)."""
+        start = getattr(self, "_cycle_cursor_start", None)
+        if len(self.store) == 0 or start is None:
+            return False
+        self._abandon_prefetch()
+        if self._exp is not None:
+            # the rebuilt stream replays this cycle's prompts: void the
+            # transport's in-flight chunks/leases under a new epoch so
+            # an old delivery can never shadow a replayed one
+            self._exp.abort_epoch()
+        self.store.clear_history()
+        self._rewind_prompt_stream(start)
+        logger.warning(
+            "guardrails: discarded the poisoned rollout batch; prompt "
+            "stream rewound to chunk %d for replay", start,
+        )
+        return True
+
+    def _reward_fallback_value(self) -> float:
+        """`resilient_io.fallback_reward: hold_mean` — substitute the
+        running-moments mean while the reward service is down, keeping
+        the reward distribution stationary instead of injecting zeros."""
+        try:
+            v = float(np.asarray(self.running_moments.mean))
+        except Exception:
+            return 0.0
+        return v if np.isfinite(v) else 0.0
+
+    def _next_prompt_batch(self) -> PromptBatch:
+        batch = next(self.prompt_iterator)
+        self._prompt_batches_consumed += 1
+        return batch
+
+    # -- cross-cycle rollout prefetch (method.overlap_rollouts) ----------
+
+    def pre_optimization_hook(self, will_continue: bool) -> None:
+        """Dispatch the FIRST chunk of the next cycle's generation ahead
+        of the fused optimization block, with the pre-update params.
+        Device FIFO runs the generation before the train scan — whose
+        buffer donation invalidates these params for any LATER dispatch
+        — and the host decodes+scores the chunk while the block trains.
+        The samples are one policy update stale, which the clipped
+        surrogate absorbs: the teacher-forced scorer recomputes
+        old_logprobs with the updated params when the chunk is
+        consumed, so the ratio stays self-consistent with the
+        optimization epoch's start."""
+        from time import time
+
+        if not self.config.method.overlap_rollouts or not will_continue:
+            return
+        if self._prefetched_gen is not None or not hasattr(self, "prompt_iterator"):
+            return
+        cursor0 = self._prompt_batches_consumed
+        batch = self._next_prompt_batch()
+        t0 = time()
+        with self.watchdog.phase("rollout", step=self.iter_count):
+            gen = self.generate(batch.input_ids, batch.attention_mask)
+        self._prefetched_gen = (batch, gen, time() - t0)
+        self._prefetch_cursor_start = cursor0
+        # staleness metadata: the prefetched chunk's samples belong to
+        # the PRE-update policy — it is consumed one optimizer cycle
+        # later at exactly staleness 1 (which the admission gate's
+        # default max_staleness admits untouched)
+        self._prefetch_policy_version = self._policy_version
+
+    def _abandon_prefetch(self) -> None:
+        """Drop an in-flight prefetched chunk and rewind the prompt
+        cursor: its rollouts never train (run ending / preempted), so a
+        resumed run must replay those prompts."""
+        if self._prefetched_gen is None:
+            return
+        self._prefetched_gen = None
+        self._prompt_batches_consumed = self._prefetch_cursor_start
+        self._prefetch_cursor_start = None
+
+    def _fast_forward_prompts(self) -> None:
+        """Resume: advance the prompt stream to the saved cursor. The
+        loader's shuffle RNG is stateful per epoch, so replaying `skip`
+        host-side batch pulls (cheap: pre-tokenized collation, no
+        generation) reproduces the exact data order the killed run would
+        have continued with."""
+        skip = self._resume_prompt_cursor - self._prompt_batches_consumed
+        if skip <= 0 or not hasattr(self, "prompt_iterator"):
+            return
+        logger.info(
+            "resume: fast-forwarding the prompt stream by %d chunks to "
+            "restore the rollout data order", skip,
+        )
+        for _ in range(skip):
+            next(self.prompt_iterator)
+        self._prompt_batches_consumed += skip
+
+    def _extra_fingerprint(self):
+        """Consistency-watchdog extras: the rollout-data cursor (host-
+        side online-trainer state that MUST advance in lockstep across
+        hosts — a drifted cursor silently trains different prompts per
+        host); subclasses layer their controller state on top."""
+        out = {
+            "prompt_cursor": float(self._prompt_batches_consumed),
+        }
+        if self._exp is not None:
+            # the transport's committed consumer position must advance
+            # in lockstep too (a drifted cursor = hosts training
+            # different chunks); also asserted dedicatedly through
+            # multihost.cursor_consensus in _extra_consistency_checks
+            out["exp_epoch"] = float(self._exp.queue.epoch)
+            out["exp_cursor"] = float(self._exp.queue.cursor)
+        return out
+
+    # -- resumable state -------------------------------------------------
+
+    def _extra_state(self):
+        rm = self.running_moments
+        state = {
+            "ref_mean": None if self.ref_mean is None else float(self.ref_mean),
+            "ref_std": None if self.ref_std is None else float(self.ref_std),
+            "running_moments": {
+                "mean": float(rm.mean), "var": float(rm.var),
+                "std": float(rm.std), "count": float(rm.count),
+            },
+            # an in-flight prefetched chunk has NOT trained: persist the
+            # cursor from before its pull, so a resume from this
+            # checkpoint replays those prompts instead of skipping them
+            "prompt_batches_consumed": (
+                self._prefetch_cursor_start
+                if self._prefetched_gen is not None
+                else self._prompt_batches_consumed
+            ),
+            # the cursor counts GLOBAL chunks of the topology-invariant
+            # stream (this marker lets a restore distinguish cursors
+            # saved under the old per-group-shuffle scheme)
+            "prompt_stream": "global-chunks-v1",
+        }
+        if self._exp is not None:
+            # the experience-transport consumer cursor, committed INSIDE
+            # the atomic checkpoint (state.json rides the integrity
+            # manifest): a resume replays exactly the unconsumed chunks
+            # — produced-but-unconsumed ones regenerate from the
+            # group-invariant prompt stream. Invariant (verify_ckpt.py's
+            # torn-commit detector): cursor <= prompt_batches_consumed,
+            # every committed chunk consumed a prompt pull.
+            state["exp_queue"] = {
+                **self._exp.state_dict(),
+                "policy_version": self._policy_version,
+                "staleness_mode": self._exp_cfg.staleness.mode,
+            }
+        if self._fleet is not None:
+            # membership epoch + last broadcast version, committed by
+            # the SAME atomic state.json write as the exp cursor —
+            # verify_ckpt.py's torn-commit detector holds the pair to
+            # the publish-cadence invariant (a cursor referencing a
+            # policy the committed snapshot never broadcast is torn)
+            state["fleet"] = self._fleet.state()
+        return state
+
+    def _restore_extra_state(self, state) -> None:
+        from trlx_tpu.ops.common import RunningMoments
+
+        self.ref_mean = state.get("ref_mean", self.ref_mean)
+        self.ref_std = state.get("ref_std", self.ref_std)
+        rm = state.get("running_moments")
+        if rm:
+            self.running_moments = RunningMoments(
+                mean=jnp.float32(rm["mean"]), var=jnp.float32(rm["var"]),
+                std=jnp.float32(rm["std"]), count=jnp.float32(rm["count"]),
+            )
+        eq = state.get("exp_queue")
+        if eq and self._exp is not None:
+            self._exp.load_state_dict(eq)
+            self._policy_version = int(eq.get("policy_version", 0))
+        if self._fleet is not None:
+            # the restore may have moved _policy_version backwards
+            # (rollback): drop the publish cursor so the next cycle
+            # rebroadcasts the restored params — otherwise workers keep
+            # the rolled-back-over weights and their chunks admit as
+            # non-stale (generation version ahead of the learner's)
+            self._fleet.reset_published()
+        self._resume_prompt_cursor = state.get("prompt_batches_consumed", 0)
+        if (
+            self._resume_prompt_cursor
+            and state.get("prompt_stream") != "global-chunks-v1"
+            and mh.data_group_count(self.mesh) > 1
+        ):
+            # pre-elastic multihost checkpoints counted chunks of
+            # per-group shuffled streams; the invariant stream replays
+            # a (deterministic) different partitioning from the same
+            # cursor — continue, but say so
+            logger.warning(
+                "restored prompt cursor %d predates the "
+                "topology-invariant stream: the replayed chunk "
+                "composition differs from the saving run's on multi-"
+                "group meshes", self._resume_prompt_cursor,
+            )
+        self._fast_forward_prompts()
+
+    def prepare_learning(self) -> None:
+        self.eval_dataloader = mh.shard_pipeline(self.eval_pipeline, self.mesh).create_loader(
+            max(self.config.method.chunk_size // mh.data_group_count(self.mesh), 1)
+        )
+        # the restored iter_count keys the deferred rollout-stats flush:
+        # without it a resumed run logs its first rollout at step 0 and
+        # breaks tracker-step monotonicity
+        self.make_experience(self.config.method.num_rollouts, self.iter_count)
+        self.n_inner_epochs = self._inner_epochs()
+        n_batches = len(self.store) // self.config.train.batch_size
+        self.total_steps = min(
+            self.config.train.epochs * self.n_inner_epochs * max(n_batches, 1),
+            self.config.train.total_steps,
+        )
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, drop_last=True,
+            seed=self.config.train.seed + self.iter_count,
+        )
+
+    def post_backward_callback(self) -> None:
+        # flush the deferred rollout stats (by now the async device->
+        # host copy has landed under the train step: a free read)
+        self._finish_rollout_stats()
+
+    def _fused_epoch_batch(self):
+        # the rollout store is a rectangular (device-resident) pytree:
+        # the whole inner-epochs x minibatch loop can run as one fused scan
+        return self.store.fused_epoch_source()
+
+    def post_epoch_callback(self) -> None:
+        if self.log_rollouts:
+            self.store.export_history(self.rollout_logging_dir, self.tokenizer)
+        self.store.clear_history()
+        self.make_experience(self.config.method.num_rollouts, self.iter_count)
 
 
 # ---------------------------------------------------------------------------

@@ -13,6 +13,8 @@ def get_trainer(name: str) -> type:
     import trlx_tpu.trainer.ilql  # noqa: F401
     import trlx_tpu.trainer.sft  # noqa: F401
     import trlx_tpu.trainer.rft  # noqa: F401
+    import trlx_tpu.trainer.grpo  # noqa: F401
+    import trlx_tpu.trainer.dpo  # noqa: F401
 
     key = name.lower()
     # accept the reference's trainer names so its configs run unmodified
@@ -24,6 +26,9 @@ def get_trainer(name: str) -> type:
         "nemoppotrainer": "tpuppotrainer",
         "nemoilqltrainer": "tpuilqltrainer",
         "nemosfttrainer": "tpusfttrainer",
+        # reference-ecosystem names for the preference-RL trainers
+        "accelerategrpotrainer": "tpugrpotrainer",
+        "acceleratedpotrainer": "tpudpotrainer",
     }
     key = aliases.get(key, key)
     if key not in trainer_pkg._TRAINERS:
