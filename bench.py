@@ -1486,10 +1486,16 @@ def bench_chaos() -> dict:
     # learner restart against real worker processes, golden-checked
     # bit-equal to the in-process exp path
     fleet_leg = bench_chaos_fleet()
+    # memory-doctor leg: injected fused-block/prefill OOMs recover
+    # through the degradation ladder without process death, hbm_creep
+    # trips the `memory` signal, and preflight rejects an over-budget
+    # config with an itemized report before any compile
+    mem_leg = bench_chaos_memory()
     return {
         **stall,
         **exp_leg,
         **fleet_leg,
+        **mem_leg,
         "chaos_completed_steps": int(trainer.iter_count),
         "chaos_rollbacks": int(trainer.guardrails.rollbacks),
         "chaos_actions": list(trainer.guardrails.actions_taken),
@@ -1635,6 +1641,164 @@ def bench_chaos_exp() -> dict:
         "exp_staleness_trips":
             stale.guardrails.trip_history.count("staleness"),
         "exp_leg_wall_s": round(time.time() - t0, 1),
+    }
+
+
+def bench_chaos_memory() -> dict:
+    """Memory-doctor chaos proof (part of ``bench.py --chaos``):
+
+    1. OOM recovery ladder — injected ``oom_fused_block`` (x2) and
+       ``oom_prefill`` faults against a gen-engine PPO run with
+       ``train.memory`` armed: the run must degrade (pool shrink +
+       microbatch split with grad-accum compensation — golden-checked
+       equal to the unsplit step in tests/test_memdoctor.py) and
+       complete its FULL step budget without process death, with the
+       degradation persisted in the committed state.json;
+    2. ``hbm_creep`` — the watermark sampler's saturated readings must
+       trip the ``memory`` guardrail signal WITHOUT aborting;
+    3. preflight admission control — a deliberately over-budget config
+       (1 MiB ``hbm_bytes``) must be REJECTED with an itemized
+       per-phase report BEFORE any rollout or compile is paid."""
+    import shutil
+
+    import numpy as np
+
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.utils.memdoctor import MemoryPlanError
+
+    t0 = time.time()
+    ckpt_dir = os.path.join("/tmp", "chaos_memory_ckpts")
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    def cfg(train_over, method_over=None):
+        return default_ppo_config().evolve(
+            train=dict(
+                dict(batch_size=8, total_steps=8, eval_interval=100,
+                     checkpoint_interval=2, seq_length=24, epochs=64,
+                     tracker="jsonl", checkpoint_dir=ckpt_dir,
+                     save_best=False, minibatch_size=8),
+                **train_over,
+            ),
+            model=dict(
+                model_path="random", num_layers_unfrozen=-1,
+                model_extra_configs={
+                    "transformer": dict(
+                        vocab_size=258, hidden_size=64, n_layer=2,
+                        n_head=2, n_positions=64,
+                    )
+                },
+            ),
+            tokenizer=dict(tokenizer_path="byte"),
+            method=dict(
+                dict(num_rollouts=8, chunk_size=8, ppo_epochs=1,
+                     gen_engine=dict(enabled=True, slots=4, page_size=8),
+                     gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                                     do_sample=True)),
+                **(method_over or {}),
+            ),
+        )
+
+    prompts = ["hello world", "the cat", "a b", "xyz",
+               "what is", "I am", "go", "ok"]
+
+    def reward(samples, prompts, outputs, **kw):
+        return [float(len(o.split())) for o in outputs]
+
+    # -- leg 1+2: OOM ladder + watermark creep, one run ------------------
+    config = cfg(dict(
+        memory=dict(enabled=True, preflight="warn"),
+        guardrails=dict(enabled=True, loss_spike_sigma=0.0,
+                        ladder=["log", "requeue", "rollback", "abort"]),
+        chaos=dict(seed=0, faults=[
+            # 2nd rollout generate: prefill OOM -> pool shrink + retry
+            {"fault": "oom_prefill", "at": 2},
+            # the 3rd fused block OOMs on two consecutive dispatch
+            # attempts (the site consults per ATTEMPT): split -> retry
+            # -> split again within one block
+            {"fault": "oom_fused_block", "at": 3, "span": 2},
+            # 5th guardrail cycle: watermark saturates -> `memory` trip
+            {"fault": "hbm_creep", "at": 5},
+        ]),
+    ))
+    trainer = trlx_tpu.train(reward_fn=reward, prompts=prompts, config=config)
+    actions = [e["action"] for e in trainer.memdoctor.events]
+    assert trainer.iter_count >= config.train.total_steps, (
+        f"memory-chaos run died mid-ladder at step {trainer.iter_count} "
+        f"(doctor events: {trainer.memdoctor.events})"
+    )
+    assert "shrink_pool" in actions and "split_microbatch" in actions, (
+        f"expected the ladder to shrink the pool AND split the "
+        f"microbatch, saw {actions}"
+    )
+    assert trainer.num_mb > 1, "microbatch split did not take effect"
+    assert "memory" in trainer.guardrails.trip_history, (
+        f"expected hbm_creep to trip the memory signal, saw "
+        f"{trainer.guardrails.trip_history}"
+    )
+    # distinguish the WATERMARK trip from the OOM events' `memory`
+    # trips: the sampler counts only consumed watermark latches
+    assert trainer.memdoctor.sampler.watermark_trips >= 1, (
+        "hbm_creep never latched a watermark trip (only OOM trips in "
+        "the history)"
+    )
+    with open(os.path.join(ckpt_dir, "logs", "metrics.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    losses = [r["losses/total_loss"] for r in recs if "losses/total_loss" in r]
+    assert losses and np.isfinite(losses[-1]), (
+        f"final loss not finite under the degraded config: {losses[-4:]}"
+    )
+    steps = sorted(
+        e for e in os.listdir(ckpt_dir) if e.startswith("checkpoint_")
+    )
+    with open(os.path.join(ckpt_dir, steps[-1], "state.json")) as f:
+        degrade = json.load(f).get("memory_degrade")
+    assert degrade and degrade["accum_factor"] > 1, (
+        f"degradation was not persisted in state.json: {degrade}"
+    )
+
+    # -- leg 3: preflight rejects an over-budget config pre-compile -----
+    calls = []
+
+    def counting_reward(samples, prompts_, outputs, **kw):
+        calls.append(1)
+        return [1.0] * len(outputs)
+
+    rejected = False
+    try:
+        trlx_tpu.train(
+            reward_fn=counting_reward, prompts=prompts,
+            config=cfg(dict(
+                checkpoint_dir=ckpt_dir + "_pf",
+                memory=dict(enabled=True, preflight="enforce",
+                            hbm_bytes=1 << 20),
+            )),
+        )
+    except MemoryPlanError as e:
+        rejected = True
+        assert "peak phase" in str(e) and "[train]" in str(e), (
+            "preflight rejection is not itemized"
+        )
+    assert rejected, "over-budget config was not rejected by preflight"
+    assert not calls, "preflight fired AFTER a rollout was paid"
+
+    return {
+        "memory_ladder_actions": actions,
+        # per-phase HBM peak attribution (empty on backends without
+        # memory_stats — CPU; populated on TPU where the watermark
+        # sampler reads real bytes-in-use)
+        "memory_phase_peaks": trainer.memdoctor.sampler.peak_stats(),
+        "memory_final_num_mb": int(trainer.num_mb),
+        "memory_pool_scale": float(trainer.memdoctor.pool_scale()),
+        # watermark latches only — the guardrail history's `memory`
+        # count also includes the OOM events' trips
+        "memory_watermark_trips":
+            int(trainer.memdoctor.sampler.watermark_trips),
+        "memory_signal_trips":
+            trainer.guardrails.trip_history.count("memory"),
+        "memory_degrade_persisted": degrade,
+        "memory_preflight_rejected": rejected,
+        "memory_leg_wall_s": round(time.time() - t0, 1),
     }
 
 

@@ -28,6 +28,16 @@ re-dispatch), a duplicate delivery (consumer dedup) and a queue wedge
 BIT-IDENTICAL to the fault-free exp run, and a `stale_flood` schedule
 must trip the `staleness` guardrail signal without aborting.
 
+And it proves the MEMORY DOCTOR (`train.memory`, utils/memdoctor.py):
+injected `oom_prefill` / `oom_fused_block` RESOURCE_EXHAUSTED faults
+must recover through the degradation ladder (gen-engine pool shrink;
+microbatch split with grad-accum compensation) with the full step
+budget completed, a finite final loss, and the degradation persisted
+in state.json; `hbm_creep` must trip the `memory` guardrail signal
+without an abort; and a deliberately over-budget config must be
+REJECTED by preflight with an itemized per-phase HBM report before
+any rollout or compile is paid.
+
 CPU-friendly (tiny random model, byte tokenizer, zero egress) — run it
 after touching guardrails / checkpointing / the rollout loop:
 `python scripts/chaos_smoke.py` (equivalently `python bench.py --chaos`).

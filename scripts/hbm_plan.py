@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Offline HBM preflight: the memory doctor's admission-control plan
+from a config file ALONE — no trainer, no device, no allocation.
+
+Builds the analytic per-phase HBM plan (utils/memdoctor.analytic_plan:
+params/optimizer/reference from an analytic parameter count;
+activations/grads/logits for the train phase; decode-engine page pools
+or the static KV cache for the rollout phase; transport/fleet host
+buffers as FYI rows) and prints the itemized report the in-trainer
+preflight would print — so a 20B sizing question is answered on a
+login node in milliseconds instead of by a dead run on the pod.
+
+Usage:
+    python scripts/hbm_plan.py configs/ppo_config.yml
+    python scripts/hbm_plan.py config.yml --hbm-gb 16        # per-device budget
+    python scripts/hbm_plan.py config.yml --json             # machine-readable
+    python scripts/hbm_plan.py config.yml --set train.batch_size=512 ...
+
+Exit code 0 = plan fits (or no budget known: report only);
+1 = over budget — the same verdict `train.memory.preflight: enforce`
+would reach in learn(), reached before any compile.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# must run on build/login nodes with no accelerator attached
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("config", help="TRLConfig YAML path")
+    parser.add_argument(
+        "--hbm-gb", type=float, default=0.0,
+        help="per-device HBM budget in GiB (overrides train.memory."
+             "hbm_bytes; 0 = use the config / report-only)",
+    )
+    parser.add_argument(
+        "--devices", type=int, default=0,
+        help="device count that resolves auto mesh axes (dp/fsdp = -1 "
+             "means 'absorb remaining devices', unknowable offline); "
+             "0 assumes 1 on the auto axis (worst case, noted in the "
+             "plan)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the plan as one JSON object instead of the table",
+    )
+    parser.add_argument(
+        "--set", action="append", default=[], metavar="PATH=VALUE",
+        help="dotted-path config overrides, e.g. train.batch_size=512 "
+             "(applied before planning; repeatable)",
+    )
+    args = parser.parse_args(argv)
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.memdoctor import analytic_plan
+
+    config = TRLConfig.load_yaml(args.config)
+    if args.set:
+        overrides = {}
+        for item in args.set:
+            path, _, raw = item.partition("=")
+            if not _:
+                parser.error(f"--set {item!r}: expected PATH=VALUE")
+            try:
+                overrides[path] = json.loads(raw)
+            except json.JSONDecodeError:
+                overrides[path] = raw
+        config = TRLConfig.update(config, overrides)
+
+    plan = analytic_plan(
+        config, hbm_bytes=int(args.hbm_gb * (1 << 30)), devices=args.devices
+    )
+    if args.json:
+        print(json.dumps(plan.to_dict(), indent=2))
+    else:
+        print(plan.report())
+    if plan.over_budget():
+        if not args.json:
+            print(
+                "\nVERDICT: OVER BUDGET — train.memory.preflight: enforce "
+                "would reject this config before any compile. Lower "
+                "batch/seq/chunk sizes, raise mesh fsdp, set "
+                "train.logit_chunks / grads_dtype / remat_policy, or "
+                "shrink method.gen_engine pool knobs."
+            )
+        return 1
+    if not args.json:
+        print("\nVERDICT: fits" if plan.budget_bytes > 0 else
+              "\nVERDICT: no budget known (pass --hbm-gb) — report only")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

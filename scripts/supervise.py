@@ -62,6 +62,7 @@ from trlx_tpu.utils.checkpointing import (  # noqa: E402
     EMERGENCY_PREFIX,
     is_committed,
 )
+from trlx_tpu.utils.memdoctor import is_degraded_record  # noqa: E402
 from trlx_tpu.utils.watchdog import EXIT_STALLED  # noqa: E402
 
 EXIT_CLASSES = {0: "clean", EXIT_STALLED: "stalled"}
@@ -110,6 +111,30 @@ def latest_emergency_snapshot(checkpoint_dir: str) -> Optional[str]:
         )
         return None
     return path
+
+
+def read_memory_degrade(checkpoint_dir: str) -> Optional[dict]:
+    """The memory-doctor degradation record of the NEWEST committed
+    checkpoint (regular or emergency), or None when absent/undegraded.
+    A relaunch resumes under this record (trainer.load() adopts it);
+    surfacing it in the ledger tells the operator the run is now
+    paying recompute/accumulation for HBM headroom — the signal to
+    re-size the config instead of relaunching forever."""
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return None
+    ckpts = (
+        _committed_steps(checkpoint_dir, "checkpoint_")
+        + _committed_steps(checkpoint_dir, EMERGENCY_PREFIX)
+    )
+    if not ckpts:
+        return None
+    _, path = max(ckpts)
+    try:
+        with open(os.path.join(path, "state.json")) as f:
+            md = json.load(f).get("memory_degrade")
+    except Exception:
+        return None
+    return md if is_degraded_record(md) else None
 
 
 class Ledger:
@@ -174,6 +199,21 @@ def supervise(
             ledger.append({**record, "action": "done"})
             print(f"supervise: clean exit after attempt {attempt}")
             return 0
+
+        # memory doctor: a relaunch resumes under the newest committed
+        # checkpoint's degradation record (trainer.load() adopts it) —
+        # surface it so the ledger shows the run is trading
+        # recompute/accumulation for HBM headroom
+        degrade = read_memory_degrade(checkpoint_dir)
+        if degrade:
+            record["memory_degrade"] = degrade
+            print(
+                "supervise: checkpoint is memory-doctor DEGRADED "
+                f"(grad-accum x{degrade.get('accum_factor', 1)}, pool "
+                f"shrinks {degrade.get('pool_shrinks', 0)}, remat "
+                f"{degrade.get('remat_policy') or 'unchanged'}) — the "
+                "relaunch resumes degraded; re-size the config to clear it"
+            )
 
         # flap detection applies to every non-clean exit class: a child
         # that dies within flap_window_s of its own launch, flap_limit

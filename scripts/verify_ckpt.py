@@ -37,6 +37,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from trlx_tpu.utils.memdoctor import is_degraded_record  # noqa: E402
 from trlx_tpu.utils.checkpointing import (  # noqa: E402
     COMMIT_MARKER,
     EMERGENCY_PREFIX,
@@ -119,6 +120,22 @@ def check_one(directory: str, deep: bool = False) -> list:
                     "it), broadcast policy version "
                     f"{'none published' if bver in (None, -1) else bver}"
                     f", publish cadence {fleet.get('broadcast_every', 1)}"
+                )
+            # memory doctor (utils/memdoctor.py): report the persisted
+            # degradation level — a resume of this checkpoint under a
+            # config with the doctor disabled fails loudly in
+            # trainer.load() (the original sizes already OOMed)
+            md = state.get("memory_degrade")
+            if is_degraded_record(md):
+                print(
+                    f"NOTE  {directory}: memory-doctor DEGRADED state — "
+                    f"pool shrinks {md.get('pool_shrinks', 0)}, grad-accum "
+                    f"x{md.get('accum_factor', 1)}, remat "
+                    f"{md.get('remat_policy') or 'unchanged'} "
+                    f"({len(md.get('events', []))} OOM events recorded). "
+                    "Resuming requires train.memory.enabled (adopts the "
+                    "degradation) or train.memory.accept_undegrade "
+                    "(asserts the original sizes fit now)"
                 )
             problems.extend(
                 f"{state_fp}: {p}" for p in check_cursor_invariants(state)

@@ -37,12 +37,12 @@ TIER1_BUDGETS = {
     # 62.4s, scanned_epochs 42.4s (RAISED 40->50: it was already over),
     # generation 11.5s, seq2seq 16.6s, remat 0.3s, models 16.2s
     # (raised 15->20), peft 13.9s, trainers 7.9s
-    "test_elastic.py": 40,
+    "test_elastic.py": 35,
     "test_examples.py": 20,
-    "test_exp_queue.py": 35,
-    "test_fault_tolerance.py": 75,
+    "test_exp_queue.py": 30,
+    "test_fault_tolerance.py": 70,
     "test_flash_attention.py": 15,
-    "test_fleet.py": 40,
+    "test_fleet.py": 35,
     "test_gen_engine.py": 40,
     "test_generation.py": 15,
     "test_golden.py": 10,
@@ -52,7 +52,14 @@ TIER1_BUDGETS = {
     "test_guardrails.py": 110,
     "test_marker_audit.py": 2,
     "test_mcts_value_branch.py": 15,
-    "test_models.py": 20,
+    # r10: memory-doctor suite (ladder units are fake-clock-fast; the
+    # cost is the split-grads golden + three tiny trainer builds) —
+    # measured 32s serial on the idle 8-way CPU mesh (2026-08-03).
+    # Paid for under the unchanged ceiling by re-trimming files whose
+    # r09 serial measurements left >=5s slack (fault_tolerance 62.4,
+    # elastic 32.0, exp_queue 28.2, fleet 33.7, peft 13.9 measured).
+    "test_memdoctor.py": 40,
+    "test_models.py": 18,
     # trimmed r07 against serial measurements (the round-6 note asked
     # the next file to trim instead of raising the ceiling): these
     # files' tier-1 portions are mostly version-gated skips/deselects —
@@ -60,7 +67,7 @@ TIER1_BUDGETS = {
     # sharding 6.1s, properties 0.06s measured 2026-08-03
     "test_multihost.py": 5,
     "test_ops.py": 10,
-    "test_peft.py": 18,
+    "test_peft.py": 15,
     "test_pipeline_parallel.py": 10,
     "test_pipelines.py": 10,
     "test_properties.py": 5,
@@ -111,6 +118,8 @@ LEARN_IN_TIER1_ALLOWLIST = {
     "test_curves.py",           # recorded-curve contract
     "test_peft.py",             # adapter roundtrip needs one tiny learn()
     "test_trainers.py",         # unmarked calls raise before training
+    "test_memdoctor.py",        # preflight-rejection test calls train()
+                                # and must RAISE before the first rollout
     "test_marker_audit.py",     # this file quotes the pattern it greps
 }
 

@@ -52,6 +52,21 @@ class PPORolloutBatch:
     # pytree-empty leaf, so every existing path (store concat, device
     # gathers, fused-scan perms) is untouched when the feature is off.
     is_weight: Optional[jnp.ndarray] = None  # [batch, resp_len] f32
+    # gradient-accumulation compensation (the memory doctor's
+    # split_microbatch rung, utils/memdoctor.py): GAE advantages +
+    # returns PREcomputed over the full minibatch before the microbatch
+    # scan splits it, so the whitening statistics match the unsplit
+    # step exactly (whitening inside loss() would normalize per
+    # microbatch and change numerics). None everywhere else — loss()
+    # then computes GAE in-graph as always.
+    advantages: Optional[jnp.ndarray] = None  # [batch, resp_len] f32
+    returns: Optional[jnp.ndarray] = None  # [batch, resp_len] f32
+    # same compensation, for the loss's mask-count normalizer: the
+    # full batch's mask total / num_mb, as a constant per-row column
+    # (sliced with the microbatch) — each microbatch then normalizes
+    # by the same constant and the accumulated mean equals the unsplit
+    # sum/N_total exactly, ragged masks included.
+    norm_n: Optional[jnp.ndarray] = None  # [batch] f32, constant rows
 
 
 @flax.struct.dataclass
@@ -71,6 +86,10 @@ class GRPORolloutBatch:
     # experience-transport staleness correction (exp.staleness.mode:
     # clip) — same contract as PPORolloutBatch.is_weight
     is_weight: Optional[jnp.ndarray] = None  # [batch, resp_len] f32
+    # split-microbatch normalizer compensation — same contract as
+    # PPORolloutBatch.norm_n (GRPO has no whitening to compensate; the
+    # mask-count normalizer is its only batch-coupled loss term)
+    norm_n: Optional[jnp.ndarray] = None  # [batch] f32, constant rows
 
 
 @flax.struct.dataclass

@@ -293,16 +293,39 @@ class TrainConfig(_Section):
     # (watchdog.EXIT_STALLED = 87), distinguishable from a crash. See
     # docs/robustness.md "Hang doctor".
     watchdog: Dict[str, Any] = field(default_factory=dict)
+    # --- memory doctor (HBM admission control + OOM recovery ladder) ----
+    # Parsed by utils/memdoctor.MemoryConfig (enabled/preflight/
+    # hbm_bytes/headroom/high_watermark/watermark_window/
+    # sample_interval_s/ladder/pool_shrink_factor/max_pool_shrinks/
+    # max_splits/remat_escalation/accept_undegrade). Default {} =
+    # disabled: no preflight, no watermark sampler, RESOURCE_EXHAUSTED
+    # propagates raw. When enabled: learn() first builds an analytic
+    # per-phase HBM plan (params/opt/grads/activations; decode-engine
+    # page pools + draft model) and REJECTS an over-budget config with
+    # an itemized report before any compile; a host-side sampler feeds
+    # the `memory` guardrail signal when bytes-in-use crosses the high
+    # watermark; and an OOM walks the degradation ladder — shrink the
+    # gen-engine page pool -> split the train microbatch (golden-equal
+    # grad accumulation) -> escalate remat -> rollback to the last
+    # health-gated checkpoint with the degradation PERSISTED in
+    # state.json -> itemized abort. See docs/robustness.md "Memory
+    # doctor".
+    memory: Dict[str, Any] = field(default_factory=dict)
     # --- chaos injection (tests/CI only) --------------------------------
     # Parsed by utils/chaos.ChaosMonkey: {"seed": int, "faults": [
     # {"fault": "nan_loss"|"sigterm"|"nan_reward"|"reward_timeout"|
     # "reward_error"|"ckpt_fail"|"ckpt_corrupt"|"host_divergence"|
-    # "stall_rollout"|"stall_reward"|"stall_collective",
+    # "stall_rollout"|"stall_reward"|"stall_collective"|
+    # "worker_death_mid_lease"|"duplicate_delivery"|"stale_flood"|
+    # "queue_wedge"|"fleet_worker_death"|"fleet_partition"|
+    # "broadcast_corrupt"|"oom_fused_block"|"oom_prefill"|"hbm_creep",
     # "at": k | "every": n | "p": x,
     # "span": m}], "reward_delay": s, "stall_delay": s}. None/{}
     # disables. Deterministic given the seed — see docs/robustness.md
     # for the schedule format (the stall_* sites sleep stall_delay
-    # seconds to prove the hang doctor end to end).
+    # seconds to prove the hang doctor end to end; the oom_* sites
+    # raise simulated RESOURCE_EXHAUSTED for the memory doctor's
+    # ladder, hbm_creep saturates its watermark sampler).
     chaos: Optional[Dict[str, Any]] = None
 
 

@@ -210,7 +210,9 @@ def engine_generate(
     of device scalars: decode_steps, refills, real_tokens,
     occupancy (real tokens / (decode_steps * slots)), truncated (rows
     that hit their budget without EOS), oom_truncated (lanes killed by
-    page-pool exhaustion — 0 unless pool_pages was undersized), and in
+    page-pool exhaustion — 0 unless pool_pages was undersized),
+    reclaimed_pages (prompt-pad compaction: pages holding nothing but
+    left-pad KV, released back to the free stack at refill), and in
     speculative mode drafted / accepted / spec_rounds.
     """
     Q, P = q_ids.shape
@@ -316,6 +318,7 @@ def engine_generate(
             emitted=jnp.int32(0),
             truncated=jnp.int32(0),
             oom=jnp.int32(0),
+            reclaimed=jnp.int32(0),
             rounds=jnp.int32(0),
             drafted=jnp.int32(0),
             accepted=jnp.int32(0),
@@ -417,6 +420,34 @@ def engine_generate(
                 draft_params, state["dpool"], state, ids, mask, posns, slot, do
             )
             state = dict(state, dpool=dpool)
+
+        if spec.paged:
+            # prompt-pad page COMPACTION: a LEFT-padded prompt's leading
+            # pages can hold nothing but pad KV (every position in them
+            # sits below npad, so its kmask bit is 0 forever) — dead
+            # weight parked on the lane from refill to finish. Release
+            # them right after prefill: reads of those positions gather
+            # the null page under a zero key mask, and neither prefill
+            # (done) nor decode (writes only at >= P) ever touches them
+            # again. This lowers the engine's HBM floor on ragged
+            # prompt mixes — the pool only has to hold REAL tokens plus
+            # page-rounding, not the pad overhang of the widest prompt.
+            npad_r = P - mask.sum(axis=1).astype(jnp.int32)
+            dead = jnp.minimum(npad_r // PS, PP)
+            pgrid = jnp.arange(PP, dtype=jnp.int32)[None, :]
+            is_dead = (pgrid < dead[:, None]) & do[:, None]  # [R, PP]
+            rows_tbl = state["table"][jnp.clip(slot, 0, SLOTS - 1)][:, :PP]
+            free, ntop = paged_kv.push_free(
+                state["free"], state["ntop"], rows_tbl.reshape(-1),
+                is_dead.reshape(-1),
+            )
+            table = state["table"].at[slot[:, None], pgrid].set(
+                jnp.where(is_dead, 0, rows_tbl), mode="drop"
+            )
+            state = dict(
+                state, free=free, ntop=ntop, table=table,
+                reclaimed=state["reclaimed"] + is_dead.sum().astype(jnp.int32),
+            )
 
         logits0 = logit_projection(params)(h_last)
         keys0 = lane_keys(rng, qc * N)
@@ -717,6 +748,7 @@ def engine_generate(
         / (steps_f * SLOTS),
         "truncated": final["truncated"],
         "oom_truncated": final["oom"],
+        "reclaimed_pages": final["reclaimed"],
         "unserved": Q - final["qnext"],
     }
     if spec.spec_decode:

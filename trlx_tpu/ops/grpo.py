@@ -70,6 +70,7 @@ def grpo_loss(
     cliprange: float,
     kl_coef: float,
     is_weight: Optional[jnp.ndarray] = None,
+    norm_n: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Clipped-ratio policy loss with a sequence-level advantage and an
     in-loss KL regularizer against the frozen reference.
@@ -88,9 +89,14 @@ def grpo_loss(
     (``exp.staleness.mode: clip``) — identical contract to
     ops/ppo.py: a stop-gradiented per-token clipped importance weight
     multiplying only the policy surrogate; None = weight 1.
+
+    ``norm_n`` overrides the mask-count normalizer (same contract as
+    ops/ppo.py: the memory doctor's microbatch split passes
+    full_total/num_mb so the accumulated mean equals the unsplit
+    step's normalization exactly with ragged masks).
     """
     mask = mask.astype(jnp.float32)
-    n = jnp.maximum(mask.sum(), 1e-8)
+    n = jnp.maximum(mask.sum() if norm_n is None else norm_n, 1e-8)
     adv = jax.lax.stop_gradient(advantages.astype(jnp.float32))[:, None]
 
     log_ratio = (logprobs - old_logprobs) * mask

@@ -62,6 +62,7 @@ def ppo_loss(
     cliprange_value: float,
     vf_coef: float,
     is_weight: Optional[jnp.ndarray] = None,
+    norm_n: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Clipped-ratio policy loss + clipped value loss, masked over real
     response tokens. All shapes [batch, response_len].
@@ -74,9 +75,16 @@ def ppo_loss(
     It multiplies only the policy surrogate; stop-gradiented, so it
     scales each token's objective without entering the ratio's
     gradient. None (the default and every fresh chunk) is exactly
-    weight 1."""
+    weight 1.
+
+    ``norm_n`` overrides the mask-count normalizer (default: this
+    call's own ``mask.sum()``). The memory doctor's microbatch split
+    passes ``full_mask_total / num_mb`` so the mean over accumulated
+    microbatches reproduces the unsplit step's ``sum/N_total`` EXACTLY
+    even with ragged response masks — each microbatch normalizing by
+    its own count would weight microbatches by 1/n_k instead."""
     mask = mask.astype(jnp.float32)
-    n = jnp.maximum(mask.sum(), 1e-8)
+    n = jnp.maximum(mask.sum() if norm_n is None else norm_n, 1e-8)
 
     values_clipped = jnp.clip(
         values, old_values - cliprange_value, old_values + cliprange_value

@@ -78,9 +78,20 @@ from trlx_tpu.utils.checkpointing import (
 )
 from trlx_tpu.utils.guardrails import (
     FLEET_SIGNAL,
+    MEMORY_SIGNAL,
     STALENESS_SIGNAL,
     STALL_SIGNAL,
     build_monitor,
+)
+from trlx_tpu.utils.memdoctor import (
+    MemoryAbortError,
+    MemoryPlanError,
+    build_memdoctor,
+    classify_oom,
+    estimate_plan,
+    is_degraded_record,
+    is_oom,
+    remat_strength,
 )
 from trlx_tpu.utils.resilient import (
     ChaosFault,
@@ -227,6 +238,18 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.watchdog = build_watchdog(train)
         self.watchdog.on_stall(self._on_watchdog_stall)
         self._warned_shadow_skip = False
+        # memory doctor (train.memory.*): preflight HBM admission
+        # control, runtime watermark sampling (feeding the `memory`
+        # guardrail signal), and the OOM recovery ladder (shrink pool
+        # -> split microbatch -> remat -> rollback -> itemized abort).
+        # Default-off = behavior-preserving: no preflight, no sampler
+        # thread, RESOURCE_EXHAUSTED propagates raw.
+        self.memdoctor = build_memdoctor(train)
+        # per-phase peak attribution keys off the hang doctor's
+        # heartbeat registry (enable train.watchdog for phase-resolved
+        # peaks; otherwise everything lands under "run")
+        self.memdoctor.sampler.set_phase_fn(self.watchdog.current_phase)
+        self._hbm_plan = None  # preflight plan, kept for the abort report
         self._resilient_cfg = ResilientIOConfig.from_dict(train.resilient_io)
         self._reward_caller: Optional[ResilientCaller] = None  # lazy
         self._lr_scale = 1.0  # cumulative guardrail LR-cut factor
@@ -812,13 +835,33 @@ class TPUBaseTrainer(BaseRLTrainer):
             return False
         return True
 
+    def _engine_spec(self, batch: int):
+        """Resolve the decode-engine spec for a call's batch width,
+        with the memory doctor's pool degradation applied: each
+        shrink_pool rung scales slots (and any explicit pool_pages)
+        by ``train.memory.pool_shrink_factor`` — fewer lanes, smaller
+        pool, same output contract (the queue just drains in more
+        refill waves)."""
+        spec = self._engine_cfg.resolve(batch, self._lm().cfg)
+        scale = self.memdoctor.pool_scale() if self.memdoctor.enabled else 1.0
+        if scale < 1.0:
+            spec = dataclasses.replace(
+                spec,
+                slots=max(1, int(spec.slots * scale)),
+                pool_pages=(
+                    max(1, int(spec.pool_pages * scale))
+                    if spec.pool_pages else 0
+                ),
+            )
+        return spec
+
     def _get_engine_fn(self, settings: SamplerSettings, shape: Tuple[int, int]):
         from trlx_tpu.models.gen_engine import (
             compose_draft_params,
             engine_generate,
         )
 
-        spec = self._engine_cfg.resolve(shape[0], self._lm().cfg)
+        spec = self._engine_spec(shape[0])
         key = (settings, shape, spec)
         if key not in self._engine_fns:
             lm = self._lm()
@@ -1065,6 +1108,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         if num_mb == 1:
             (loss, stats), grads = compute(params, batch)
         else:
+            # gradient-accumulation compensation hook: batch-statistic
+            # terms (PPO's advantage whitening) are precomputed over
+            # the FULL minibatch here, so splitting cannot change them
+            batch = self._pre_accum_batch(batch)
             mbs = jax.tree_util.tree_map(
                 lambda x: x.reshape((num_mb, mb_size) + x.shape[1:]), batch
             )
@@ -1153,6 +1200,15 @@ class TPUBaseTrainer(BaseRLTrainer):
             # with zero extra device->host transfers
             loss = jnp.where(good, loss, jnp.float32(jnp.nan))
         return new_params, new_opt_state, loss, stats
+
+    def _pre_accum_batch(self, batch):
+        """Subclass hook, traced inside the jitted step when the
+        minibatch is split into accumulation microbatches: precompute
+        any batch-statistic-coupled terms over the FULL minibatch so
+        the split step stays numerically equal to the unsplit one
+        (PPO precomputes whitened GAE advantages when the memory
+        doctor's split_microbatch rung is active). Default: identity."""
+        return batch
 
     def _pinned_state_shardings(self):
         # Pin output shardings to the current (input) shardings: without
@@ -1312,6 +1368,10 @@ class TPUBaseTrainer(BaseRLTrainer):
     def _log_fused_block(self, stats, step: int, n_steps: int) -> None:
         """Console + tracker logging for one fused block (shared by the
         deferred flush and the boundary path, so the two can't drift)."""
+        if self.memdoctor.enabled:
+            # per-phase HBM peak attribution (memory/peak_<phase>_mb)
+            # rides the tracker alongside the block's stats
+            stats.update(self.memdoctor.sampler.peak_stats())
         desc = " | ".join(
             f"{k}: {v:.2f}"
             for k, v in stats.items()
@@ -1345,6 +1405,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         # under the rollout phase, so this is a free read — and the
         # NaN-abort check runs before any new work is dispatched
         self._finish_train_stats()
+        # memory doctor: consume a latched HBM-watermark crossing once
+        # per cycle, INDEPENDENT of the guardrails gate (with guardrails
+        # on it joins this cycle's trips; off, it logs loudly)
+        self._check_memory_watermark()
         if self.guardrails.enabled:
             # pull the just-collected rollout stats early so KL/reward
             # trips are seen BEFORE training on a poisoned batch (the
@@ -1400,9 +1464,41 @@ class TPUBaseTrainer(BaseRLTrainer):
         self.pre_optimization_hook(self.iter_count + n_steps < self.total_steps)
         t0 = _time.time()
         self.watchdog.beat("fused_block", "start", step=self.iter_count)
-        with self.mesh:
-            self.params, self.opt_state, loss, stats = self._fused_train_step(
-                self.params, self.opt_state, device_full, jnp.asarray(perms)
+        # memory-doctor envelope: a RESOURCE_EXHAUSTED from the block
+        # walks the degradation ladder (split microbatch -> remat ->
+        # rollback) and RETRIES the same cycle instead of dying — the
+        # device inputs are not donated, so a degraded re-dispatch sees
+        # the identical batch. Bounded by the rung budgets (the ladder
+        # ends in abort, which raises).
+        for _attempt in range(self._oom_retry_budget()):
+            try:
+                if self.chaos is not None and self.memdoctor.enabled:
+                    # chaos: simulated OOM at the dispatch point (param
+                    # buffers intact, like a compile-time OOM)
+                    self.chaos.oom("oom_fused_block")
+                if self._fused_train_step is None:
+                    # a degradation rung dropped the jitted step
+                    self._fused_train_step = self.make_fused_train_steps()
+                with self.mesh:
+                    self.params, self.opt_state, loss, stats = self._fused_train_step(
+                        self.params, self.opt_state, device_full, jnp.asarray(perms)
+                    )
+                break
+            except Exception as e:
+                if not (self.memdoctor.enabled and is_oom(e)):
+                    raise
+                if self._handle_oom(e, "fused_block") == "skip":
+                    # rollback consumed the cycle: the epoch loop
+                    # collects fresh experience at the restored step
+                    self.watchdog.beat("fused_block", "end", step=self.iter_count)
+                    return results, False
+        else:
+            # the retry budget is a backstop against a rung that
+            # degrades without relieving the OOM — exhausting it must
+            # fail loudly, not fall through with unbound outputs
+            raise RuntimeError(
+                "memory doctor: fused block still RESOURCE_EXHAUSTED "
+                "after exhausting the degradation retry budget"
             )
         dispatch_s = _time.time() - t0
         if self.chaos is not None:
@@ -1956,6 +2052,279 @@ class TPUBaseTrainer(BaseRLTrainer):
             manifests={TOPOLOGY_MANIFEST: self._topology_manifest()},
         )
 
+    # -- memory doctor (preflight / watermarks / OOM ladder) ------------
+
+    def _extra_plan_items(self) -> List:
+        """Subclass hook: extra :class:`~trlx_tpu.utils.memdoctor.
+        PlanItem` rows folded into the preflight HBM plan (PPO adds the
+        teacher-forced experience forward's activation residency)."""
+        return []
+
+    def _memory_preflight(self) -> None:
+        """Admission control, run at the top of learn() BEFORE any
+        model compile: build the analytic per-phase HBM plan and check
+        its peak phase against the device budget. ``enforce`` fails an
+        over-budget config with the itemized report while the mistake
+        still costs seconds; ``warn`` logs the same report."""
+        md = self.memdoctor
+        if not md.enabled or md.cfg.preflight == "off":
+            return
+        plan = estimate_plan(self)
+        self._hbm_plan = plan
+        logger.info("memory doctor preflight:\n%s", plan.report())
+        if plan.over_budget():
+            msg = (
+                "memory doctor: preflight REJECTED this config — the "
+                "analytic HBM plan exceeds the admitted budget, and "
+                "compiling it would only discover the same thing the "
+                "slow way:\n" + plan.report()
+            )
+            if md.cfg.preflight == "enforce":
+                raise MemoryPlanError(msg, plan)
+            logger.warning(msg)
+
+    def _check_memory_watermark(self) -> None:
+        """Consume a latched watermark trip (and run the ``hbm_creep``
+        chaos site) at the once-per-cycle safe point: creeping HBM
+        residency raises the ``memory`` guardrail signal and walks the
+        PR 3 ladder like any other health trip."""
+        if not self.memdoctor.enabled:
+            return
+        sampler = self.memdoctor.sampler
+        if self.chaos is not None and self.chaos.consult("hbm_creep"):
+            # chaos: the next readings saturate the watermark — sampled
+            # inline so the trip lands THIS cycle deterministically
+            sampler.inject_creep()
+            for _ in range(self.memdoctor.cfg.watermark_window):
+                sampler.sample()
+        detail = sampler.consume_trip()
+        if detail:
+            if self.guardrails.enabled:
+                self.guardrails.trip(MEMORY_SIGNAL, detail)
+            else:
+                # no ladder to walk, but creep headed for an OOM must
+                # never pass silently just because guardrails are off
+                logger.warning(
+                    "memory doctor: %s — logged only (enable "
+                    "train.guardrails for the escalation ladder)", detail,
+                )
+
+    def _oom_retry_budget(self) -> int:
+        """Attempt bound shared by every OOM-retry envelope (fused
+        block / per-step / rollout): every rung the ladder could
+        possibly walk, plus slack for the terminal rollback/abort —
+        the ladder itself terminates (abort raises), this only stops a
+        logic bug from spinning."""
+        cfg = self.memdoctor.cfg
+        return cfg.max_splits + cfg.max_pool_shrinks + 4
+
+    def _oom_caps(self) -> Dict[str, bool]:
+        """What the memory doctor's ladder can actually do in THIS run:
+        pool shrinking needs the decode engine, a microbatch split
+        needs the halved size to stay sharding-divisible, remat can
+        only escalate past the configured policy."""
+        half = self.mb_size // 2
+        can_split = (
+            self.mb_size % 2 == 0
+            and half >= 1
+            and half % self.data_ways() == 0
+            and self.config.train.batch_size % (self.num_mb * 2) == 0
+        )
+        return {
+            "shrink_pool": self._engine_cfg.enabled,
+            "split_microbatch": can_split,
+            "remat": (
+                remat_strength(self.memdoctor.cfg.remat_escalation)
+                > remat_strength(self.config.train.remat_policy)
+            ),
+            "rollback": True,  # _rollback_to_last_good degrades gracefully
+        }
+
+    def _state_buffers_valid(self) -> bool:
+        """After a RUNTIME OOM the failed dispatch may already have
+        consumed its donated params/opt-state buffers — retrying with
+        deleted arrays would crash; only a restore can recover."""
+        try:
+            return not any(
+                x.is_deleted()
+                for x in jax.tree_util.tree_leaves(self._state_tree())
+                if isinstance(x, jax.Array)
+            )
+        except Exception:
+            return True
+
+    def _handle_oom(self, exc: BaseException, phase: str) -> str:
+        """Classify a RESOURCE_EXHAUSTED and execute one rung of the
+        degradation ladder. Returns ``"retry"`` when the failed
+        dispatch should be re-attempted under the degraded config,
+        ``"skip"`` when the cycle was consumed by a rollback; raises
+        the itemized abort when the ladder is exhausted (or the doctor
+        is disabled — raw propagation is the pre-doctor behavior)."""
+        md = self.memdoctor
+        if not md.enabled:
+            raise exc
+        event = classify_oom(exc, phase)
+        # unified trip accounting: the OOM joins the guardrails history
+        # (and escalates that ladder too if the run stays unhealthy)
+        self.guardrails.trip(MEMORY_SIGNAL, event.summary())
+        action = md.decide(event, self._oom_caps())
+        if action in ("shrink_pool", "split_microbatch", "remat") and (
+            not self._state_buffers_valid()
+        ):
+            # the failed dispatch already consumed its donated buffers:
+            # in-place degradation cannot retry — only a restore can
+            logger.warning(
+                "memory doctor: %s, but the failed step consumed its "
+                "donated state buffers — escalating to rollback",
+                event.summary(),
+            )
+            action = "rollback"
+        if action == "abort":
+            md.note(event, action)
+            raise MemoryAbortError(
+                md.abort_report(event, self._hbm_plan)
+            ) from exc
+        md.note(event, action)
+        if action == "shrink_pool":
+            # drop the engine's compiled fns: the next generate()
+            # resolves the spec with the new (smaller) pool scale
+            self._engine_fns.clear()
+            return "retry"
+        if action == "split_microbatch":
+            self._apply_accum_factor()
+            return "retry"
+        if action == "remat":
+            self._escalate_remat(md.cfg.remat_escalation)
+            return "retry"
+        # rollback: restore the last health-gated checkpoint; the
+        # degradation state survives it (load() merges by max)
+        if self._rollback_to_last_good():
+            return "skip"
+        raise MemoryAbortError(
+            md.abort_report(event, self._hbm_plan)
+        ) from exc
+
+    def _apply_accum_factor(self) -> None:
+        """Re-derive num_mb/mb_size from the configured microbatch and
+        the doctor's accumulation factor, and drop the jitted steps so
+        the next dispatch traces the split in. The split is
+        golden-checked equal to the unsplit step (same global batch,
+        fp32 accumulation — tests/test_memdoctor.py)."""
+        base_mb = self.config.train.minibatch_size or self.config.train.batch_size
+        mb = max(base_mb // self.memdoctor.accum_factor, 1)
+        if self.config.train.batch_size % mb or mb % self.data_ways():
+            logger.error(
+                "memory doctor: accumulation factor %d does not divide "
+                "cleanly (batch %d, base mb %d, dp*fsdp %d) — keeping "
+                "the current microbatch", self.memdoctor.accum_factor,
+                self.config.train.batch_size, base_mb, self.data_ways(),
+            )
+            return
+        if base_mb < self.config.train.batch_size:
+            # the config already accumulated (train.minibatch_size):
+            # its loss whitened batch-statistic terms per MICROBATCH
+            # (reference parity). The compensation hook precomputes
+            # them over the FULL step batch instead — the canonical,
+            # num_mb-invariant scope, which further splits preserve
+            # exactly — so the first split shifts the whitening
+            # statistics relative to the pre-OOM steps. Unavoidable:
+            # no compensation can reproduce per-64-row statistics from
+            # 32-row microbatches; say so instead of drifting silently.
+            logger.warning(
+                "memory doctor: config already used microbatch "
+                "accumulation (minibatch_size=%d) — the split switches "
+                "batch-statistic loss terms (PPO advantage whitening) "
+                "from per-microbatch to full-batch scope; numerics are "
+                "invariant to any FURTHER splits but differ from the "
+                "pre-OOM per-microbatch statistics", base_mb,
+            )
+        self.mb_size = mb
+        self.num_mb = self.config.train.batch_size // mb
+        self._train_step = None
+        self._fused_train_step = None
+        logger.warning(
+            "memory doctor: train microbatch split to %d rows "
+            "(x%d gradient accumulation; global batch unchanged)",
+            mb, self.num_mb,
+        )
+
+    def _escalate_remat(self, policy: str) -> None:
+        """Switch the run to a stronger activation-checkpoint policy
+        (ops/remat.py) and drop every jitted fn that baked the old one
+        in. Never weakens a policy the user already configured."""
+        self.config.train.remat_policy = policy
+        self.memdoctor.note_remat(policy)
+        self._drop_traced_fns()
+        logger.warning(
+            "memory doctor: activation checkpointing escalated to %r — "
+            "backward recomputes instead of keeping residuals", policy,
+        )
+
+    def _drop_traced_fns(self) -> None:
+        """Drop every cached jitted function that traced the remat
+        policy in (subclasses extend: PPO adds its experience fns)."""
+        self._train_step = None
+        self._fused_train_step = None
+        self._generate_fns.clear()
+        self._engine_fns.clear()
+
+
+    def _generate_rollout(self, input_ids, attention_mask):
+        """generate() under the memory doctor's envelope: a
+        RESOURCE_EXHAUSTED from rollout generation (the decode engine's
+        prefill is the allocation spike) walks the ladder's
+        shrink_pool rung — page pool and slots scale down, the engine
+        fns retrace, and the SAME chunk retries. The ``oom_prefill``
+        chaos site injects here, once per rollout generate() dispatch.
+        Lives on the base trainer so every experience-collecting
+        trainer (the online core AND RFT's offline sweep) shares it."""
+        for _attempt in range(self._oom_retry_budget()):
+            try:
+                if self.chaos is not None and self.memdoctor.enabled:
+                    self.chaos.oom("oom_prefill")
+                return self.generate(input_ids, attention_mask)
+            except Exception as e:
+                if not (self.memdoctor.enabled and is_oom(e)):
+                    raise
+                # rollout OOMs never return "skip" (rollback is not on
+                # the rollout sub-ladder); "retry" loops, abort raises
+                self._handle_oom(e, "rollout_prefill")
+        raise RuntimeError(
+            "memory doctor: rollout generation still RESOURCE_EXHAUSTED "
+            "after exhausting the pool-shrink budget"
+        )
+
+    def _dispatch_experience(self, fn, *args):
+        """Run a jitted teacher-forced scoring forward under the memory
+        doctor's classification envelope. An OOM here has no runtime
+        relief rung (the forward is inference-shaped: microbatch splits
+        and remat don't apply; ``train.logit_chunks`` is the
+        config-time fix) — the envelope's value is the classified,
+        itemized abort instead of a raw allocator error."""
+        try:
+            return fn(*args)
+        except Exception as e:
+            if not (self.memdoctor.enabled and is_oom(e)):
+                raise
+            self._handle_oom(e, "experience")  # experience -> abort
+            raise  # unreachable: the abort above always raises
+
+    def _apply_degradation(self) -> None:
+        """Re-apply the doctor's (restored) degradation to the live
+        trainer: pool scale, accumulation factor, remat policy. Called
+        after load() adopts a persisted ``memory_degrade``."""
+        md = self.memdoctor
+        if md.pool_shrinks:
+            self._engine_fns.clear()
+        if md.accum_factor > 1:
+            self._apply_accum_factor()
+        if md.remat_policy is not None and (
+            remat_strength(md.remat_policy)
+            > remat_strength(self.config.train.remat_policy)
+        ):
+            self.config.train.remat_policy = md.remat_policy
+            self._drop_traced_fns()
+
     # -- cross-host consistency watchdog --------------------------------
 
     def _extra_fingerprint(self) -> Dict[str, float]:
@@ -2206,15 +2575,26 @@ class TPUBaseTrainer(BaseRLTrainer):
 
     def learn(self):
         """The training loop (parity: reference learn() :518-651)."""
+        # memory doctor: admission control BEFORE any compile — an
+        # over-budget config dies here with an itemized per-phase plan
+        # instead of after a long compile (train.memory.preflight).
+        # Deliberately before preemption.install(): a rejection must
+        # not leak process-global signal handlers bound to a trainer
+        # that never trained.
+        self._memory_preflight()
         self.preemption.install()
         # arm the hang doctor for the duration of the loop (no-op when
         # train.watchdog is unset): phase heartbeats are already flowing
         # from the beat sites; this starts the monitor thread that
         # compares them against the deadlines
         self.watchdog.start()
+        # ... and the memory doctor's HBM watermark sampler (no-op on
+        # backends without memory_stats; default-off = no thread)
+        self.memdoctor.sampler.start()
         try:
             return self._learn()
         finally:
+            self.memdoctor.sampler.stop()
             self.watchdog.stop()
             self.preemption.uninstall()
             # rollout phases defer their stats behind an async device->host
@@ -2343,10 +2723,43 @@ class TPUBaseTrainer(BaseRLTrainer):
                     self.watchdog.beat(
                         "train_step", "start", step=self.iter_count
                     )
-                    with self.mesh:
-                        self.params, self.opt_state, loss, stats = self._train_step(
-                            self.params, self.opt_state, device_batch
+                    # memory-doctor envelope (per-step counterpart of
+                    # the fused-block one; the oom_fused_block chaos
+                    # site doubles for this path like nan_loss does —
+                    # a trainer runs exactly one of the two)
+                    oom_skip = False
+                    for _attempt in range(self._oom_retry_budget()):
+                        try:
+                            if self.chaos is not None and self.memdoctor.enabled:
+                                self.chaos.oom("oom_fused_block")
+                            if self._train_step is None:
+                                self._train_step = self.make_train_step()
+                            with self.mesh:
+                                self.params, self.opt_state, loss, stats = self._train_step(
+                                    self.params, self.opt_state, device_batch
+                                )
+                            break
+                        except Exception as e:
+                            if not (self.memdoctor.enabled and is_oom(e)):
+                                raise
+                            if self._handle_oom(e, "train_step") == "skip":
+                                oom_skip = True
+                                break
+                    else:
+                        raise RuntimeError(
+                            "memory doctor: train step still "
+                            "RESOURCE_EXHAUSTED after exhausting the "
+                            "degradation retry budget"
                         )
+                    if oom_skip:
+                        # rollback consumed this step's data source —
+                        # restart from the epoch top like a guardrail
+                        # rollback does
+                        self.watchdog.beat(
+                            "train_step", "end", step=self.iter_count
+                        )
+                        guard_break = True
+                        break
                     if self.chaos is not None:
                         if self.chaos.consult("sigterm"):
                             # chaos: preemption lands while the device is
@@ -2363,6 +2776,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                     )
                     step_time = clock.tick()
                     bad = self._guard_bad_loss(loss)
+                    # per-step counterpart of the fused path's
+                    # once-per-cycle watermark consumption
+                    self._check_memory_watermark()
                     if self.guardrails.enabled:
                         # unfused loop: one step = one watchdog cycle
                         self.guardrails.observe_train(
@@ -2549,6 +2965,13 @@ class TPUBaseTrainer(BaseRLTrainer):
                 else self.config.train.total_steps
             ),
         }
+        if self.memdoctor.enabled:
+            # memory-doctor degradation level (pool shrinks / grad-accum
+            # factor / remat escalation): committed INSIDE the atomic
+            # state.json so a supervise.py relaunch and trainer.load()
+            # resume already-degraded instead of re-OOMing at the
+            # original sizes (verify_ckpt.py reports it)
+            state["memory_degrade"] = self.memdoctor.degrade_state()
         state.update(self._extra_state())
         return state
 
@@ -2757,7 +3180,44 @@ class TPUBaseTrainer(BaseRLTrainer):
             self._unpack_rng(state["rng_key"])
         self._restored_total_steps = state.get("total_steps")
         self._restored_config_total_steps = state.get("config_total_steps")
+        self._restore_memory_degrade(state.get("memory_degrade"))
         self._restore_extra_state(state)
+
+    def _restore_memory_degrade(self, saved: Optional[Dict[str, Any]]) -> None:
+        """Adopt a checkpoint's persisted memory-doctor degradation.
+        A DEGRADED checkpoint exists because the original sizes already
+        OOMed — resuming it under a config that silently un-degrades it
+        (doctor disabled) would re-OOM at exactly those sizes, so that
+        fails LOUDLY unless ``train.memory.accept_undegrade`` asserts
+        the environment changed. The merge is by max (monotonic), so a
+        guardrail rollback restoring an older state.json can never
+        un-degrade the live run either."""
+        if not saved or not is_degraded_record(saved):
+            return
+        if self.memdoctor.cfg.accept_undegrade:
+            logger.warning(
+                "memory doctor: checkpoint carries degradation (%s) but "
+                "train.memory.accept_undegrade is set — resuming at the "
+                "ORIGINAL sizes; you are asserting they fit now",
+                saved,
+            )
+            return
+        if not self.memdoctor.enabled:
+            raise ValueError(
+                "this checkpoint was committed DEGRADED by the memory "
+                f"doctor ({saved}) — the original sizes already OOMed — "
+                "but train.memory is disabled in the resuming config, "
+                "which would silently un-degrade it and re-OOM. Enable "
+                "train.memory.enabled to resume degraded, or set "
+                "train.memory.accept_undegrade: true to assert the "
+                "original sizes fit now (e.g. after moving to larger "
+                "devices)"
+            )
+        self.memdoctor.restore(saved)
+        self._apply_degradation()
+        logger.warning(
+            "memory doctor: resumed degraded — %s", self.memdoctor.describe()
+        )
 
     def save_pretrained(self, directory: Optional[str] = None) -> None:
         """Deploy artifact: HF-format export of the base model when the
@@ -3089,7 +3549,7 @@ class TPUOnlineTrainer(TPUBaseTrainer):
         else:
             next_batch = self._next_prompt_batch()
             rollout_generate_time = time()
-            next_gen = self.generate(
+            next_gen = self._generate_rollout(
                 next_batch.input_ids, next_batch.attention_mask
             )
             next_gen_time = time() - rollout_generate_time
@@ -3126,7 +3586,7 @@ class TPUOnlineTrainer(TPUBaseTrainer):
             if n_collected + chunk_rows < num_rollouts:
                 next_batch = self._next_prompt_batch()
                 rollout_generate_time = time()
-                next_gen = self.generate(
+                next_gen = self._generate_rollout(
                     next_batch.input_ids, next_batch.attention_mask
                 )
                 next_gen_time = time() - rollout_generate_time
@@ -3281,6 +3741,12 @@ class TPUOnlineTrainer(TPUBaseTrainer):
             stats["rollout/engine_occupancy"] = g.get("occupancy", 0.0)
             stats["rollout/engine_refills"] = g.get("refills", 0.0)
             stats["rollout/engine_decode_steps"] = g.get("decode_steps", 0.0)
+            # prompt-pad page compaction: pages that held nothing but
+            # left-pad KV, released back to the pool at refill (lowers
+            # the engine's HBM floor on ragged prompt mixes)
+            stats["rollout/engine_reclaimed_pages"] = g.get(
+                "reclaimed_pages", 0.0
+            )
             if "drafted" in g:
                 stats["rollout/spec_accept_rate"] = g["accepted"] / max(
                     g["drafted"], 1.0
@@ -3376,7 +3842,9 @@ class TPUOnlineTrainer(TPUBaseTrainer):
                 return
             exp.heartbeat(lease)
             t0 = time()
-            gen_out = self.generate(batch.input_ids, batch.attention_mask)
+            gen_out = self._generate_rollout(
+                batch.input_ids, batch.attention_mask
+            )
             gen_time = time() - t0
             version = self._policy_version
         stats["time/rollout_generate"] = gen_time
@@ -3991,7 +4459,7 @@ class TPUOnlineTrainer(TPUBaseTrainer):
         batch = self._next_prompt_batch()
         t0 = time()
         with self.watchdog.phase("rollout", step=self.iter_count):
-            gen = self.generate(batch.input_ids, batch.attention_mask)
+            gen = self._generate_rollout(batch.input_ids, batch.attention_mask)
         self._prefetched_gen = (batch, gen, time() - t0)
         self._prefetch_cursor_start = cursor0
         # staleness metadata: the prefetched chunk's samples belong to

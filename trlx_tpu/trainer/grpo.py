@@ -72,6 +72,31 @@ class TPUGRPOTrainer(TPUOnlineTrainer):
             )
         self._experience_fns: Dict[Any, Any] = {}
 
+    def _drop_traced_fns(self) -> None:
+        # the teacher-forced experience fns trace train.remat_policy in
+        # — the memory doctor's remat escalation must retrace them
+        super()._drop_traced_fns()
+        self._experience_fns.clear()
+
+    def _pre_accum_batch(self, batch):
+        """Split-microbatch compensation for GRPO: no whitening to
+        precompute (advantages are stored per-sequence), but the
+        loss's mask-count normalizer is batch-coupled — fix it to
+        full_total/num_mb so a doctor split reproduces the unsplit
+        normalization exactly with ragged masks (same contract as
+        PPO's hook)."""
+        if self.memdoctor.accum_factor <= 1 or not isinstance(
+            batch, GRPORolloutBatch
+        ):
+            return batch
+        rows = batch.response_mask.shape[0]
+        norm = jnp.full(
+            (rows,),
+            batch.response_mask.astype(jnp.float32).sum() / self.num_mb,
+            jnp.float32,
+        )
+        return batch.replace(norm_n=norm)
+
     # -- model -----------------------------------------------------------
 
     def setup_model(self) -> None:
@@ -136,6 +161,8 @@ class TPUGRPOTrainer(TPUOnlineTrainer):
             # experience-transport staleness correction (exp.staleness.
             # mode: clip); None on every other path = weight 1
             is_weight=batch.is_weight,
+            # split-microbatch normalizer compensation (_pre_accum_batch)
+            norm_n=None if batch.norm_n is None else batch.norm_n[0],
         )
 
     # -- the method-specific score/assemble seam -------------------------
@@ -306,7 +333,8 @@ class TPUGRPOTrainer(TPUOnlineTrainer):
         if device_gen:
             with self.mesh:
                 fwd_fn = self._get_experience_fwd_fn(P_width, N)
-                pre_batch, pre_kl_stats = fwd_fn(
+                pre_batch, pre_kl_stats = self._dispatch_experience(
+                    fwd_fn,
                     self.params,
                     self.ref_params,
                     gen_out["sequences"].astype(jnp.int32),
@@ -408,7 +436,8 @@ class TPUGRPOTrainer(TPUOnlineTrainer):
             )
             with self.mesh:
                 fwd_fn = self._get_experience_fwd_fn(P, N)
-                pre_batch, kl_stats = fwd_fn(
+                pre_batch, kl_stats = self._dispatch_experience(
+                    fwd_fn,
                     self.params,
                     self.ref_params,
                     mh.global_from_local(rpad(sequences.astype(np.int32)), sharding),

@@ -188,9 +188,16 @@ class TPUPPOTrainer(TPUOnlineTrainer):
         """Recompute logprobs/values on stored rollouts, GAE on the fly,
         clipped PPO objective (parity: reference loss :127-204)."""
         method = self.config.method
-        advantages, returns = gae_advantages_and_returns(
-            batch.values, batch.rewards, gamma=method.gamma, lam=method.lam
-        )
+        if batch.advantages is not None:
+            # gradient-accumulation compensation (_pre_accum_batch):
+            # advantages were whitened over the FULL minibatch before
+            # the microbatch split — recomputing here would whiten per
+            # microbatch and diverge from the unsplit step
+            advantages, returns = batch.advantages, batch.returns
+        else:
+            advantages, returns = gae_advantages_and_returns(
+                batch.values, batch.rewards, gamma=method.gamma, lam=method.lam
+            )
         pad = self.generate_settings.pad_token_id
         remat = resolve_remat(self.config.train.remat_policy)
         # chunked-from-hidden logprobs (train.logit_chunks): the full
@@ -229,6 +236,7 @@ class TPUPPOTrainer(TPUOnlineTrainer):
                 cliprange_value=method.cliprange_value,
                 vf_coef=method.vf_coef,
                 is_weight=batch.is_weight,
+                norm_n=None if batch.norm_n is None else batch.norm_n[0],
             )
         P = batch.query_tensors.shape[1]
         N = batch.response_tensors.shape[1]
@@ -268,6 +276,8 @@ class TPUPPOTrainer(TPUOnlineTrainer):
             # experience-transport staleness correction (exp.staleness.
             # mode: clip); None on every other path = weight 1
             is_weight=batch.is_weight,
+            # split-microbatch normalizer compensation (_pre_accum_batch)
+            norm_n=None if batch.norm_n is None else batch.norm_n[0],
         )
 
     # -- the method-specific score/assemble seam -------------------------
@@ -351,6 +361,9 @@ class TPUPPOTrainer(TPUOnlineTrainer):
         inject_fn = self._get_score_inject_fn(N, S)
 
         def fn(params, ref_params, tokens, attention_mask, response_mask, scores, scores_mask, kl_coef, row_valid, scale_div):
+            # no envelope here: this composed fn is itself dispatched
+            # through _dispatch_experience at its call site — wrapping
+            # both layers would classify one OOM twice
             pre_batch, kl_stats = fwd_fn(
                 params, ref_params, tokens, attention_mask, response_mask,
                 kl_coef, row_valid,
@@ -513,7 +526,8 @@ class TPUPPOTrainer(TPUOnlineTrainer):
         if device_gen:
             with self.mesh:
                 fwd_fn = self._get_experience_fwd_fn(P_width, N)
-                pre_batch, pre_kl_stats = fwd_fn(
+                pre_batch, pre_kl_stats = self._dispatch_experience(
+                    fwd_fn,
                     self.params,
                     self.ref_params,
                     gen_out["sequences"].astype(jnp.int32),
@@ -640,7 +654,8 @@ class TPUPPOTrainer(TPUOnlineTrainer):
                     rpad(attention_mask),
                 )
             with self.mesh:
-                rollout_batch, kl_stats = exp_fn(
+                rollout_batch, kl_stats = self._dispatch_experience(
+                    exp_fn,
                     self.params,
                     self.ref_params,
                     *[mh.global_from_local(a, sharding) for a in args],
@@ -721,7 +736,8 @@ class TPUPPOTrainer(TPUOnlineTrainer):
         )
         with self.mesh:
             fwd_fn = self._get_experience_fwd_fn(P, N)
-            pre_batch, _ = fwd_fn(
+            pre_batch, _ = self._dispatch_experience(
+                fwd_fn,
                 self.params, self.ref_params, tokens, attention_mask,
                 resp_mask.astype(jnp.int32),
                 jnp.float32(self.kl_ctl.value),
@@ -754,6 +770,73 @@ class TPUPPOTrainer(TPUOnlineTrainer):
                     truncation_rate=stats.get("rollout/truncation_rate"),
                 )
             self._tracker_log(stats, step=step)
+
+    # -- memory doctor hooks ---------------------------------------------
+
+    def _pre_accum_batch(self, batch):
+        """Gradient-accumulation compensation for the memory doctor's
+        split_microbatch rung: GAE + advantage whitening are computed
+        over the FULL step batch before the scan splits it, so the
+        whitening statistics (batch mean/std) are num_mb-INVARIANT —
+        an unsplit (num_mb=1) baseline is reproduced exactly
+        (reduction-order tolerance, tests/test_memdoctor.py golden),
+        and any further doctor split preserves numerics. A config that
+        already accumulated (train.minibatch_size) whitened per
+        microbatch pre-doctor; its first split switches to this
+        full-batch scope with a logged warning (_apply_accum_factor) —
+        no compensation can reproduce the old statistics from smaller
+        microbatches. Outside a doctor split the batch passes through
+        untouched: the pre-doctor minibatch path keeps its
+        reference-parity per-microbatch whitening."""
+        if self.memdoctor.accum_factor <= 1 or not isinstance(
+            batch, PPORolloutBatch
+        ):
+            return batch
+        method = self.config.method
+        advantages, returns = gae_advantages_and_returns(
+            batch.values, batch.rewards, gamma=method.gamma, lam=method.lam
+        )
+        # the loss's mask-count normalizer, fixed to full_total/num_mb:
+        # each microbatch then divides by the same constant, so the
+        # accumulated mean equals the unsplit sum/N_total exactly even
+        # when ragged response masks make per-microbatch counts unequal
+        rows = batch.response_mask.shape[0]
+        norm = jnp.full(
+            (rows,),
+            batch.response_mask.astype(jnp.float32).sum() / self.num_mb,
+            jnp.float32,
+        )
+        return batch.replace(advantages=advantages, returns=returns, norm_n=norm)
+
+    def _drop_traced_fns(self) -> None:
+        # the teacher-forced experience fns trace train.remat_policy
+        # in too — a remat escalation must retrace them
+        super()._drop_traced_fns()
+        self._experience_fns.clear()
+
+    def _extra_plan_items(self):
+        """Preflight plan rows for PPO's method half: the teacher-forced
+        experience forward materializes one chunk's activations at
+        [chunk, P+N] on top of the rollout phase (it shares the phase
+        with generation — they run back-to-back per chunk)."""
+        from trlx_tpu.utils.memdoctor import PlanItem, _dtype_size
+
+        train = self.config.train
+        chunk = int(self.config.method.chunk_size)
+        rows_dev = max(chunk // self.data_ways(), 1)
+        cfg = self._lm().cfg
+        S = train.seq_length
+        # forward-only: residency is ~2 live layer activations, not the
+        # whole saved-for-backward stack (unless logits materialize)
+        act_b = int(rows_dev * S * cfg.hidden_size * 2
+                    * _dtype_size(train.compute_dtype))
+        chunks = max(int(train.logit_chunks or 0), 0)
+        logit_rows = S if chunks == 0 else -(-S // chunks)
+        logits_b = int(2 * rows_dev * logit_rows * cfg.vocab_size * 4)
+        return [
+            PlanItem("rollout", "experience_fwd", act_b + logits_b,
+                     "teacher-forced policy+ref forward per chunk"),
+        ]
 
     # -- controller state layered on the online-core hooks ---------------
 

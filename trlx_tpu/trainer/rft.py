@@ -114,7 +114,11 @@ class TPURFTTrainer(TPUBaseTrainer):
             for batch in self.prompt_dataloader:
                 for _ in range(method.n_generations_per_prompt):
                     self.watchdog.beat("rollout", step=self.iter_count)
-                    out = self.generate(batch.input_ids, batch.attention_mask)
+                    # memory-doctor envelope: a prefill OOM in the
+                    # sweep walks the shrink_pool rung and retries
+                    out = self._generate_rollout(
+                        batch.input_ids, batch.attention_mask
+                    )
                     sequences = mh.local_rows(out["sequences"])
                     # ragged multi-host batches come back padded with
                     # real_rows marking this group's real count

@@ -101,6 +101,28 @@ to an exact cycle/call):
                   ``exp.staleness`` gate; consulted in the learner,
                   once per broadcast publish.
 
+  Memory-doctor sites (``train.memory.enabled``; utils/memdoctor.py):
+  oom_fused_block raise a simulated RESOURCE_EXHAUSTED right before
+                  the fused optimization block (or per-step train
+                  step) dispatches — param buffers are still valid,
+                  exactly like a compile-time OOM — so the recovery
+                  ladder (split microbatch -> remat -> rollback) must
+                  degrade and RETRY the same cycle; consulted once per
+                  dispatch ATTEMPT (a degrade-and-retry consults
+                  again, so ``span: k`` forces k consecutive rungs
+                  within ONE block — the multi-rung escalation proof).
+  oom_prefill     the same simulated OOM at the top of a rollout
+                  generate() call (the decode engine's prefill is the
+                  allocation spike there): the ladder's shrink_pool
+                  rung must scale the page pool down and retry;
+                  consulted once per generate() dispatch attempt.
+  hbm_creep       the watermark sampler's next readings saturate the
+                  high watermark (a silently leaking allocation): the
+                  ``memory`` guardrail signal must trip WITHOUT an
+                  abort (guardrails off: a loud log instead); consulted
+                  once per optimization cycle (fused block or per-step
+                  dispatch), independent of the guardrails gate.
+
 Schedule entries select by count: ``{"fault": "nan_loss", "at": 2}``
 fires on the 2nd consult (1-based), ``{"fault": ..., "at": 2, "span": 3}``
 on consults 2..4, and ``{"fault": ..., "every": 5}`` on every 5th.
@@ -122,6 +144,13 @@ from trlx_tpu.utils import logging
 from trlx_tpu.utils.resilient import ChaosFault
 
 logger = logging.get_logger(__name__)
+
+
+class ChaosOOM(RuntimeError):
+    """Simulated accelerator RESOURCE_EXHAUSTED (the ``oom_*`` chaos
+    sites). Deliberately NOT a :class:`ChaosFault`: the resilient
+    retry/fallback machinery must never swallow an allocation failure
+    — only the memory doctor's ladder handles these."""
 
 FAULT_SITES = (
     "nan_loss",
@@ -145,6 +174,10 @@ FAULT_SITES = (
     "fleet_worker_death",
     "fleet_partition",
     "broadcast_corrupt",
+    # memory-doctor sites (appended, same reason)
+    "oom_fused_block",
+    "oom_prefill",
+    "hbm_creep",
 )
 
 
@@ -271,6 +304,20 @@ class ChaosMonkey:
             sleep(self.stall_delay)
             return True
         return False
+
+    def oom(self, site: str) -> None:
+        """Shared body for the two ``oom_*`` sites: consult, and on a
+        hit raise :class:`ChaosOOM` — a simulated RESOURCE_EXHAUSTED
+        whose message carries an allocator-style byte count, so the
+        memory doctor's classifier parses it exactly like jaxlib's.
+        Raised BEFORE the dispatch, so param buffers are intact and a
+        degrade-then-retry is sound (the same property a real
+        compile-time OOM has)."""
+        if self.consult(site):
+            raise ChaosOOM(
+                "RESOURCE_EXHAUSTED: chaos: out of memory while trying "
+                f"to allocate 8.00GiB ({site})"
+            )
 
     def corrupt_checkpoint(self, directory: str) -> Optional[str]:
         """``ckpt_corrupt`` body: flip one bit in the middle of the

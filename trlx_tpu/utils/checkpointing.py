@@ -321,6 +321,29 @@ def check_cursor_invariants(state: Dict[str, Any]) -> List[str]:
     resume from it would fabricate experience for prompts that were
     never drawn."""
     problems: List[str] = []
+    # memory doctor (utils/memdoctor.py): the degradation record is
+    # what a relaunch sizes itself by — malformed values would either
+    # crash the resume or silently un-degrade it, so they fail here
+    md = state.get("memory_degrade")
+    if md is not None and not isinstance(md, dict):
+        problems.append(
+            f"memory_degrade={md!r} is not a mapping (torn or "
+            "hand-edited state.json)"
+        )
+    elif isinstance(md, dict):
+        shrinks = md.get("pool_shrinks", 0)
+        accum = md.get("accum_factor", 1)
+        if not isinstance(shrinks, int) or shrinks < 0:
+            problems.append(
+                f"memory_degrade.pool_shrinks={shrinks!r} is not a "
+                "non-negative integer"
+            )
+        if not isinstance(accum, int) or accum < 1 or (accum & (accum - 1)):
+            problems.append(
+                f"memory_degrade.accum_factor={accum!r} is not a "
+                "power-of-two >= 1 (each split rung doubles it) — a "
+                "resume would derive a non-divisible microbatch"
+            )
     eq = state.get("exp_queue")
     if not isinstance(eq, dict):
         return problems

@@ -85,6 +85,16 @@ STALENESS_SIGNAL = "staleness"
 # healthy->degraded transition, not per chunk.
 FLEET_SIGNAL = "fleet"
 
+# the memory doctor's trip kind (utils/memdoctor.py): host-side HBM
+# watermark sampling saw bytes-in-use cross ``train.memory.
+# high_watermark`` for ``watermark_window`` consecutive samples —
+# creeping residency (a leak, fragmentation, an unplanned allocation)
+# headed for a RESOURCE_EXHAUSTED. The trip walks this ladder like any
+# other health signal; an actual OOM is handled separately by the
+# memory doctor's own degradation ladder (shrink pool -> split
+# microbatch -> remat -> rollback -> itemized abort).
+MEMORY_SIGNAL = "memory"
+
 
 def _finite(x) -> bool:
     try:

@@ -281,6 +281,22 @@ class HangWatchdog:
         finally:
             self.beat(name, "end", step)
 
+    def current_phase(self) -> Optional[str]:
+        """The INNERMOST in-progress phase (most recently started), or
+        None when nothing is in progress / the watchdog is disabled.
+        The memory doctor's watermark sampler uses this to attribute
+        HBM peaks to phases without its own beat plumbing."""
+        if not self.cfg.enabled:
+            return None
+        with self._lock:
+            inner_name, inner_started = None, None
+            for name, st in self._phases.items():
+                if st.started_at is None:
+                    continue
+                if inner_started is None or st.started_at > inner_started:
+                    inner_name, inner_started = name, st.started_at
+            return inner_name
+
     # -- detection -------------------------------------------------------
 
     def effective_deadline(self, phase: str) -> float:
