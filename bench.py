@@ -1251,6 +1251,124 @@ def _smoke_engine() -> dict:
     }
 
 
+def _smoke_obs() -> dict:
+    """Observability leg of ``bench.py --smoke`` (flight recorder,
+    trlx_tpu/obs/): the same tiny PPO learn() run with ``train.obs``
+    ON vs OFF (min-of-2 walls after a shared compile-cache warmup),
+    asserting
+
+    1. the recorder's host cost stays under 3% of train wall — the
+       default-on subsystem must be effectively free;
+    2. the committed ``telemetry.json``'s run-level samples/s agrees
+       with the bench-measured value (total collected samples over the
+       measured learn() wall) within tolerance — the two accounting
+       paths must not drift. The telemetry denominator is the sum of
+       CYCLE walls (excludes the initial eval and final commit), so
+       telemetry reads slightly HIGHER by construction; 35% bounds the
+       drift without flaking on that known skew.
+    """
+    import shutil
+
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    O_STEPS, O_ROLLOUTS = 6, 8
+
+    def run(tag: str, obs_enabled: bool):
+        ckpt_dir = os.path.join("/tmp", f"smoke_obs_{tag}_ckpts")
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        config = default_ppo_config().evolve(
+            train=dict(
+                batch_size=8, total_steps=O_STEPS, eval_interval=100,
+                checkpoint_interval=3, seq_length=24, epochs=64,
+                tracker="jsonl", checkpoint_dir=ckpt_dir, save_best=False,
+                obs=dict(enabled=obs_enabled),
+            ),
+            model=dict(
+                model_path="random", num_layers_unfrozen=-1,
+                model_extra_configs={
+                    "transformer": dict(
+                        vocab_size=258, hidden_size=64, n_layer=2,
+                        n_head=2, n_positions=64,
+                    )
+                },
+            ),
+            tokenizer=dict(tokenizer_path="byte"),
+            method=dict(
+                num_rollouts=O_ROLLOUTS, chunk_size=O_ROLLOUTS, ppo_epochs=1,
+                gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                                do_sample=True),
+            ),
+        )
+        t0 = time.time()
+        trainer = trlx_tpu.train(
+            reward_fn=reward_fn, prompts=PROMPTS[:O_ROLLOUTS], config=config
+        )
+        return time.time() - t0, trainer, ckpt_dir
+
+    run("warm", False)  # compile-cache warmup shared by both arms
+    # the recorder's real per-beat cost is microseconds, but two
+    # independent full learn() walls carry scheduler/page-cache noise
+    # comparable to the 3% gate — take the min over growing samples and
+    # only fail once three interleaved pairs agree the overhead is real
+    t_off, t_on = float("inf"), float("inf")
+    on_runs = []
+    for i in range(3):
+        t_off = min(t_off, run(f"off{i}", False)[0])
+        on_runs.append(run(f"on{i}", True))
+        t_on = min(r[0] for r in on_runs)
+        overhead = t_on / max(t_off, 1e-9) - 1.0
+        if overhead < 0.03:
+            break
+    assert overhead < 0.03, (
+        f"train.obs overhead {overhead:.1%} >= 3% over 3 min-of pairs "
+        f"(on {t_on:.3f}s vs off {t_off:.3f}s)"
+    )
+
+    # accounting-drift gate on the fastest obs-on run
+    wall, trainer, ckpt_dir = min(on_runs, key=lambda r: r[0])
+    with open(os.path.join(ckpt_dir, "flight", "telemetry.json")) as f:
+        telem = json.load(f)
+    head = telem["headline"]
+    cycles = int(head["cycles"])
+    # independent sample count: the tracker's metrics.jsonl carries one
+    # time/rollout_generate record per completed collection, each of
+    # O_ROLLOUTS samples — comparing telemetry against the trainer's
+    # OTHER accounting path, not against the aggregator that wrote it
+    with open(os.path.join(ckpt_dir, "logs", "metrics.jsonl")) as f:
+        collections = sum(
+            1 for line in f if "time/rollout_generate" in line
+        )
+    expected_samples = collections * O_ROLLOUTS
+    assert head["total_samples"] == expected_samples > 0, (
+        f"telemetry total_samples {head['total_samples']} != "
+        f"{collections} collections x {O_ROLLOUTS} rollouts"
+    )
+    bench_sps = head["total_samples"] / wall
+    telem_sps = head["run_samples_per_sec"]
+    drift = abs(telem_sps - bench_sps) / max(bench_sps, 1e-9)
+    assert drift < 0.35, (
+        f"telemetry samples/s {telem_sps} vs bench-measured "
+        f"{bench_sps:.3f} drifted {drift:.1%} (> 35%)"
+    )
+    # the checkpoint-committed snapshot exists and is provenance-stamped
+    steps = sorted(
+        e for e in os.listdir(ckpt_dir) if e.startswith("checkpoint_")
+    )
+    with open(os.path.join(ckpt_dir, steps[-1], "telemetry.json")) as f:
+        committed = json.load(f)
+    assert committed["provenance"]["run_id"], committed["provenance"]
+    return {
+        "smoke_obs_overhead": round(overhead, 4),
+        "smoke_obs_train_s_on": round(t_on, 3),
+        "smoke_obs_train_s_off": round(t_off, 3),
+        "smoke_obs_cycles": cycles,
+        "smoke_obs_samples_per_sec_telemetry": telem_sps,
+        "smoke_obs_samples_per_sec_bench": round(bench_sps, 3),
+        "smoke_obs_sps_drift": round(drift, 4),
+    }
+
+
 def bench_smoke() -> dict:
     """Dispatch-path perf smoke (`python bench.py --smoke`, also
     scripts/bench_smoke.py): ONE tiny PPO cycle run through BOTH train
@@ -1343,6 +1461,7 @@ def bench_smoke() -> dict:
         "smoke_mean_loss_scanned": round(mean_loss, 6),
         "smoke_last_loss_looped": round(last_loss, 6),
         **_smoke_engine(),
+        **_smoke_obs(),
     }
 
 
@@ -1473,6 +1592,33 @@ def bench_chaos() -> dict:
         f"expected a consistency-watchdog trip, saw "
         f"{trainer.guardrails.trip_history}"
     )
+    # flight recorder (train.obs, default ON): every island of this
+    # run's telemetry — guardrail trips, chaos injections, ladder
+    # actions, cycle breakdowns, checkpoint commits — must be in ONE
+    # correlated stream, and scripts/flight_report.py must render it
+    from trlx_tpu.obs.recorder import iter_rows as _flight_rows
+
+    flight_kinds: dict = {}
+    for row in _flight_rows(os.path.join(ckpt_dir, "flight")):
+        flight_kinds[row.get("kind", "?")] = (
+            flight_kinds.get(row.get("kind", "?"), 0) + 1
+        )
+    for kind in ("cycle", "guardrail_trip", "guardrail_action", "chaos",
+                 "checkpoint"):
+        assert flight_kinds.get(kind), (
+            f"flight stream is missing {kind!r} rows: {flight_kinds}"
+        )
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "flight_report", os.path.join(REPO, "scripts", "flight_report.py")
+    )
+    _fr = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_fr)
+    rendered = _fr.render(os.path.join(ckpt_dir, "flight"))
+    assert "guardrail_trip" in rendered and "slowest-phase" in rendered, (
+        "flight_report.py did not render the chaos run's stream"
+    )
     # hang-doctor leg: stall_rollout + stall_collective schedules must
     # end in detection -> stack dump -> restorable emergency snapshot ->
     # EXIT_STALLED, in child processes (the abort is a process exit)
@@ -1505,6 +1651,7 @@ def bench_chaos() -> dict:
         "chaos_consistency_trips":
             trainer.guardrails.trip_history.count("consistency"),
         "chaos_final_reward": round(float(final_reward), 4),
+        "chaos_flight_rows": flight_kinds,
         "chaos_wall_s": round(wall, 2),
     }
 
@@ -1756,6 +1903,17 @@ def bench_chaos_memory() -> dict:
     assert degrade and degrade["accum_factor"] > 1, (
         f"degradation was not persisted in state.json: {degrade}"
     )
+    # the OOM-ladder rungs must land in the run's flight-recorder
+    # stream, correlated with the guardrail `memory` trips
+    from trlx_tpu.obs.recorder import iter_rows as _flight_rows
+
+    oom_rows = [
+        r for r in _flight_rows(os.path.join(ckpt_dir, "flight"))
+        if r.get("kind") == "oom"
+    ]
+    assert {r.get("action") for r in oom_rows} >= {
+        "shrink_pool", "split_microbatch"
+    }, f"OOM-ladder rungs missing from the flight stream: {oom_rows}"
 
     # -- leg 3: preflight rejects an over-budget config pre-compile -----
     calls = []
@@ -2014,6 +2172,17 @@ def bench_chaos_fleet() -> dict:
         "expected a fleet trip from the never-arrived fleet, saw "
         f"{down.guardrails.trip_history}"
     )
+    # ... and the degrade transition must be a `fleet` guardrail_trip
+    # row in the run's flight-recorder stream (same correlated
+    # timeline as the memory/chaos legs' events)
+    from trlx_tpu.obs.recorder import iter_rows as _flight_rows
+
+    assert any(
+        r.get("kind") == "guardrail_trip" and r.get("signal") == "fleet"
+        for r in _flight_rows(
+            os.path.join("/tmp", "chaos_fleet_down_ckpts", "flight")
+        )
+    ), "fleet-degrade trip missing from the flight stream"
     assert down.iter_count >= down.config.train.total_steps, (
         f"below-min-workers leg aborted at step {down.iter_count}"
     )

@@ -138,10 +138,18 @@ def read_memory_degrade(checkpoint_dir: str) -> Optional[dict]:
 
 
 class Ledger:
-    """Append-only JSONL run ledger (one record per supervised exit)."""
+    """Append-only JSONL run ledger (one record per supervised exit).
 
-    def __init__(self, path: str):
+    When the supervised run keeps a flight-recorder stream
+    (``<checkpoint_dir>/flight/`` — trlx_tpu/obs/, on by default),
+    every ledger record is MIRRORED into it as a ``supervisor`` event,
+    so restarts/stall-resumes/give-ups land in the same correlated
+    timeline as the run's own guardrail/OOM/fleet events instead of a
+    sixth parallel format."""
+
+    def __init__(self, path: str, flight_dir: str = ""):
         self.path = path
+        self.flight_dir = flight_dir
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
 
     def append(self, record: dict) -> None:
@@ -150,6 +158,16 @@ class Ledger:
             f.write(json.dumps(record) + "\n")
             f.flush()
             os.fsync(f.fileno())
+        if self.flight_dir and os.path.isdir(self.flight_dir):
+            try:  # best-effort: the ledger stays authoritative
+                from trlx_tpu.obs.recorder import append_external
+
+                append_external(
+                    self.flight_dir, "supervisor", run="supervisor",
+                    **{k: v for k, v in record.items() if k != "ts"},
+                )
+            except Exception:
+                pass
 
 
 def supervise(
@@ -550,6 +568,14 @@ def main(argv=None) -> int:
              "slots (each formatting '{i}' with its index)",
     )
     parser.add_argument(
+        "--flight-dir", default="",
+        help="flight-recorder dir to mirror ledger records into as "
+             "'supervisor' events (default <checkpoint-dir>/flight; "
+             "point it at a custom train.obs.dir when the run uses "
+             "one). Mirroring is best-effort and skipped when the dir "
+             "does not exist",
+    )
+    parser.add_argument(
         "command", nargs=argparse.REMAINDER,
         help="the training command, after a literal --",
     )
@@ -561,7 +587,11 @@ def main(argv=None) -> int:
         parser.error("no command given (pass it after a literal --)")
     ledger = Ledger(
         args.ledger
-        or os.path.join(args.checkpoint_dir, "run_ledger.jsonl")
+        or os.path.join(args.checkpoint_dir, "run_ledger.jsonl"),
+        flight_dir=(
+            args.flight_dir
+            or os.path.join(args.checkpoint_dir, "flight")
+        ),
     )
     if args.worker_cmd:
         import shlex

@@ -137,6 +137,33 @@ def check_one(directory: str, deep: bool = False) -> list:
                     "degradation) or train.memory.accept_undegrade "
                     "(asserts the original sizes fit now)"
                 )
+            # guardrail trip tail (trlx_tpu/obs/ persists a bounded
+            # tail inside the atomic commit so the flight recorder's
+            # post-resume stream isn't amnesiac): report what tripped
+            # before this checkpoint was committed
+            trips = state.get("guardrail_trips")
+            if isinstance(trips, list) and trips:
+                counts = {}
+                for s in trips:
+                    counts[str(s)] = counts.get(str(s), 0) + 1
+                print(
+                    f"NOTE  {directory}: guardrail trip tail — "
+                    f"{len(trips)} trips ("
+                    + ", ".join(
+                        f"{k} x{v}" for k, v in sorted(counts.items())
+                    )
+                    + f"); last: {', '.join(map(str, trips[-6:]))}"
+                )
+            obs_state = state.get("obs")
+            if isinstance(obs_state, dict) and obs_state.get("run_id"):
+                print(
+                    f"NOTE  {directory}: flight-recorder run "
+                    f"{obs_state['run_id']} — cycle "
+                    f"{obs_state.get('cycle_count')}, "
+                    f"{obs_state.get('total_samples')} samples in "
+                    f"{round(float(obs_state.get('total_wall_s', 0.0)), 1)}s"
+                    " (render the stream with scripts/flight_report.py)"
+                )
             problems.extend(
                 f"{state_fp}: {p}" for p in check_cursor_invariants(state)
             )

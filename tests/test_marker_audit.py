@@ -37,19 +37,19 @@ TIER1_BUDGETS = {
     # 62.4s, scanned_epochs 42.4s (RAISED 40->50: it was already over),
     # generation 11.5s, seq2seq 16.6s, remat 0.3s, models 16.2s
     # (raised 15->20), peft 13.9s, trainers 7.9s
-    "test_elastic.py": 35,
+    "test_elastic.py": 34,
     "test_examples.py": 20,
-    "test_exp_queue.py": 30,
-    "test_fault_tolerance.py": 70,
+    "test_exp_queue.py": 29,
+    "test_fault_tolerance.py": 65,
     "test_flash_attention.py": 15,
     "test_fleet.py": 35,
-    "test_gen_engine.py": 40,
+    "test_gen_engine.py": 36,
     "test_generation.py": 15,
     "test_golden.py": 10,
     "test_grpo.py": 55,
     # r09: +4 preference-RL chaos learn() tests (GRPO nan/sigterm, DPO
     # nan/sigterm); whole file re-measured 99.9s serial
-    "test_guardrails.py": 110,
+    "test_guardrails.py": 105,
     "test_marker_audit.py": 2,
     "test_mcts_value_branch.py": 15,
     # r10: memory-doctor suite (ladder units are fake-clock-fast; the
@@ -58,16 +58,25 @@ TIER1_BUDGETS = {
     # Paid for under the unchanged ceiling by re-trimming files whose
     # r09 serial measurements left >=5s slack (fault_tolerance 62.4,
     # elastic 32.0, exp_queue 28.2, fleet 33.7, peft 13.9 measured).
-    "test_memdoctor.py": 40,
-    "test_models.py": 18,
+    "test_memdoctor.py": 37,
+    "test_models.py": 17,
     # trimmed r07 against serial measurements (the round-6 note asked
     # the next file to trim instead of raising the ceiling): these
     # files' tier-1 portions are mostly version-gated skips/deselects —
     # multihost 0.05s, pipeline_parallel 4.9s, ring_attention 6.3s,
     # sharding 6.1s, properties 0.06s measured 2026-08-03
     "test_multihost.py": 5,
+    # r11: flight-recorder suite (fake-clock units + ONE tiny learn()
+    # integration) — measured ~20s serial on the 8-way CPU mesh
+    # (2026-08-04). Paid for under the unchanged ceiling by trimming
+    # files whose r09/r10 serial measurements left slack: guardrails
+    # 110->105 (99.9 measured), fault_tolerance 70->65 (62.4),
+    # scanned_epochs 50->46 (42.4), gen_engine 40->36 (32.6),
+    # memdoctor 40->37 (32), elastic 35->34 (32.0), exp_queue 30->29
+    # (28.2), models 18->17 (16.2), peft 15->14 (13.9).
+    "test_obs.py": 25,
     "test_ops.py": 10,
-    "test_peft.py": 15,
+    "test_peft.py": 14,
     "test_pipeline_parallel.py": 10,
     "test_pipelines.py": 10,
     "test_properties.py": 5,
@@ -75,7 +84,7 @@ TIER1_BUDGETS = {
     "test_remat.py": 5,
     "test_resilient.py": 5,
     "test_ring_attention.py": 10,
-    "test_scanned_epochs.py": 50,
+    "test_scanned_epochs.py": 46,
     "test_seq2seq.py": 20,
     "test_sharding.py": 10,
     "test_summarize_eval.py": 5,
@@ -120,6 +129,8 @@ LEARN_IN_TIER1_ALLOWLIST = {
     "test_trainers.py",         # unmarked calls raise before training
     "test_memdoctor.py",        # preflight-rejection test calls train()
                                 # and must RAISE before the first rollout
+    "test_obs.py",              # the flight-recorder acceptance IS a
+                                # fault-free tiny learn() end to end
     "test_marker_audit.py",     # this file quotes the pattern it greps
 }
 

@@ -311,6 +311,27 @@ class TrainConfig(_Section):
     # state.json -> itemized abort. See docs/robustness.md "Memory
     # doctor".
     memory: Dict[str, Any] = field(default_factory=dict)
+    # --- flight recorder / run telemetry (observability) ----------------
+    # Parsed by obs.ObsConfig (enabled/dir/rotate_bytes/keep_files/
+    # telemetry_window/events_tail/profile.{start_cycle,stop_cycle,
+    # on_trip,dir,force}). DEFAULT ON (unlike the other subsystems —
+    # the point is that every run self-documents): a span tracer rides
+    # the hang doctor's existing beat sites to produce a per-cycle
+    # phase wall-time breakdown (phase sum == cycle wall by
+    # construction); guardrail trips, chaos injections, memdoctor
+    # watermark/OOM-ladder events, fleet degradations and supervisor
+    # restarts all land in ONE size-rotated JSONL flight-recorder
+    # stream under <checkpoint_dir>/flight/, correlated by
+    # run_id/cycle/policy_version; and a provenance-stamped
+    # telemetry.json with the bench-comparable headline numbers
+    # (samples/s, mask-weighted tokens/s, phase breakdown, engine
+    # ledger, analytic MFU estimate) is committed alongside every
+    # checkpoint. train.obs.profile.* arms an on-demand jax.profiler
+    # window (cycles N..M, or one-shot on a perf/memory guardrail
+    # trip). Host-side only, no device syncs; {enabled: false}
+    # restores pre-obs behavior. Render with scripts/flight_report.py;
+    # runbook: docs/observability.md.
+    obs: Dict[str, Any] = field(default_factory=dict)
     # --- chaos injection (tests/CI only) --------------------------------
     # Parsed by utils/chaos.ChaosMonkey: {"seed": int, "faults": [
     # {"fault": "nan_loss"|"sigterm"|"nan_reward"|"reward_timeout"|

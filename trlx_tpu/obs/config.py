@@ -1,0 +1,88 @@
+"""Parsed ``train.obs`` section (plain dict in YAML, like the other
+robustness subsystems — the flat TrainConfig stays YAML/back-compatible).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ProfileConfig:
+    """``train.obs.profile``: on-demand deep profiling.
+
+    start_cycle/stop_cycle  arm a ``jax.profiler`` window capture for
+                            cycles [start_cycle, stop_cycle] (1-based;
+                            0 disables the window).
+    on_trip                 additionally arm a ONE-CYCLE capture when a
+                            guardrail perf/memory signal trips
+                            (``cycle_time`` / ``memory``) — the profile
+                            of the first slow/creeping cycle is exactly
+                            the artifact a post-mortem wants.
+    dir                     capture directory (default
+                            ``<flight_dir>/profiles``).
+    force                   capture even off-TPU (tests; default the
+                            capture is a no-op on non-TPU backends —
+                            the dir is still created so arming is
+                            observable).
+    """
+
+    start_cycle: int = 0
+    stop_cycle: int = 0
+    on_trip: bool = False
+    dir: Optional[str] = None
+    force: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ProfileConfig":
+        d = dict(d or {})
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"train.obs.profile: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        return cls(**d)
+
+
+@dataclass
+class ObsConfig:
+    """Parsed ``train.obs`` section.
+
+    enabled           master switch. DEFAULT ON (unlike the other
+                      subsystems): the whole point is that every run
+                      self-documents without anyone remembering to ask.
+                      Host-side only, no device syncs, bounded cost.
+    dir               flight-recorder directory (default
+                      ``<checkpoint_dir>/flight``).
+    rotate_bytes      rotate the JSONL stream when the current file
+                      exceeds this size.
+    keep_files        rotated files retained (oldest pruned beyond it).
+    telemetry_window  cycles in the rolling headline (samples/s etc.);
+                      the first cycle is always excluded (compile).
+    events_tail       per-kind event rows retained in telemetry.json.
+    profile           :class:`ProfileConfig` sub-section.
+    """
+
+    enabled: bool = True
+    dir: Optional[str] = None
+    rotate_bytes: int = 4 * 1024 * 1024
+    keep_files: int = 8
+    telemetry_window: int = 8
+    events_tail: int = 16
+    profile: ProfileConfig = field(default_factory=ProfileConfig)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ObsConfig":
+        d = dict(d or {})
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"train.obs: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        d["profile"] = ProfileConfig.from_dict(d.get("profile"))
+        return cls(**d)

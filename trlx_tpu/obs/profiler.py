@@ -1,0 +1,120 @@
+"""On-demand deep profiling: ``jax.profiler`` window capture armed by
+cycle range (``train.obs.profile.start_cycle..stop_cycle``) or
+one-shot on a guardrail perf/memory trip (``on_trip``).
+
+The capture directory is created whenever a window arms (so arming is
+observable and the operator knows where the trace will land); the
+actual ``start_trace`` only runs on a TPU backend unless ``force`` is
+set — on CPU tier-1 runs arming is a no-op beyond the directory, and
+a profiler failure never escapes into the loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from trlx_tpu.obs.config import ProfileConfig
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+# guardrail signals that arm the one-shot capture: a slow cycle
+# (cycle_time) or creeping HBM (memory) is exactly when the next
+# cycle's profile is the post-mortem artifact
+TRIP_SIGNALS = ("cycle_time", "memory")
+
+
+class ProfilerArm:
+    """Per-cycle arming state machine. All methods are no-raise."""
+
+    def __init__(self, cfg: ProfileConfig, default_dir: str, enabled: bool = True):
+        self.cfg = cfg
+        self.dir = cfg.dir or default_dir
+        self.enabled = enabled and (
+            cfg.start_cycle > 0 or cfg.on_trip
+        )
+        self.capturing = False
+        self._oneshot_armed = False
+        self.captures = 0  # windows actually armed (tests observe this)
+        self.traced = 0    # windows that really started a jax trace
+
+    def _backend_ok(self) -> bool:
+        if self.cfg.force:
+            return True
+        try:
+            import jax
+
+            return jax.default_backend() == "tpu"
+        except Exception:
+            return False
+
+    def _start(self, cycle: int) -> None:
+        capture_dir = os.path.join(self.dir, f"cycle-{cycle:05d}")
+        try:
+            os.makedirs(capture_dir, exist_ok=True)
+        except OSError as e:
+            logger.warning("obs profiler: cannot create %s (%s)", capture_dir, e)
+            return
+        self.capturing = True
+        self.captures += 1
+        if not self._backend_ok():
+            logger.info(
+                "obs profiler: armed for cycle %d but backend is not TPU "
+                "— capture dir %s created, trace skipped", cycle, capture_dir,
+            )
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(capture_dir)
+            self.traced += 1
+            logger.info("obs profiler: tracing cycle %d -> %s", cycle, capture_dir)
+        except Exception as e:
+            logger.warning("obs profiler: start_trace failed (%s)", e)
+
+    def _stop(self) -> None:
+        if not self.capturing:
+            return
+        self.capturing = False
+        if self.traced:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:
+                logger.warning("obs profiler: stop_trace failed (%s)", e)
+
+    # -- cycle hooks -----------------------------------------------------
+
+    def begin_cycle(self, cycle: int) -> None:
+        if not self.enabled or self.capturing:
+            return
+        window = (
+            self.cfg.start_cycle > 0
+            and self.cfg.start_cycle <= cycle
+            and cycle <= max(self.cfg.stop_cycle, self.cfg.start_cycle)
+        )
+        if window or self._oneshot_armed:
+            self._oneshot_armed = False
+            self._start(cycle)
+
+    def end_cycle(self, cycle: int) -> None:
+        if not self.capturing:
+            return
+        window_continues = (
+            self.cfg.start_cycle > 0
+            and cycle + 1 <= max(self.cfg.stop_cycle, self.cfg.start_cycle)
+            and cycle + 1 >= self.cfg.start_cycle
+        )
+        if not window_continues:
+            self._stop()
+
+    def note_trip(self, signal: str) -> None:
+        """Arm a one-shot capture of the NEXT cycle on a perf/memory
+        guardrail trip."""
+        if self.enabled and self.cfg.on_trip and signal in TRIP_SIGNALS:
+            self._oneshot_armed = True
+
+    def close(self) -> None:
+        self._stop()

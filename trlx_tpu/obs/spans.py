@@ -1,0 +1,100 @@
+"""Span tracer: per-cycle wall-time partition over the watchdog's beat
+sites.
+
+The hang doctor's beat calls already mark every phase boundary the
+trainers have (rollout start/end, per-chunk refills, reward, fused
+block, per-step train, checkpoint, eval, transport waits). Rather than
+instrumenting a second time, the tracer registers as a sibling
+listener on those SAME sites (``HangWatchdog.add_listener``) and turns
+the beat stream into an exact partition of host wall time:
+
+- every instant belongs to exactly ONE phase — the innermost
+  in-progress one (phases nest: PPO's reward call runs inside the
+  rollout phase; its time is attributed to ``reward``, not double-
+  counted under ``rollout``) — or to ``other`` when no phase is open
+  (host bookkeeping between phases);
+- therefore the per-cycle phase walls SUM TO THE CYCLE WALL by
+  construction (float addition error only), which is the invariant
+  tests and the flight-report sanity check hold it to.
+
+Host-side only, no locks on the beat path (beats come from the
+training thread; the monitor thread never beats), fake-clock testable:
+timestamps arrive from the watchdog's injectable clock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+# the bucket for wall time outside any open phase (host bookkeeping,
+# dataloader pulls, tracker writes between phases)
+OTHER = "other"
+
+
+class SpanTracer:
+    """Partitions beat-site timestamps into per-phase wall seconds."""
+
+    def __init__(self):
+        self._stack: list = []  # innermost phase = last element
+        self._last: Optional[float] = None
+        self._acc: Dict[str, float] = {}
+        self._cycle_t0: Optional[float] = None
+        self.beats = 0  # total beat events observed (cost accounting)
+
+    # -- beat consumption ------------------------------------------------
+
+    def on_beat(
+        self, now: float, phase: str, event: str = "point",
+        step=None, count: int = 1,
+    ) -> None:
+        """Sibling-listener entry point (HangWatchdog.add_listener
+        signature). Attributes the elapsed time since the previous
+        event to the CURRENT innermost phase, then applies the stack
+        transition. ``point`` beats only advance the clock attribution
+        (a many-chunk rollout keeps charging ``rollout``)."""
+        self.beats += count
+        self._attribute(now)
+        if event == "start":
+            self._stack.append(phase)
+        elif event == "end":
+            # pop the innermost occurrence of this phase; exceptions
+            # unwind via the watchdog's phase() finally, so ends arrive
+            # innermost-first in practice — the reverse search keeps a
+            # mismatched end from corrupting unrelated open phases
+            for i in range(len(self._stack) - 1, -1, -1):
+                if self._stack[i] == phase:
+                    del self._stack[i]
+                    break
+
+    def _attribute(self, now: float) -> None:
+        if self._last is not None and now > self._last:
+            bucket = self._stack[-1] if self._stack else OTHER
+            self._acc[bucket] = self._acc.get(bucket, 0.0) + (now - self._last)
+        self._last = now
+
+    # -- cycle boundaries ------------------------------------------------
+
+    def start_cycle(self, now: float) -> None:
+        """Open the first cycle (subsequent cycles open implicitly at
+        :meth:`snapshot_cycle`)."""
+        self._cycle_t0 = now
+        self._last = now
+        self._acc = {}
+
+    def snapshot_cycle(self, now: float) -> Tuple[float, Dict[str, float]]:
+        """Close the current cycle at ``now``: returns ``(wall_s,
+        {phase: seconds})`` — the partition of [cycle start, now] —
+        and opens the next cycle. The stack (open phases) carries
+        across the boundary, so a phase spanning two cycles is charged
+        to each for exactly the time it spent inside it."""
+        self._attribute(now)
+        t0 = self._cycle_t0 if self._cycle_t0 is not None else now
+        wall = max(now - t0, 0.0)
+        breakdown = {k: v for k, v in self._acc.items() if v > 0.0}
+        self._cycle_t0 = now
+        self._acc = {}
+        return wall, breakdown
+
+    @property
+    def open_phases(self) -> list:
+        return list(self._stack)

@@ -83,6 +83,8 @@ from trlx_tpu.utils.guardrails import (
     STALL_SIGNAL,
     build_monitor,
 )
+from trlx_tpu.obs import build_observer
+from trlx_tpu.obs.telemetry import tree_param_count
 from trlx_tpu.utils.memdoctor import (
     MemoryAbortError,
     MemoryPlanError,
@@ -250,6 +252,26 @@ class TPUBaseTrainer(BaseRLTrainer):
         # peaks; otherwise everything lands under "run")
         self.memdoctor.sampler.set_phase_fn(self.watchdog.current_phase)
         self._hbm_plan = None  # preflight plan, kept for the abort report
+        # flight recorder (train.obs.*, trlx_tpu/obs/ — DEFAULT ON):
+        # span tracer riding the watchdog's beat sites, unified JSONL
+        # event stream under <checkpoint_dir>/flight/ fed by the
+        # guardrail/chaos listeners registered here, and continuous
+        # bench-comparable telemetry committed with every checkpoint.
+        # Host-side only; never raises into the loop.
+        self.obs = build_observer(
+            train,
+            checkpoint_dir=train.checkpoint_dir,
+            is_writer=mh.is_main(),
+            watchdog=self.watchdog,
+            guardrails=self.guardrails,
+            chaos=self.chaos,
+        )
+        # the last cycle's async metrics must survive shutdown in any
+        # order: tracker.close() drains these before backend teardown
+        self.tracker.attach_pending(self._finish_rollout_stats)
+        self.tracker.attach_pending(
+            lambda: self._finish_train_stats(suppress_abort=True)
+        )
         self._resilient_cfg = ResilientIOConfig.from_dict(train.resilient_io)
         self._reward_caller: Optional[ResilientCaller] = None  # lazy
         self._lr_scale = 1.0  # cumulative guardrail LR-cut factor
@@ -1532,6 +1554,14 @@ class TPUBaseTrainer(BaseRLTrainer):
             meta={"t0": t0, "n_steps": n_steps, "dispatch_s": dispatch_s,
                   "cycle_s": cycle_s},
         )
+        # flight recorder: one optimization cycle = rollout collection
+        # + this fused block's host span (the block's DEVICE time
+        # materializes at the next flush and lands in the next cycle's
+        # fused_block phase — steady-state attribution is consistent)
+        self.obs.end_cycle(
+            step=self.iter_count, policy_version=self._policy_version,
+            n_steps=n_steps,
+        )
         for _ in range(self.n_inner_epochs):
             self.post_backward_callback()
 
@@ -1634,6 +1664,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         CircuitBreaker with reset_timeout=0): one un-retried attempt per
         step — so a recovered backend resumes logging — with failures
         swallowed silently."""
+        # flight-recorder tap on the ONE stats funnel: telemetry reuses
+        # the exact host scalars the run already produces (the two
+        # accounting paths cannot drift), and a tracker outage below
+        # never costs the flight stream its numbers
+        self.obs.observe_stats(stats, step)
         train = self.config.train
         probing = not self._tracker_breaker.is_closed
         if not self._tracker_breaker.allow():  # unreachable at reset=0
@@ -1785,6 +1820,12 @@ class TPUBaseTrainer(BaseRLTrainer):
             if self.config.train.save_optimizer:
                 self.save(tmp_dir)
             self.save_pretrained(os.path.join(tmp_dir, "hf_model"))
+            # self-documenting perf artifact: the run's bench-comparable
+            # telemetry snapshot commits atomically WITH the checkpoint
+            # (same tmp+rename protocol, hashed by the same integrity
+            # manifest), so every checkpointed run leaves a trajectory
+            # point even when nobody runs bench.py --record
+            self.obs.write_telemetry(os.path.join(tmp_dir, "telemetry.json"))
 
         try:
             with self.watchdog.phase("checkpoint", step=self.iter_count):
@@ -1813,6 +1854,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
             return
         self._ckpt_commit_failures = 0
+        self.obs.record("checkpoint", name=name)
         if self.watchdog.enabled and self.watchdog.cfg.emergency_snapshot:
             # the commit was health-gated, so the state just persisted
             # is also the freshest "known good" — refresh the host-RAM
@@ -1962,6 +2004,13 @@ class TPUBaseTrainer(BaseRLTrainer):
             if peer and not self.guardrails.has_pending_trips:
                 self.guardrails.peer_trip()
         action = self.guardrails.pending_action()
+        if action is not None:
+            # the trip rows landed via the guardrail listener as they
+            # were recorded; this row is the ladder's DECISION
+            self.obs.record(
+                "guardrail_action", action=action,
+                rung=self.guardrails.state_summary()["rung"],
+            )
         if action is None or action == "log":
             return False  # pending_action already logged the trip
         if action == "requeue":
@@ -2099,6 +2148,9 @@ class TPUBaseTrainer(BaseRLTrainer):
                 sampler.sample()
         detail = sampler.consume_trip()
         if detail:
+            # recorded directly too: with guardrails off the crossing
+            # would otherwise exist only as a log line
+            self.obs.record("memory_watermark", detail=detail)
             if self.guardrails.enabled:
                 self.guardrails.trip(MEMORY_SIGNAL, detail)
             else:
@@ -2168,6 +2220,11 @@ class TPUBaseTrainer(BaseRLTrainer):
         # (and escalates that ladder too if the run stays unhealthy)
         self.guardrails.trip(MEMORY_SIGNAL, event.summary())
         action = md.decide(event, self._oom_caps())
+        # flight recorder: the OOM-ladder rung, in the same correlated
+        # stream as the guardrail trip above
+        self.obs.record(
+            "oom", phase=phase, action=action, detail=event.summary(),
+        )
         if action in ("shrink_pool", "split_microbatch", "remat") and (
             not self._state_buffers_valid()
         ):
@@ -2399,6 +2456,7 @@ class TPUBaseTrainer(BaseRLTrainer):
         self._consistency_counter += 1
         if self._consistency_counter % every:
             return
+        straggler_detail = None
         if self.watchdog.enabled and mh.is_multihost():
             # soft stall path: while collectives still work, compare
             # heartbeat counters fleet-wide — a host whose beats lag the
@@ -2407,6 +2465,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             # a frozen loop — is the monitor thread's deadline abort)
             strag = mh.straggler_report(self.watchdog.phase_ages())
             if not strag.agree:
+                straggler_detail = strag.detail
                 self.guardrails.trip(
                     STALL_SIGNAL,
                     f"cross-host straggler at step {self.iter_count}: "
@@ -2436,6 +2495,18 @@ class TPUBaseTrainer(BaseRLTrainer):
                 f"cross-host state fingerprint diverged at step "
                 f"{self.iter_count}: {detail or 'rows disagree'}",
             )
+        # flight recorder: cross-host row at the consensus cadence —
+        # the local phase wall/beat counters (the straggler-attribution
+        # signal) land in the same correlated timeline as everything
+        # else, so "which host/phase was behind" reads off one stream.
+        # The straggler verdict is the REPORT's, not the fingerprint's
+        # (a numeric state divergence already rides the `consistency`
+        # guardrail trip above — labeling it a straggler would misname
+        # state drift as slowness).
+        self.obs.record_hosts(
+            self.watchdog.phase_ages() if self.watchdog.enabled else {},
+            straggler_detail,
+        )
         # trainer-specific lockstep assertions at the same cadence (PPO:
         # the experience-transport consumer cursor via
         # multihost.cursor_consensus)
@@ -2591,6 +2662,18 @@ class TPUBaseTrainer(BaseRLTrainer):
         # ... and the memory doctor's HBM watermark sampler (no-op on
         # backends without memory_stats; default-off = no thread)
         self.memdoctor.sampler.start()
+        # flight recorder: stamp provenance + open the first cycle
+        # (resume keeps the restored run_id, so the stream stays one
+        # correlated timeline across relaunches)
+        self.obs.set_param_count(tree_param_count(self.params))
+        self.obs.start(
+            trainer=type(self).__name__,
+            step=self.iter_count,
+            total_steps=self.config.train.total_steps,
+            batch_size=self.config.train.batch_size,
+            seq_length=self.config.train.seq_length,
+            mesh={ax: int(s) for ax, s in self.mesh.shape.items()},
+        )
         try:
             return self._learn()
         finally:
@@ -2610,6 +2693,15 @@ class TPUBaseTrainer(BaseRLTrainer):
             # learn() exits: drop it and rewind its prompt cursor so a
             # resumed run replays those prompts
             self._abandon_prefetch()
+            # flight recorder: close the open cycle and refresh the
+            # flight-dir telemetry snapshot (after the stat flushes
+            # above, so the final cycle's numbers are in it)
+            self.obs.finish()
+            # tracker teardown LAST among metric consumers — close()
+            # re-drains any deferred stats the flushes above missed
+            # (none in this ordering; the drain is the backstop) and
+            # then flushes/releases the backends
+            self.tracker.close()
             # external producer fleets (ppo.fleet.*): signal clean
             # finish when the budget is done, leave the fleet attached
             # for the relaunch handshake otherwise
@@ -2695,6 +2787,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             # before this loop emits newer step indices
             self._finish_train_stats()
             guard_break = False  # ladder consumed this epoch's data
+            cycle_steps0 = self.iter_count  # flight-recorder cycle span
             for _ in range(self.n_inner_epochs):
                 train_dataloader = self.create_train_dataloader()
                 for batch in train_dataloader:
@@ -2860,6 +2953,12 @@ class TPUBaseTrainer(BaseRLTrainer):
             # count one version per pass over the cycle's data)
             if not guard_break:
                 self._policy_version += 1
+            # flight recorder: per-step-loop counterpart of the fused
+            # path's cycle boundary (one cycle per inner-epoch pass)
+            self.obs.end_cycle(
+                step=self.iter_count, policy_version=self._policy_version,
+                n_steps=self.iter_count - cycle_steps0,
+            )
             self.post_epoch_callback()
         # epoch exhaustion can end BELOW total_steps (a NaN-skipped step
         # consumes its batch without advancing iter_count, and small
@@ -2972,6 +3071,19 @@ class TPUBaseTrainer(BaseRLTrainer):
             # resume already-degraded instead of re-OOMing at the
             # original sizes (verify_ckpt.py reports it)
             state["memory_degrade"] = self.memdoctor.degrade_state()
+        if self.obs.active:
+            # flight-recorder correlation state: the run_id + telemetry
+            # run totals, so a resume appends to the SAME correlated
+            # stream (ids stable across restart) and the trajectory
+            # point keeps covering the whole run. Omitted when obs is
+            # disabled — verify_ckpt.py must not advertise a stream
+            # that was never written.
+            state["obs"] = self.obs.state_dict()
+        if self.guardrails.enabled:
+            # bounded guardrail trip tail, committed in the same atomic
+            # state.json: the post-resume event stream (and
+            # verify_ckpt.py) keeps the pre-restart trip record
+            state["guardrail_trips"] = self.guardrails.trip_tail()
         state.update(self._extra_state())
         return state
 
@@ -3181,6 +3293,15 @@ class TPUBaseTrainer(BaseRLTrainer):
         self._restored_total_steps = state.get("total_steps")
         self._restored_config_total_steps = state.get("config_total_steps")
         self._restore_memory_degrade(state.get("memory_degrade"))
+        # flight recorder: adopt the saved run_id + telemetry totals
+        # (correlation ids stable across resume) and the guardrail trip
+        # tail, then mark the restore in the stream itself
+        self.obs.load_state_dict(state.get("obs"))
+        self.guardrails.load_trip_tail(state.get("guardrail_trips"))
+        self.obs.record(
+            "restore", path=os.path.basename(directory),
+            to_step=self.iter_count,
+        )
         self._restore_extra_state(state)
 
     def _restore_memory_degrade(self, saved: Optional[Dict[str, Any]]) -> None:
@@ -3604,6 +3725,10 @@ class TPUOnlineTrainer(TPUBaseTrainer):
                 pbar.update(rows_local * mh.data_group_count(self.mesh))
             logger.info("[rollout %d / %d]", n_collected, num_rollouts)
 
+        # flight recorder: this cycle's collected samples — the SAME
+        # n_collected the trainer's own rollout accounting advances, so
+        # telemetry samples/s cannot drift from it
+        self.obs.note_samples(n_collected)
         if not accumulated_stats:
             # rollout abandoned before the first chunk completed
             # (preemption): nothing to log, nothing pending
@@ -3711,6 +3836,10 @@ class TPUOnlineTrainer(TPUBaseTrainer):
         N_resp = rm_np.shape[1]
         real_toks = float(rm_np.sum())
         stats["rollout/real_tokens"] = real_toks
+        # flight recorder: the honest (mask-weighted) token ledger —
+        # telemetry's tokens/s numerator reuses THIS number, so pad
+        # emissions can never inflate the trajectory artifact
+        self.obs.note_tokens(real_toks * mh.data_group_count(self.mesh))
         stats["rollout/token_occupancy"] = real_toks / max(
             rm_np.shape[0] * N_resp, 1
         )
@@ -4238,6 +4367,9 @@ class TPUOnlineTrainer(TPUBaseTrainer):
                 pbar.update(rows_local * mh.data_group_count(self.mesh))
             logger.info("[rollout %d / %d]", n_collected, num_rollouts)
 
+        # same samples accounting as the direct loop (one definition of
+        # n_collected feeds both the store and the telemetry headline)
+        self.obs.note_samples(n_collected)
         if not accumulated_stats:
             if hasattr(pbar, "close"):
                 pbar.close()

@@ -227,6 +227,9 @@ class ChaosMonkey:
             for i, s in enumerate(FAULT_SITES)
         }
         self.fired: List[Dict[str, Any]] = []
+        # fire consumer (the flight recorder, trlx_tpu/obs/): called
+        # with the fired-record dict outside the lock; must never raise
+        self.on_fire: Optional[Callable[[Dict[str, Any]], None]] = None
         # a deadline-abandoned reward worker (resilient.call_with_deadline
         # cannot kill its thread) may still consult reward sites while
         # the main thread's retry runs its own: the lock keeps the
@@ -272,6 +275,11 @@ class ChaosMonkey:
                 self.fired.append({"fault": site, "count": count})
         if hit:
             logger.warning("chaos: injecting %s (consult #%d)", site, count)
+            if self.on_fire is not None:
+                try:
+                    self.on_fire({"fault": site, "count": count})
+                except Exception:
+                    self.on_fire = None
         return hit
 
     def counts(self) -> Dict[str, int]:

@@ -259,8 +259,14 @@ class GuardrailMonitor:
         self.actions_taken: List[str] = []
         # every trip signal ever raised, in order (tiny strings; lets
         # tests/smokes assert e.g. that a consistency divergence was
-        # actually detected without scraping logs)
+        # actually detected without scraping logs). A bounded tail is
+        # persisted inside the atomic state.json commit and restored on
+        # resume/rollback (trip_tail/load_trip_tail), so the flight
+        # recorder's post-resume event stream doesn't start amnesiac.
         self.trip_history: List[str] = []
+        # trip consumers (the flight recorder, trlx_tpu/obs/): called
+        # with (signal, detail) the moment a trip is recorded
+        self._listeners: List[Any] = []
         # step of the last observation that tripped, for log context
         self._last_trip_step: Optional[int] = None
 
@@ -274,9 +280,37 @@ class GuardrailMonitor:
 
     # -- observations ----------------------------------------------------
 
+    def add_listener(self, callback) -> None:
+        """Register a trip consumer: ``callback(signal, detail)`` on
+        every recorded trip (the flight recorder correlates trips into
+        its unified stream this way). Must never raise — a failing
+        listener is dropped, not fatal."""
+        self._listeners.append(callback)
+
+    # bounded tail persisted in state.json (full history stays in RAM)
+    TRIP_TAIL_LIMIT = 64
+
+    def trip_tail(self, limit: int = TRIP_TAIL_LIMIT) -> List[str]:
+        return list(self.trip_history[-limit:])
+
+    def load_trip_tail(self, tail) -> None:
+        """Prepend a checkpoint's persisted trip tail: a resumed (or
+        rolled-back) run keeps the pre-restart trip record instead of
+        starting amnesiac. Idempotent enough for rollback (the live
+        history already contains the restored tail's events when the
+        rollback happened in-process — prepending duplicates nothing
+        because load() only restores what save() wrote BEFORE them)."""
+        if tail and not self.trip_history:
+            self.trip_history[:0] = [str(s) for s in tail]
+
     def _trip(self, signal: str, detail: str) -> None:
         self._trips.append(Trip(signal, detail))
         self.trip_history.append(signal)
+        for cb in list(self._listeners):
+            try:
+                cb(signal, detail)
+            except Exception:
+                self._listeners.remove(cb)
 
     def trip(self, signal: str, detail: str) -> None:
         """Record an externally-detected trip (e.g. the trainer's

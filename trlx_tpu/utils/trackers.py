@@ -97,6 +97,12 @@ class Tracker:
         self._tb = None
         self._wandb = None
         self._jsonl = None
+        # deferred-stats flush hooks (trainer registers its
+        # DeferredStats flushers): close() drains them BEFORE tearing
+        # down backends, so the last cycle's async metrics — staged
+        # behind a device->host copy and normally consumed one cycle
+        # later — can never be dropped by shutdown ordering
+        self._pending_flushes = []
         # multi-host: only process 0 writes (parity: reference gates all
         # trackers on accelerator.is_main_process)
         try:
@@ -152,10 +158,32 @@ class Tracker:
         if self._wandb is not None:
             self._wandb.log(stats, step=step)
 
+    def attach_pending(self, flush_fn) -> None:
+        """Register a callable that materializes + logs any still-staged
+        deferred stats (idempotent). Run by close() before the backends
+        tear down."""
+        self._pending_flushes.append(flush_fn)
+
     def close(self) -> None:
+        """Flush staged deferred stats, then tear down backends.
+        Idempotent: backends are dropped after closing, and log() on a
+        closed tracker is a silent no-op (same as a non-main process) —
+        a learn() that already closed cannot crash a later stray log."""
+        flushes, self._pending_flushes = self._pending_flushes, []
+        for flush in flushes:
+            try:
+                flush()
+            except Exception as e:
+                logger.error(
+                    "tracker.close: deferred-stats flush failed (%s); "
+                    "closing backends anyway", e,
+                )
         if self._jsonl is not None:
             self._jsonl.close()
+            self._jsonl = None
         if self._tb is not None:
             self._tb.close()
+            self._tb = None
         if self._wandb is not None:
             self._wandb.finish()
+            self._wandb = None

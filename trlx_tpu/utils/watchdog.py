@@ -218,16 +218,37 @@ class HangWatchdog:
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._on_stall: List[Callable[[StallReport], None]] = []
+        # sibling beat consumers (the flight recorder's span tracer,
+        # trlx_tpu/obs/): called on EVERY beat with (now, phase, event,
+        # step, count), even when the watchdog itself is disabled —
+        # instrumentation lands once at the beat sites and both the
+        # stall detector and the span tracer consume it
+        self._listeners: List[Callable] = []
         self.tripped: Optional[StallReport] = None
 
     @property
     def enabled(self) -> bool:
         return self.cfg.enabled
 
+    @property
+    def clock(self) -> Callable[[], float]:
+        """The timebase beats are stamped with — sibling beat consumers
+        (the flight recorder's span tracer) must share it, or cycle
+        boundaries and beat timestamps drift apart."""
+        return self._clock
+
     def on_stall(self, callback: Callable[[StallReport], None]) -> None:
         """Register an escalation callback (run on the MONITOR thread,
         after the stack dump, before the abort — keep it host-side)."""
         self._on_stall.append(callback)
+
+    def add_listener(self, callback: Callable) -> None:
+        """Register a sibling beat consumer: ``callback(now, phase,
+        event, step, count)`` on every beat, from the beating thread.
+        Listeners receive beats even with the watchdog DISABLED (the
+        flight recorder's span tracer is on by default; the stall
+        monitor is opt-in) and must never raise or block."""
+        self._listeners.append(callback)
 
     # -- heartbeats ------------------------------------------------------
 
@@ -249,9 +270,13 @@ class HangWatchdog:
         fact): the beat counter advances by N but the timeline gets a
         single annotated entry, so a burst cannot evict the other
         phases' history from the bounded timeline deque."""
-        if not self.cfg.enabled or count < 1:
+        if count < 1 or (not self.cfg.enabled and not self._listeners):
             return
         now = self._clock()
+        for listener in self._listeners:
+            listener(now, phase, event, step, count)
+        if not self.cfg.enabled:
+            return
         with self._lock:
             st = self._state(phase)
             st.beats += count
