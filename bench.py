@@ -1453,7 +1453,21 @@ def bench_smoke() -> dict:
     run_scanned(), run_looped()  # compile warmup for both paths
     t_scan, mean_loss = run_scanned()
     t_loop, last_loss = run_looped()
+    # graft-lint (trlx_tpu/analysis/) must add zero runtime import cost
+    # to the training path: after building a trainer and running both
+    # train paths, the analysis package must not be in sys.modules
+    analysis_imported = any(
+        m == "trlx_tpu.analysis" or m.startswith("trlx_tpu.analysis.")
+        for m in sys.modules
+    )
+    if analysis_imported:
+        # explicit raise (not assert): the guard must survive -O
+        raise RuntimeError(
+            "trlx_tpu.analysis leaked into the training path — the "
+            "static analysis suite must stay import-free at runtime"
+        )
     return {
+        "smoke_analysis_imported": int(analysis_imported),
         "smoke_steps": int(len(perms)),
         "smoke_train_s_scanned": round(t_scan, 4),
         "smoke_train_s_looped": round(t_loop, 4),

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""graft-lint CLI: AST-level enforcement of donation safety, trace
+purity, RNG-stream discipline and config<->docs sync (ISSUE 13;
+runbook: docs/static_analysis.md).
+
+Usage:
+    python scripts/graft_lint.py                      # full repo, exit 1 on findings
+    python scripts/graft_lint.py path/to/file.py ...  # just these files
+    python scripts/graft_lint.py --rules donation,sync-zone
+    python scripts/graft_lint.py --baseline lint_baseline.json
+    python scripts/graft_lint.py --diff lint_baseline.json
+    python scripts/graft_lint.py --update-manifests   # append-only regen
+    python scripts/graft_lint.py --json               # findings as JSON lines
+
+Suppressions are inline pragmas on the flagged line, reason required:
+    x = step(x, b)  # graft-lint: allow[donation] rematerialized below
+
+No jax, no trlx_tpu training imports — safe on a login node, and the
+analysis package is never imported by the training path (bench.py
+--smoke pins that).
+"""
+
+import argparse
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# load trlx_tpu.analysis WITHOUT executing trlx_tpu/__init__.py (which
+# imports the jax training stack): a bare namespace shim keeps this CLI
+# importable on a login node with nothing but the stdlib + pyyaml
+if "trlx_tpu" not in sys.modules:
+    _pkg = types.ModuleType("trlx_tpu")
+    _pkg.__path__ = [os.path.join(REPO, "trlx_tpu")]
+    sys.modules["trlx_tpu"] = _pkg
+
+from trlx_tpu.analysis import RULES, runner  # noqa: E402
+from trlx_tpu.analysis import manifests  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help=(
+        "files to lint (repo-relative or absolute); default: the whole "
+        "repo incl. the repo-level manifest and config<->docs checks"
+    ))
+    ap.add_argument("--repo", default=REPO, help=(
+        "tree root to lint (default: this checkout) — lets tests and "
+        "fixtures run the full pipeline against a scratch tree"
+    ))
+    ap.add_argument("--rules", default=None, help=(
+        f"comma-separated rule filter (known: {', '.join(RULES)})"
+    ))
+    ap.add_argument("--baseline", metavar="OUT.json", default=None, help=(
+        "write the (unsuppressed) findings to OUT.json and exit 0 — "
+        "the snapshot future --diff runs compare against"
+    ))
+    ap.add_argument("--diff", metavar="BASELINE.json", default=None, help=(
+        "report only findings NOT in BASELINE.json (stable keys: "
+        "rule+file+flagged text, immune to line drift)"
+    ))
+    ap.add_argument("--update-manifests", action="store_true", help=(
+        "regenerate tests/golden/ chaos-site + guardrail-signal "
+        "manifests, append-only (refuses inserts/reorders/deletes)"
+    ))
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per finding instead of text")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also list pragma-suppressed findings")
+    args = ap.parse_args(argv)
+
+    if args.update_manifests:
+        try:
+            for note in manifests.update(args.repo):
+                print(f"WROTE {note}")
+        except ValueError as e:
+            print(f"FAIL  {e}")
+            return 1
+        return 0
+
+    rules = args.rules.split(",") if args.rules else None
+    if rules:
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            ap.error(f"unknown rule(s) {unknown}; known: {', '.join(RULES)}")
+
+    paths = None
+    if args.paths:
+        paths = []
+        for p in args.paths:
+            # non-absolute paths are repo-relative (the --repo tree),
+            # not CWD-relative; absolute paths are mapped into the repo
+            ap_abs = p if os.path.isabs(p) else os.path.join(args.repo, p)
+            paths.append(
+                os.path.relpath(ap_abs, args.repo).replace(os.sep, "/")
+            )
+
+    findings = runner.run_repo(args.repo, paths=paths, rules=rules)
+    live = runner.active(findings)
+    suppressed = [f for f in findings if f.suppressed_by is not None]
+
+    if args.baseline:
+        runner.write_baseline(args.baseline, findings)
+        print(f"WROTE {args.baseline}: {len(live)} finding(s) recorded")
+        return 0
+
+    if args.diff:
+        try:
+            live = runner.diff_against(args.diff, findings)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"FAIL  cannot diff against {args.diff}: {e}")
+            return 1
+
+    for f in sorted(live, key=lambda f: (f.file, f.line, f.rule)):
+        print(json.dumps(f.to_dict()) if args.json else f"FAIL  {f.render()}")
+    if args.show_suppressed:
+        for f in sorted(suppressed, key=lambda f: (f.file, f.line)):
+            print(f"allow {f.render()}  [pragma: {f.suppressed_by}]")
+
+    if live:
+        mode = "new findings vs baseline" if args.diff else "finding(s)"
+        print(f"\ngraft-lint: {len(live)} {mode} "
+              f"({len(suppressed)} suppressed by pragma). "
+              "Runbook: docs/static_analysis.md")
+        return 1
+    print(f"OK    graft-lint clean ({len(suppressed)} pragma-suppressed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,16 +40,23 @@ TIER1_BUDGETS = {
     "test_elastic.py": 34,
     "test_examples.py": 20,
     "test_exp_queue.py": 29,
-    "test_fault_tolerance.py": 65,
+    "test_fault_tolerance.py": 63,
     "test_flash_attention.py": 15,
     "test_fleet.py": 35,
-    "test_gen_engine.py": 36,
+    "test_gen_engine.py": 34,
     "test_generation.py": 15,
     "test_golden.py": 10,
+    # r13: graft-lint suite (pure-AST checker units + one whole-repo
+    # lint + two tiny jax-free subprocesses) — measured ~5.2s serial on
+    # the 8-way CPU mesh (2026-08-04). Paid for under the unchanged
+    # ceiling by trimming r09/r10-measured slack: guardrails 105->103
+    # (99.9 measured), fault_tolerance 65->63 (62.4), gen_engine 36->34
+    # (32.6), memdoctor 37->35 (32).
+    "test_graft_lint.py": 8,
     "test_grpo.py": 55,
     # r09: +4 preference-RL chaos learn() tests (GRPO nan/sigterm, DPO
     # nan/sigterm); whole file re-measured 99.9s serial
-    "test_guardrails.py": 105,
+    "test_guardrails.py": 103,
     "test_marker_audit.py": 2,
     "test_mcts_value_branch.py": 15,
     # r10: memory-doctor suite (ladder units are fake-clock-fast; the
@@ -58,7 +65,7 @@ TIER1_BUDGETS = {
     # Paid for under the unchanged ceiling by re-trimming files whose
     # r09 serial measurements left >=5s slack (fault_tolerance 62.4,
     # elastic 32.0, exp_queue 28.2, fleet 33.7, peft 13.9 measured).
-    "test_memdoctor.py": 37,
+    "test_memdoctor.py": 35,
     "test_models.py": 17,
     # trimmed r07 against serial measurements (the round-6 note asked
     # the next file to trim instead of raising the ceiling): these

@@ -1,0 +1,53 @@
+"""graft-lint: stdlib-``ast`` static enforcement of the repo's
+hardest-won invariants (ISSUE 13). No jax import, no trlx_tpu import —
+this package must stay loadable on a login node with nothing but the
+standard library, and must NEVER be imported by the training path
+(``bench.py --smoke`` and tests/test_graft_lint.py pin that).
+
+Checkers (rule ids):
+
+  donation      read-after-donation of buffers consumed by a
+                ``donate_argnums``/``donate_argnames`` jit (the PR 3
+                heap-corruption class: orbax-restored arrays fed to a
+                donating train step, then read again).
+  trace-purity  side effects inside functions traced by
+                jit/pjit/scan/while_loop/fori_loop/cond/switch/
+                shard_map/checkpoint: print, time.*, np.random/random,
+                Python-state mutation, host-sync constructs.
+  sync-zone     device-sync constructs (``.item()``,
+                ``block_until_ready``, ``np.asarray``, ``device_get``,
+                module-scope jax imports) in modules that claim
+                "host-side, no device syncs" (``trlx_tpu/obs/``,
+                ``utils/watchdog.py`` — plus any module whose docstring
+                makes the claim).
+  rng-manifest  chaos-site registry (utils/chaos.py FAULT_SITES) and
+                guardrail-signal set (utils/guardrails.py) checked
+                against committed manifests under tests/golden/ —
+                append-only, automating the per-PR hand-check.
+  config-docs   every dataclass field reachable from TRLConfig must be
+                documented in docs/api.md and annotated in
+                configs/test_config.yml, and vice versa.
+  bad-pragma    a ``# graft-lint: allow[...]`` pragma with an unknown
+                rule id or no reason (reasonless suppressions are not
+                suppressions).
+
+Findings are suppressible only via an inline pragma on the flagged
+line::
+
+    x = step(x, batch)  # graft-lint: allow[donation] rematerialized below
+
+CLI: ``python scripts/graft_lint.py`` (see docs/static_analysis.md).
+"""
+
+from trlx_tpu.analysis.common import Finding  # noqa: F401
+from trlx_tpu.analysis.runner import run_repo  # noqa: F401
+
+RULES = (
+    "donation",
+    "trace-purity",
+    "sync-zone",
+    "rng-manifest",
+    "config-docs",
+    "bad-pragma",
+    "lint-error",
+)

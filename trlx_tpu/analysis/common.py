@@ -1,0 +1,217 @@
+"""Shared graft-lint plumbing: findings, pragmas, module parsing, and
+import-alias resolution. Stdlib only — see the package docstring."""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+KNOWN_RULES = (
+    "donation",
+    "trace-purity",
+    "sync-zone",
+    "rng-manifest",
+    "config-docs",
+    "bad-pragma",
+    "lint-error",  # I/O failures of the lint itself; never filterable
+)
+
+# inline suppression grammar: `# graft-lint: allow[<rule>] <reason>`;
+# several allow[...] groups may share one comment
+_PRAGMA_RE = re.compile(
+    r"graft-lint:\s*allow\[(?P<rule>[a-z0-9_-]+)\]\s*"
+    r"(?P<reason>(?:(?!graft-lint:)[^#])*)"
+)
+
+
+@dataclass
+class Finding:
+    """One lint finding, anchored to a repo-relative file:line."""
+
+    rule: str
+    file: str
+    line: int
+    message: str
+    snippet: str = ""
+    suppressed_by: Optional[str] = None  # pragma reason when suppressed
+
+    @property
+    def key(self) -> str:
+        """Stable identity for baseline/diff: deliberately excludes the
+        line number (pure line drift must not resurface a triaged
+        finding) but includes the flagged source text."""
+        basis = f"{self.rule}|{self.file}|{self.snippet.strip()}"
+        return hashlib.sha1(basis.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+            "snippet": self.snippet.strip(),
+            "key": self.key,
+        }
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Pragma:
+    rule: str
+    reason: str
+    line: int
+
+
+def collect_pragmas(source: str) -> Dict[int, List[Pragma]]:
+    """Line -> pragmas on that line. A pragma only ever suppresses
+    findings anchored to its own line (inline discipline: the
+    suppression sits where the reviewer reads the flagged code)."""
+    out: Dict[int, List[Pragma]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        if "graft-lint" not in text:
+            continue
+        comment = text.split("#", 1)[1] if "#" in text else text
+        for m in _PRAGMA_RE.finditer(comment):
+            out.setdefault(i, []).append(
+                Pragma(m.group("rule"), m.group("reason").strip(), i)
+            )
+    return out
+
+
+def pragma_findings(path: str, source: str) -> List[Finding]:
+    """Malformed pragmas are findings themselves: an unknown rule id or
+    a missing reason must fail loudly, or typos become silent
+    unsuppressed noise and reasonless suppressions rot."""
+    out = []
+    for line, pragmas in collect_pragmas(source).items():
+        for p in pragmas:
+            if p.rule not in KNOWN_RULES:
+                out.append(Finding(
+                    "bad-pragma", path, line,
+                    f"pragma allows unknown rule {p.rule!r} "
+                    f"(known: {', '.join(KNOWN_RULES)})",
+                    snippet=f"allow[{p.rule}]",
+                ))
+            elif not p.reason:
+                out.append(Finding(
+                    "bad-pragma", path, line,
+                    f"pragma allow[{p.rule}] carries no reason — a "
+                    "suppression must say why the finding is intended",
+                    snippet=f"allow[{p.rule}] @L{line}",
+                ))
+    return out
+
+
+def apply_pragmas(
+    findings: List[Finding], pragmas: Dict[int, List[Pragma]]
+) -> List[Finding]:
+    """Mark findings suppressed by a well-formed same-line pragma."""
+    for f in findings:
+        for p in pragmas.get(f.line, []):
+            if p.rule == f.rule and p.reason and p.rule in KNOWN_RULES:
+                f.suppressed_by = p.reason
+    return findings
+
+
+@dataclass
+class Module:
+    """A parsed python file plus its import-alias map."""
+
+    path: str  # repo-relative, forward slashes
+    source: str
+    tree: ast.AST
+    aliases: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def lines(self) -> List[str]:
+        return self.source.splitlines()
+
+    def line_at(self, lineno: int) -> str:
+        ls = self.lines
+        return ls[lineno - 1] if 1 <= lineno <= len(ls) else ""
+
+
+def parse_module(path: str, source: str) -> Optional[Module]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return Module(path=path, source=source, tree=tree, aliases=aliases)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Name/Attribute chain -> raw dotted string ('self.params',
+    'jnp.asarray'); None for anything else (calls, subscripts...)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve(module: Module, node: ast.AST) -> Optional[str]:
+    """Dotted chain with its root import-alias expanded to the
+    canonical module path: `jnp.asarray` -> `jax.numpy.asarray`,
+    `scan` (from jax.lax import scan) -> `jax.lax.scan`."""
+    raw = dotted(node)
+    if raw is None:
+        return None
+    root, _, rest = raw.partition(".")
+    canon_root = module.aliases.get(root, root)
+    return f"{canon_root}.{rest}" if rest else canon_root
+
+
+def iter_python_files(
+    root: str, subdirs: Iterable[str] = ("trlx_tpu", "scripts", "examples")
+) -> List[Tuple[str, str]]:
+    """(repo-relative path, absolute path) for every lintable .py file.
+
+    Deliberately out of scope: tests (they hold known-bad fixture
+    snippets) and this analysis package itself (its checker sources
+    quote the very patterns they detect — rule tables, message strings
+    — and would self-flag)."""
+    out = []
+    top = [f for f in os.listdir(root) if f.endswith(".py")]
+    for f in sorted(top):
+        out.append((f, os.path.join(root, f)))
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            if os.path.basename(dirpath) == "trlx_tpu" and sub == "trlx_tpu":
+                dirnames[:] = [d for d in dirnames if d != "analysis"]
+            for f in sorted(filenames):
+                if not f.endswith(".py"):
+                    continue
+                ap = os.path.join(dirpath, f)
+                rp = os.path.relpath(ap, root).replace(os.sep, "/")
+                out.append((rp, ap))
+    return out
+
+
+def read_source(abs_path: str) -> str:
+    with open(abs_path, encoding="utf-8") as f:
+        return f.read()

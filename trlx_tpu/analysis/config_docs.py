@@ -1,0 +1,268 @@
+"""Config<->docs drift checker (rule ``config-docs``).
+
+Every dataclass field reachable from ``TRLConfig`` (the six sections
+plus every registered method config) must be:
+
+* mentioned in ``docs/api.md`` (word match — the doc owes the field at
+  least a sentence), and
+* annotated in ``configs/test_config.yml`` ("every config field,
+  annotated" is that file's contract; commented annotation lines
+  count, they are how default-off subsections document themselves),
+
+and vice versa — no phantoms:
+
+* every *actual* (uncommented) key in test_config.yml must be a known
+  field of its section (keys nested under a dict-typed field are that
+  subsystem's own schema and out of scope here), and
+* every backticked dotted reference in api.md whose prefix names a
+  section (``train.foo``, ``model.bar``, ``ppo.baz`` ...) must resolve
+  to a real field.
+
+AST-only on the config modules; no trlx_tpu import.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from trlx_tpu.analysis.common import Finding
+
+CONFIG_MODULES = (
+    "trlx_tpu/data/configs.py",
+    "trlx_tpu/data/method_configs.py",
+)
+DOCS_PATH = "docs/api.md"
+YML_PATH = "configs/test_config.yml"
+
+# doc-reference prefixes -> section key ('method:<Class>' selects one
+# method config; bare 'method' means any registered method's field)
+_METHOD_ALIAS_RE = re.compile(r"^(\w+)Config$")
+
+
+@dataclass
+class FieldInfo:
+    name: str
+    cls: str
+    section: str
+    file: str
+    line: int
+    is_dict: bool  # Dict/dict/Any-typed: nested keys are free-form
+
+
+def _annotation_is_dict(node) -> bool:
+    src = ast.dump(node)
+    return any(k in src for k in ("'Dict'", "'dict'", "'Any'"))
+
+
+def _is_dataclass(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = getattr(target, "id", getattr(target, "attr", ""))
+        if name == "dataclass":
+            return True
+    return False
+
+
+def collect_fields(
+    repo: str, config_modules: Tuple[str, ...] = CONFIG_MODULES
+) -> Tuple[List[FieldInfo], Dict[str, List[str]]]:
+    """(all reachable fields, section -> class names). The section map
+    comes from the ``_SECTIONS`` literal in configs.py; every dataclass
+    in method_configs.py maps to the ``method`` section (the registry
+    makes them all reachable via ``method.name``)."""
+    fields: List[FieldInfo] = []
+    sections: Dict[str, List[str]] = {}
+    class_fields: Dict[str, List[Tuple[str, int, bool]]] = {}
+    cls_file: Dict[str, str] = {}
+    section_of_cls: Dict[str, str] = {}
+
+    for rel in config_modules:
+        path = os.path.join(repo, rel)
+        with open(path) as f:
+            tree = ast.parse(f.read())
+        is_methods = "method_configs" in rel
+        for node in tree.body:
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target] if isinstance(node, ast.AnnAssign)
+                else []
+            )
+            if getattr(node, "value", None) is not None and any(
+                isinstance(t, ast.Name) and t.id == "_SECTIONS"
+                for t in targets
+            ):
+                # (("model", ModelConfig), ...) — names are Name nodes
+                for el in getattr(node.value, "elts", []):
+                    if isinstance(el, ast.Tuple) and len(el.elts) == 2:
+                        key = getattr(el.elts[0], "value", None)
+                        cls = getattr(el.elts[1], "id", None)
+                        if key and cls:
+                            sections.setdefault(key, []).append(cls)
+                            section_of_cls[cls] = key
+            if not (isinstance(node, ast.ClassDef) and _is_dataclass(node)):
+                continue
+            rows = []
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    rows.append((
+                        stmt.target.id, stmt.lineno,
+                        _annotation_is_dict(stmt.annotation),
+                    ))
+            class_fields[node.name] = rows
+            cls_file[node.name] = rel
+            if is_methods:
+                sections.setdefault("method", []).append(node.name)
+                section_of_cls[node.name] = "method"
+
+    for cls, rows in class_fields.items():
+        section = section_of_cls.get(cls)
+        if section is None:
+            continue  # TRLConfig itself: its fields ARE the sections
+        for name, line, is_dict in rows:
+            fields.append(FieldInfo(
+                name=name, cls=cls, section=section,
+                file=cls_file[cls], line=line, is_dict=is_dict,
+            ))
+    return fields, sections
+
+
+def _doc_prefixes(sections: Dict[str, List[str]]) -> Dict[str, List[str]]:
+    """'train' -> [TrainConfig], 'ppo' -> [PPOConfig], ..."""
+    out = {k: list(v) for k, v in sections.items()}
+    for cls in sections.get("method", []):
+        m = _METHOD_ALIAS_RE.match(cls)
+        if m and m.group(1).lower() != "method":
+            out[m.group(1).lower()] = [cls]
+    return out
+
+
+_BACKTICK_RE = re.compile(r"`([A-Za-z_][\w.]*(?:\.\*)?)`")
+_YML_KEY_RE = re.compile(r"(?<![\w.]){name}\s*:")
+
+
+def check(
+    repo: str,
+    config_modules: Tuple[str, ...] = CONFIG_MODULES,
+    docs_path: str = DOCS_PATH,
+    yml_path: str = YML_PATH,
+) -> List[Finding]:
+    import yaml
+
+    findings: List[Finding] = []
+    try:
+        fields, sections = collect_fields(repo, config_modules)
+    except (OSError, SyntaxError) as e:
+        return [Finding("config-docs", config_modules[0], 1,
+                        f"cannot parse config modules: {e}")]
+    try:
+        with open(os.path.join(repo, docs_path)) as f:
+            docs = f.read()
+        with open(os.path.join(repo, yml_path)) as f:
+            yml_text = f.read()
+    except OSError as e:
+        return [Finding("config-docs", docs_path, 1, f"unreadable: {e}")]
+
+    # the dict-subkey exemption is structural: only depth-1 yml keys
+    # are checked below, and everything deeper sits under a dict-typed
+    # field by construction of the config schema
+    by_section: Dict[str, set] = {}
+    for fi in fields:
+        by_section.setdefault(fi.section, set()).add(fi.name)
+
+    # --- direction 1: every field documented + annotated -------------
+    for fi in fields:
+        # plain word boundary: a dotted mention (`train.batch_size`)
+        # counts as documentation of the field
+        word = re.compile(rf"(?<!\w){re.escape(fi.name)}(?!\w)")
+        if not word.search(docs):
+            findings.append(Finding(
+                "config-docs", fi.file, fi.line,
+                f"{fi.cls}.{fi.name} (section `{fi.section}`) is not "
+                f"mentioned anywhere in {docs_path} — document it "
+                "(or drop the field)",
+                snippet=f"{fi.cls}.{fi.name} undocumented",
+            ))
+        if not re.search(
+            _YML_KEY_RE.pattern.format(name=re.escape(fi.name)), yml_text
+        ):
+            findings.append(Finding(
+                "config-docs", fi.file, fi.line,
+                f"{fi.cls}.{fi.name} (section `{fi.section}`) is not "
+                f"annotated in {yml_path} — that file's contract is "
+                "'every config field, annotated' (a commented "
+                "annotation line counts)",
+                snippet=f"{fi.cls}.{fi.name} unannotated",
+            ))
+
+    # --- direction 2a: no phantom yml keys ---------------------------
+    try:
+        data = yaml.safe_load(yml_text) or {}
+    except yaml.YAMLError as e:
+        return findings + [
+            Finding("config-docs", yml_path, 1, f"unparseable YAML: {e}")
+        ]
+    yml_lines = yml_text.splitlines()
+
+    def line_of(key: str) -> int:
+        pat = re.compile(rf"^\s*{re.escape(key)}\s*:")
+        for i, text in enumerate(yml_lines, start=1):
+            if pat.match(text):
+                return i
+        return 1
+
+    for section, content in (data.items() if isinstance(data, dict) else ()):
+        known = by_section.get(section)
+        if known is None:
+            findings.append(Finding(
+                "config-docs", yml_path, line_of(section),
+                f"unknown config section {section!r} (known: "
+                f"{sorted(by_section)})",
+                snippet=f"section {section}",
+            ))
+            continue
+        if not isinstance(content, dict):
+            continue
+        for key in content:
+            if key not in known:
+                findings.append(Finding(
+                    "config-docs", yml_path, line_of(key),
+                    f"{section}.{key} is annotated in {yml_path} but "
+                    "no reachable config dataclass has that field — "
+                    "phantom annotation (stale rename?)",
+                    snippet=f"phantom yml key {section}.{key}",
+                ))
+
+    # --- direction 2b: no phantom doc references ---------------------
+    prefixes = _doc_prefixes(sections)
+    for i, text in enumerate(docs.splitlines(), start=1):
+        for m in _BACKTICK_RE.finditer(text):
+            parts = m.group(1).split(".")
+            if len(parts) < 2 or parts[-1] == "py":
+                continue  # `ppo.py`-style file references, not config paths
+            head, field = parts[0], parts[1]
+            if head == "method" and field in prefixes and len(parts) > 2:
+                # `method.grpo.*` — method-alias hop, resolve the rest
+                head, field = field, parts[2]
+            if head not in prefixes or field in ("*",):
+                continue
+            classes = prefixes[head]
+            known = set()
+            for cls in classes:
+                known |= {
+                    fi.name for fi in fields if fi.cls == cls
+                }
+            if field not in known:
+                findings.append(Finding(
+                    "config-docs", docs_path, i,
+                    f"`{m.group(1)}` in {docs_path} references a field "
+                    f"`{field}` that no {'/'.join(classes)} dataclass "
+                    "has — phantom documentation (stale rename?)",
+                    snippet=f"phantom doc ref {m.group(1)}",
+                ))
+    return findings

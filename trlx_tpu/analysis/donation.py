@@ -1,0 +1,422 @@
+"""Donation-safety checker (rule ``donation``).
+
+The PR 3 heap-corruption class: a buffer handed to a jit compiled with
+``donate_argnums``/``donate_argnames`` is dead the moment the call is
+dispatched — XLA may reuse its memory for the outputs. Reading the old
+binding afterwards (before it is reassigned) reads freed storage:
+orbax-restored params fed to the donating train step and then consumed
+again was exactly that bug.
+
+This checker:
+
+1. finds every donating jit site — ``jax.jit(f, donate_argnums=...)`` /
+   ``pjit`` calls and ``@partial(jax.jit, donate_argnums=...)``
+   decorators with a non-empty donation spec;
+2. resolves donating *callables*: names/attributes bound to a donating
+   jit (``self._train_step = jax.jit(...)``), functions decorated
+   donating, and — one level of indirection — names bound to a call of
+   a function that *returns* a donating jit (the repo's
+   ``make_train_step()`` factory idiom; the factory registry is shared
+   across modules so ``trainer.make_train_step()`` resolves from any
+   file);
+3. at each call site of a donating callable, takes the caller bindings
+   passed in donated positions and flags any read of those bindings
+   after the call, before reassignment, within the enclosing function.
+
+The dataflow is a straight-line, source-order approximation: a read
+textually *before* the call inside the same loop body is out of scope
+(documented limitation, docs/static_analysis.md). Metadata-only
+attribute reads (``.is_deleted``, ``.sharding``, ``.shape``,
+``.dtype``, ``.ndim``, ``.aval``) are not buffer reads and are
+whitelisted — the memory doctor legitimately probes ``is_deleted`` on
+possibly-donated trees.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from trlx_tpu.analysis.common import Finding, Module, dotted, resolve
+
+JIT_FNS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+PARTIAL_FNS = {"functools.partial", "partial"}
+
+METADATA_ATTRS = {"is_deleted", "sharding", "shape", "dtype", "ndim", "aval"}
+
+# store events sort after every load on their own statement's last line
+_END_OF_LINE = 1 << 20
+
+
+def _donated_indices(
+    module: Module, call: ast.Call, fdef=None
+) -> Optional[Tuple[int, ...]]:
+    """Donated indices of a jax.jit/pjit call in the jitted FUNCTION's
+    own parameter space, or None when the call donates nothing (or the
+    spec is not statically constant — conservatively treated as
+    non-donating, noted in the docs).
+
+    ``fdef`` pins the jitted function when the caller already knows it
+    (the decorator form, where ``call.args[0]`` is ``jax.jit`` itself,
+    not the function). argnames resolve against the function's params;
+    for the *call* form ``jax.jit(self._step, ...)`` they are shifted
+    past ``self`` here because bound-method call sites never pass it —
+    the decorator path applies that shift itself, uniformly with
+    argnums."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return None
+            if isinstance(val, int):
+                return (val,)
+            if isinstance(val, (tuple, list)) and val:
+                return tuple(int(v) for v in val)
+            return None
+        if kw.arg == "donate_argnames":
+            try:
+                names = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return None
+            if isinstance(names, str):
+                names = (names,)
+            bound_call_form = fdef is None
+            if fdef is None:
+                fdef = _local_function_def(
+                    module, call.args[0] if call.args else None
+                )
+            if fdef is None or not names:
+                return None
+            params = [a.arg for a in fdef.args.args]
+            shift = (
+                1 if bound_call_form and params[:1] == ["self"] else 0
+            )
+            idx = tuple(
+                params.index(n) - shift for n in names if n in params
+            )
+            return idx or None
+    return None
+
+
+def _local_function_def(module: Module, node) -> Optional[ast.FunctionDef]:
+    name = None
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    if name is None:
+        return None
+    for n in ast.walk(module.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n.name == name:
+            return n
+    return None
+
+
+def _is_jit(module: Module, node) -> bool:
+    if not isinstance(node, (ast.Name, ast.Attribute)):
+        return False
+    if resolve(module, node) in JIT_FNS:
+        return True
+    return (dotted(node) or "").split(".")[-1] in ("jit", "pjit")
+
+
+def _donating_jit_call(
+    module: Module, node, fdef=None
+) -> Optional[Tuple[int, ...]]:
+    """Donated indices when ``node`` is a donating jax.jit/pjit(...) or
+    partial(jax.jit, ...) call expression; None otherwise. ``fdef``
+    names the decorated function in the decorator form (where the
+    jitted function is not among the call's args)."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if (
+        isinstance(fn, (ast.Name, ast.Attribute))
+        and resolve(module, fn) in PARTIAL_FNS
+        and node.args
+        and _is_jit(module, node.args[0])
+    ):
+        return _donated_indices(module, node, fdef)
+    if _is_jit(module, fn):
+        return _donated_indices(module, node, fdef)
+    return None
+
+
+def _donated_names(module: Module, call: ast.Call, fdef=None) -> Tuple[str, ...]:
+    """Donated parameter NAMES of this jit call, when resolvable —
+    call sites may pass donated buffers by keyword, and positional
+    indices alone cannot see those."""
+    argnames: Tuple[str, ...] = ()
+    argnums: Tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnames", "donate_argnums"):
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return ()
+            if kw.arg == "donate_argnames":
+                argnames = (val,) if isinstance(val, str) else tuple(val)
+            else:
+                argnums = (val,) if isinstance(val, int) else tuple(val)
+    if argnames:
+        return argnames
+    if not argnums:
+        return ()
+    f = fdef or _local_function_def(
+        module, call.args[0] if call.args else None
+    )
+    if f is None:
+        return ()
+    params = [a.arg for a in f.args.args]
+    # the call form jits a BOUND method: argnums index past `self`
+    shift = 1 if fdef is None and params[:1] == ["self"] else 0
+    return tuple(
+        params[i + shift] for i in argnums if i + shift < len(params)
+    )
+
+
+def collect_factories(module: Module) -> Dict[str, Tuple]:
+    """Function name -> (donated indices, donated param names), for
+    every function in this module that returns a donating jit (the
+    make_train_step idiom)."""
+    out: Dict[str, Tuple] = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                idx = _donating_jit_call(module, sub.value)
+                if idx:
+                    out[node.name] = (
+                        idx, _donated_names(module, sub.value)
+                    )
+    return out
+
+
+@dataclass
+class _Callable:
+    key: str  # dotted binding ('step', 'self._fused_train_step') or def name
+    indices: Tuple[int, ...]
+    line: int
+    names: Tuple[str, ...] = ()  # donated params, for keyword call sites
+
+
+def _collect_donating_callables(
+    module: Module, factories: Dict[str, Tuple]
+) -> List[_Callable]:
+    out: List[_Callable] = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # @partial(jax.jit, donate_argnums=...): argnums index the
+            # function's own params, so bound-method call sites see
+            # them shifted past `self`
+            for dec in node.decorator_list:
+                idx = _donating_jit_call(module, dec, fdef=node)
+                if idx:
+                    params = [a.arg for a in node.args.args]
+                    shift = 1 if params[:1] == ["self"] else 0
+                    call_idx = tuple(i - shift for i in idx if i - shift >= 0)
+                    if call_idx:
+                        out.append(_Callable(
+                            node.name, call_idx, node.lineno,
+                            _donated_names(module, dec, fdef=node),
+                        ))
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            idx = _donating_jit_call(module, node.value)
+            names: Tuple[str, ...] = ()
+            if idx is not None:
+                names = _donated_names(module, node.value)
+            else:
+                fn = node.value.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None
+                )
+                if fname in factories:
+                    idx, names = factories[fname]
+            if idx:
+                for tgt in node.targets:
+                    key = dotted(tgt)
+                    if key:
+                        out.append(_Callable(key, idx, node.lineno, names))
+    return out
+
+
+class _ScopeIndex(ast.NodeVisitor):
+    """Map every node to its innermost enclosing function."""
+
+    def __init__(self):
+        self.scope_of: Dict[ast.AST, ast.AST] = {}
+        self._stack: List[ast.AST] = []
+
+    def generic_visit(self, node):
+        self.scope_of[node] = self._stack[-1] if self._stack else None
+        is_fn = isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        )
+        if is_fn:
+            self._stack.append(node)
+        super().generic_visit(node)
+        if is_fn:
+            self._stack.pop()
+
+
+def _flat_targets(target) -> List[ast.AST]:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for el in target.elts:
+            out.extend(_flat_targets(el))
+        return out
+    return [target]
+
+
+def _binding_events(scope: ast.AST, key: str):
+    """Sorted ((line, col), 'load'|'store', node) events for ``key``
+    inside ``scope``. Store positions use the end of the enclosing
+    statement: the value (possibly the donating call) is fully
+    evaluated before the binding lands."""
+    events = []
+
+    def load(node):
+        events.append(((node.lineno, node.col_offset), "load", node))
+
+    def store(stmt):
+        events.append(((stmt.end_lineno, _END_OF_LINE), "store", stmt))
+
+    def visit_expr(node):
+        # maximal dotted chains are handled whole, so `x.sharding`
+        # consults the metadata whitelist exactly once
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            d = dotted(node)
+            if d is not None:
+                if d == key:
+                    if isinstance(getattr(node, "ctx", None), ast.Load):
+                        load(node)
+                elif d.startswith(key + "."):
+                    hop = d[len(key) + 1:].split(".")[0]
+                    if hop not in METADATA_ATTRS:
+                        load(node)
+                return
+        for ch in ast.iter_child_nodes(node):
+            if isinstance(ch, ast.expr):
+                visit_expr(ch)
+
+    def visit_stmt(node):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in targets:
+                for el in _flat_targets(tgt):
+                    d = dotted(el)
+                    if d == key:
+                        store(node)
+                    else:
+                        # x[i] = v / x.attr = v reads x's buffer;
+                        # also catches loads in subscript indices
+                        visit_expr(el)
+            if isinstance(node, ast.AugAssign) and dotted(node.target) == key:
+                load(node)  # x += ... reads the old buffer first
+            if node.value is not None:
+                visit_expr(node.value)
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if dotted(tgt) == key:
+                    store(node)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            for el in _flat_targets(node.target):
+                if dotted(el) == key:
+                    events.append(
+                        ((node.lineno, node.col_offset), "store", node)
+                    )
+            visit_expr(node.iter)
+            for ch in node.body + node.orelse:
+                visit_stmt(ch)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                visit_expr(item.context_expr)
+                if item.optional_vars is not None and (
+                    dotted(item.optional_vars) == key
+                ):
+                    events.append(
+                        ((node.lineno, node.col_offset), "store", node)
+                    )
+            for ch in node.body:
+                visit_stmt(ch)
+            return
+        for ch in ast.iter_child_nodes(node):
+            if isinstance(ch, ast.expr):
+                visit_expr(ch)
+            elif isinstance(ch, ast.stmt):
+                visit_stmt(ch)
+            else:  # handlers / match cases: recurse one level
+                for sub in ast.iter_child_nodes(ch):
+                    if isinstance(sub, ast.stmt):
+                        visit_stmt(sub)
+                    elif isinstance(sub, ast.expr):
+                        visit_expr(sub)
+
+    for stmt in scope.body if hasattr(scope, "body") else [scope]:
+        visit_stmt(stmt)
+    return sorted(events, key=lambda e: e[0])
+
+
+def check_module(
+    module: Module, factories: Optional[Dict[str, Tuple]] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    local_factories = collect_factories(module)
+    merged = dict(factories or {})
+    merged.update(local_factories)
+    callables = {
+        c.key: c for c in _collect_donating_callables(module, merged)
+    }
+
+    scopes = _ScopeIndex()
+    scopes.visit(module.tree)
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fkey = dotted(node.func)
+        cand = callables.get(fkey) if fkey else None
+        indices = cand.indices if cand else None
+        names = cand.names if cand else ()
+        if indices is None and isinstance(node.func, ast.Call):
+            # immediate invocation: jax.jit(f, donate...)(args)
+            indices = _donating_jit_call(module, node.func)
+            if indices:
+                names = _donated_names(module, node.func)
+        if not indices:
+            continue
+
+        donated_args = [
+            node.args[i] for i in indices if i < len(node.args)
+        ] + [
+            kw.value for kw in node.keywords if kw.arg in names
+        ]
+        scope = scopes.scope_of.get(node) or module.tree
+        call_pos = (node.end_lineno, node.end_col_offset)
+        for i, arg in enumerate(donated_args):
+            arg_key = dotted(arg)
+            if arg_key is None:
+                continue  # expression args (copies, literals) own no binding
+            events = _binding_events(scope, arg_key)
+            post = [e for e in events if e[0] > call_pos]
+            if not post or post[0][1] != "load":
+                continue
+            pos = post[0][0]
+            findings.append(Finding(
+                "donation", module.path, pos[0],
+                f"`{arg_key}` is donated to `{fkey or 'a jitted fn'}` "
+                f"at line {node.lineno} (donate arg {i}) and read again "
+                "here before reassignment — the buffer may already be "
+                "reused by XLA (the PR 3 bug class); reassign it from "
+                "the call's outputs or pass a copy",
+                snippet=module.line_at(pos[0]),
+            ))
+    return findings

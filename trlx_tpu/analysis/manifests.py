@@ -1,0 +1,232 @@
+"""RNG-stream-discipline checker (rule ``rng-manifest``).
+
+Two registries carry order/set contracts that every PR since PR 5 has
+hand-checked in review:
+
+* ``utils/chaos.py FAULT_SITES`` — the tuple ORDER keys each site's
+  per-site RNG stream (``seed * 1_000_003 + index``): inserting,
+  reordering or deleting a site silently shifts every later site's
+  draws and breaks recorded chaos schedules. The committed manifest
+  (``tests/golden/chaos_sites.json``) must be an exact PREFIX of the
+  live tuple — new sites append, nothing else moves.
+* ``utils/guardrails.py`` trip signals — the SET of signal strings
+  (``*_SIGNAL`` constants plus ``self._trip("<literal>", ...)`` sites)
+  is consumed by flight-recorder correlation, persisted trip tails and
+  operator runbooks: a deleted/renamed signal orphans recorded
+  histories. The committed manifest (``guardrail_signals.json``) must
+  equal the live set; additions are appended via
+  ``graft_lint.py --update-manifests``, deletions always fail (a real
+  deletion is a hand edit the reviewer must see).
+
+Extraction is AST-only so the check runs without importing trlx_tpu.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from trlx_tpu.analysis.common import Finding
+
+CHAOS_SOURCE = "trlx_tpu/utils/chaos.py"
+GUARDRAILS_SOURCE = "trlx_tpu/utils/guardrails.py"
+CHAOS_MANIFEST = "tests/golden/chaos_sites.json"
+GUARDRAIL_MANIFEST = "tests/golden/guardrail_signals.json"
+
+
+def extract_chaos_sites(source: str) -> Tuple[List[str], int]:
+    """(ordered FAULT_SITES entries, assignment line) from chaos.py."""
+    tree = ast.parse(source)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if any(
+            isinstance(t, ast.Name) and t.id == "FAULT_SITES"
+            for t in node.targets
+        ):
+            val = ast.literal_eval(node.value)
+            return [str(v) for v in val], node.lineno
+    raise ValueError("FAULT_SITES tuple not found")
+
+
+def extract_guardrail_signals(source: str) -> Tuple[List[str], Dict[str, int]]:
+    """(sorted signal names, name -> first-seen line). Signals are the
+    module-level ``*_SIGNAL`` string constants plus every literal first
+    argument of a ``._trip("...")`` / ``.trip("...")`` call."""
+    tree = ast.parse(source)
+    lines: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id.endswith("_SIGNAL")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    lines.setdefault(node.value.value, node.lineno)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in ("_trip", "trip")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                lines.setdefault(node.args[0].value, node.lineno)
+    return sorted(lines), lines
+
+
+def _load_manifest(path: str) -> Optional[dict]:
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def check(repo: str) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # --- chaos sites: committed list must be a prefix of the live one
+    chaos_path = os.path.join(repo, CHAOS_SOURCE)
+    manifest_path = os.path.join(repo, CHAOS_MANIFEST)
+    try:
+        with open(chaos_path) as f:
+            live, line = extract_chaos_sites(f.read())
+    except (OSError, ValueError) as e:
+        return [Finding("rng-manifest", CHAOS_SOURCE, 1,
+                        f"cannot extract FAULT_SITES: {e}")]
+    committed = _load_manifest(manifest_path)
+    if committed is None:
+        findings.append(Finding(
+            "rng-manifest", CHAOS_MANIFEST, 1,
+            f"missing manifest — run `python scripts/graft_lint.py "
+            "--update-manifests` to commit the current chaos-site order",
+            snippet="chaos_sites.json",
+        ))
+    else:
+        sites = committed.get("sites", [])
+        if live[:len(sites)] != sites:
+            # name the first divergence so the fix is obvious
+            i = next(
+                (k for k, (a, b) in enumerate(zip(sites, live)) if a != b),
+                min(len(sites), len(live)),
+            )
+            was = sites[i] if i < len(sites) else "<end>"
+            now = live[i] if i < len(live) else "<deleted>"
+            findings.append(Finding(
+                "rng-manifest", CHAOS_SOURCE, line,
+                "FAULT_SITES diverged from the committed manifest at "
+                f"index {i}: manifest has {was!r}, source has {now!r}. "
+                "The registry is APPEND-ONLY — each site's RNG stream "
+                "is keyed by its index, so inserts/reorders/deletes "
+                "silently shift every later site's draws. Move new "
+                "sites to the end; a genuine removal is a hand edit of "
+                f"{CHAOS_MANIFEST} the reviewer must see",
+                snippet=f"FAULT_SITES[{i}] {was!r} -> {now!r}",
+            ))
+        elif len(live) > len(sites):
+            extra = live[len(sites):]
+            findings.append(Finding(
+                "rng-manifest", CHAOS_SOURCE, line,
+                f"new chaos sites {extra} appended but not yet in the "
+                f"manifest — run `python scripts/graft_lint.py "
+                "--update-manifests` (append-only) and commit it",
+                snippet=f"unmanifested: {','.join(extra)}",
+            ))
+
+    # --- guardrail signals: committed set must equal the live set
+    guard_path = os.path.join(repo, GUARDRAILS_SOURCE)
+    gman_path = os.path.join(repo, GUARDRAIL_MANIFEST)
+    try:
+        with open(guard_path) as f:
+            signals, sig_lines = extract_guardrail_signals(f.read())
+    except (OSError, SyntaxError) as e:
+        return findings + [Finding("rng-manifest", GUARDRAILS_SOURCE, 1,
+                                   f"cannot extract signals: {e}")]
+    gman = _load_manifest(gman_path)
+    if gman is None:
+        findings.append(Finding(
+            "rng-manifest", GUARDRAIL_MANIFEST, 1,
+            "missing manifest — run `python scripts/graft_lint.py "
+            "--update-manifests` to commit the current signal set",
+            snippet="guardrail_signals.json",
+        ))
+        return findings
+    known = gman.get("signals", [])
+    removed = [s for s in known if s not in signals]
+    added = [s for s in signals if s not in known]
+    if removed:
+        findings.append(Finding(
+            "rng-manifest", GUARDRAILS_SOURCE, 1,
+            f"guardrail signal(s) {removed} deleted/renamed — recorded "
+            "trip histories, flight-recorder correlation and runbooks "
+            "reference them by name. A genuine removal is a hand edit "
+            f"of {GUARDRAIL_MANIFEST} the reviewer must see",
+            snippet=f"removed: {','.join(removed)}",
+        ))
+    for s in added:
+        findings.append(Finding(
+            "rng-manifest", GUARDRAILS_SOURCE, sig_lines.get(s, 1),
+            f"new guardrail signal {s!r} is not in the manifest — run "
+            "`python scripts/graft_lint.py --update-manifests` and "
+            "commit it (and document the signal in docs/robustness.md)",
+            snippet=f"unmanifested: {s}",
+        ))
+    return findings
+
+
+def update(repo: str) -> List[str]:
+    """Regenerate both manifests, append-only. Returns human-readable
+    notes; raises on a non-append chaos change (the one thing this
+    tool must never paper over)."""
+    notes = []
+    with open(os.path.join(repo, CHAOS_SOURCE)) as f:
+        live, _ = extract_chaos_sites(f.read())
+    cpath = os.path.join(repo, CHAOS_MANIFEST)
+    committed = _load_manifest(cpath)
+    if committed is not None:
+        sites = committed.get("sites", [])
+        if live[:len(sites)] != sites:
+            raise ValueError(
+                "refusing to update chaos_sites.json: the live "
+                "FAULT_SITES is not an append of the committed order "
+                "(inserts/reorders/deletes shift per-site RNG streams)."
+                " Fix the registry, or hand-edit the manifest if the "
+                "break is truly intended"
+            )
+    os.makedirs(os.path.dirname(cpath), exist_ok=True)
+    with open(cpath, "w") as f:
+        json.dump({
+            "source": CHAOS_SOURCE,
+            "discipline": "append-only (index keys each site's RNG stream)",
+            "sites": live,
+        }, f, indent=2)
+        f.write("\n")
+    notes.append(f"{CHAOS_MANIFEST}: {len(live)} sites")
+
+    with open(os.path.join(repo, GUARDRAILS_SOURCE)) as f:
+        signals, _ = extract_guardrail_signals(f.read())
+    gpath = os.path.join(repo, GUARDRAIL_MANIFEST)
+    gman = _load_manifest(gpath)
+    if gman is not None:
+        removed = [s for s in gman.get("signals", []) if s not in signals]
+        if removed:
+            raise ValueError(
+                f"refusing to update guardrail_signals.json: signal(s) "
+                f"{removed} would be deleted. Recorded trip histories "
+                "reference them; hand-edit the manifest if the removal "
+                "is truly intended"
+            )
+    with open(gpath, "w") as f:
+        json.dump({
+            "source": GUARDRAILS_SOURCE,
+            "discipline": "no deletes/renames; additions via --update-manifests",
+            "signals": signals,
+        }, f, indent=2)
+        f.write("\n")
+    notes.append(f"{GUARDRAIL_MANIFEST}: {len(signals)} signals")
+    return notes

@@ -1,0 +1,137 @@
+"""graft-lint orchestration: run every checker over a tree, apply
+pragmas, and serialize/compare baselines. Stdlib only."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from trlx_tpu.analysis import config_docs, donation, manifests, purity
+from trlx_tpu.analysis.common import (
+    Finding,
+    apply_pragmas,
+    collect_pragmas,
+    iter_python_files,
+    parse_module,
+    pragma_findings,
+    read_source,
+)
+
+BASELINE_VERSION = 1
+
+
+def lint_paths(
+    repo: str,
+    rel_paths: Sequence[str],
+    zones: Sequence[str] = purity.DEFAULT_ZONES,
+) -> List[Finding]:
+    """Donation + purity + sync-zone + pragma checks over specific
+    python files (repo-relative). Manifest and config-docs checks are
+    repo-level and live in :func:`run_repo`."""
+    modules = []
+    findings: List[Finding] = []
+    for rel in rel_paths:
+        abs_path = os.path.join(repo, rel)
+        try:
+            source = read_source(abs_path)
+        except OSError as e:
+            findings.append(
+                Finding("lint-error", rel, 1, f"unreadable: {e}",
+                        snippet=f"unreadable {rel}")
+            )
+            continue
+        mod = parse_module(rel, source)
+        if mod is None:
+            # tier-1 flake8 owns syntax errors; unparseable files are
+            # simply out of lint scope
+            continue
+        modules.append(mod)
+
+    # donation factories (make_train_step & co) resolve cross-module
+    factories: Dict[str, tuple] = {}
+    for mod in modules:
+        factories.update(donation.collect_factories(mod))
+
+    for mod in modules:
+        per_file: List[Finding] = []
+        per_file += donation.check_module(mod, factories)
+        per_file += purity.check_module(mod, zones)
+        per_file += pragma_findings(mod.path, mod.source)
+        apply_pragmas(per_file, collect_pragmas(mod.source))
+        findings += per_file
+    return findings
+
+
+def run_repo(
+    repo: str,
+    paths: Optional[Sequence[str]] = None,
+    zones: Sequence[str] = purity.DEFAULT_ZONES,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Full lint. ``paths`` restricts the per-file checkers (the
+    repo-level manifest/config-docs checks still run unless filtered
+    out via ``rules``)."""
+    explicit_paths = paths is not None
+    if paths is None:
+        paths = [rel for rel, _ in iter_python_files(repo)]
+    findings = lint_paths(repo, paths, zones)
+    # repo-level checks are skipped when the caller pinned explicit
+    # files (the CLI's fixture mode: lint THIS snippet)
+    if not explicit_paths:
+        repo_level: List[Finding] = []
+        repo_level += manifests.check(repo)
+        try:
+            repo_level += config_docs.check(repo)
+        except ImportError:
+            # pyyaml missing: the config<->yml check needs it; the
+            # environment always has it in CI (tier-1 imports yaml)
+            repo_level.append(Finding(
+                "config-docs", config_docs.YML_PATH, 1,
+                "pyyaml unavailable — config<->docs check skipped",
+            ))
+        for f in repo_level:
+            abs_path = os.path.join(repo, f.file)
+            if os.path.isfile(abs_path):
+                try:
+                    apply_pragmas([f], collect_pragmas(read_source(abs_path)))
+                except OSError:
+                    pass
+        findings += repo_level
+    if rules:
+        # lint-error (an unreadable/typo'd path) must never be
+        # filterable into a silent clean exit
+        findings = [
+            f for f in findings if f.rule in rules or f.rule == "lint-error"
+        ]
+    return findings
+
+
+def active(findings: Sequence[Finding]) -> List[Finding]:
+    return [f for f in findings if f.suppressed_by is None]
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Machine-readable findings snapshot for ``--diff`` (future PRs
+    get incremental signal: only NEW findings fail)."""
+    payload = {
+        "version": BASELINE_VERSION,
+        "findings": [f.to_dict() for f in active(findings)],
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+def diff_against(path: str, findings: Sequence[Finding]) -> List[Finding]:
+    """Findings not present in the baseline (matched by stable key:
+    rule + file + flagged source text, line-number independent)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {payload.get('version')!r}, "
+            f"expected {BASELINE_VERSION} — regenerate with --baseline"
+        )
+    known = {row["key"] for row in payload.get("findings", [])}
+    return [f for f in active(findings) if f.key not in known]

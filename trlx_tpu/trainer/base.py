@@ -1445,6 +1445,18 @@ class TPUBaseTrainer(BaseRLTrainer):
                 return results, False
 
         full, n = fused_src
+        ways = self.local_ways()
+        if n % ways:
+            # a short final rollout chunk (prompt set smaller than
+            # chunk_size) leaves the store with a row count that does
+            # not divide this process's shard count, and device_put
+            # rejects uneven batch sharding. Pad rows by tiling modulo
+            # n: the perms below only ever index [0, n), so pad rows
+            # never train and never touch the running moments — this
+            # is placement geometry, not data.
+            pad_to = -(-n // ways) * ways
+            idx = np.arange(pad_to) % n
+            full = jax.tree_util.tree_map(lambda x: x[idx], full)
         bs = self.config.train.batch_size
         n_batches = max(n // bs, 1)
         steps_left = max(self.total_steps - self.iter_count, 1)
