@@ -1369,6 +1369,190 @@ def _smoke_obs() -> dict:
     }
 
 
+# -- serving tier (train.serve.*) ---------------------------------------
+
+
+def _serve_tiny_config(ckpt_dir: str, serve=None, chaos=None, steps=3):
+    """Tiny-PPO config for the serving legs: the serving frontend on a
+    CPU-sized model, shared-fs transport under the checkpoint dir."""
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    train = dict(
+        batch_size=8, total_steps=steps, eval_interval=100,
+        checkpoint_interval=100, seq_length=24, epochs=64,
+        tracker="jsonl", checkpoint_dir=ckpt_dir, save_best=False,
+        serve=dict(serve or {}),
+    )
+    if chaos is not None:
+        train["chaos"] = chaos
+    return default_ppo_config().evolve(
+        train=train,
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    vocab_size=258, hidden_size=32, n_layer=2, n_head=2,
+                    n_positions=64,
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                            do_sample=True),
+        ),
+    )
+
+
+_SERVE_TINY = dict(
+    enabled=True, max_batch=4, page_size=8, max_prompt_len=32,
+    max_new_tokens=8, default_max_tokens=6, pool_pages=64,
+)
+
+
+def _serve_load_run(tag: str, serve=None, chaos=None, steps=3, load=True,
+                    client_fn=None):
+    """One tiny learn() with (optionally) a background client thread
+    generating mixed serve traffic — shared prefix, a two-turn session,
+    plain requests. Returns (trainer, loss/reward stream, results,
+    wall_s)."""
+    import shutil
+    import threading
+
+    import trlx_tpu
+
+    ckpt_dir = os.path.join("/tmp", f"serve_bench_{tag}")
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    config = _serve_tiny_config(ckpt_dir, serve=serve, chaos=chaos,
+                                steps=steps)
+    results: list = []
+    threads = []
+    if load:
+        spec = {"backend": "shared_fs", "root": os.path.join(ckpt_dir,
+                                                             "serve")}
+
+        def default_client():
+            from trlx_tpu.serve.client import ServeClient
+
+            c = ServeClient(spec)
+            prefix = list(range(50, 66))  # 2 pages @ page_size 8
+            r0 = c.submit([100, 101, 102], max_tokens=6, deadline_s=240.0,
+                          prefix_ids=prefix, rid="load0")
+            results.append(c.result(r0, timeout_s=300.0))
+            rids = [
+                c.submit([110 + i], max_tokens=6, deadline_s=240.0,
+                         prefix_ids=prefix, rid=f"load{i + 1}")
+                for i in range(2)
+            ]
+            for rid in rids:
+                results.append(c.result(rid, timeout_s=300.0))
+            s1 = c.submit(list(range(120, 129)), max_tokens=6,
+                          deadline_s=240.0, session_id="bench",
+                          rid="sess1")
+            results.append(c.result(s1, timeout_s=300.0))
+            s2 = c.submit([60], max_tokens=4, deadline_s=240.0,
+                          session_id="bench", rid="sess2")
+            results.append(c.result(s2, timeout_s=300.0))
+
+        body = (
+            (lambda: client_fn(spec, results)) if client_fn is not None
+            else default_client
+        )
+        t = threading.Thread(target=body, daemon=True)
+        t.start()
+        threads.append(t)
+
+    t0 = time.time()
+    trainer = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=["hello world", "the cat", "a b", "xyz",
+                 "what is", "I am", "go", "ok"],
+        config=config,
+    )
+    wall = time.time() - t0
+    for t in threads:
+        t.join(timeout=60)
+    with open(os.path.join(ckpt_dir, "logs", "metrics.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    stream = [
+        {k: v for k, v in r.items()
+         if k.startswith("losses/") or k == "reward/mean"}
+        for r in recs
+    ]
+    return trainer, [s for s in stream if s], results, wall
+
+
+def _smoke_serve() -> dict:
+    """Serving leg of ``bench.py --smoke``: one tiny PPO learn() with a
+    background serve load (shared prefix + a two-turn session) on the
+    shared-fs transport. Asserts every request completes within its
+    deadline with prefix/session page reuse, and reports the serve SLO
+    ledger — TTFT / per-token decode percentiles — plus training
+    samples/s under the mixed load."""
+    trainer, _stream, results, wall = _serve_load_run(
+        "smoke", serve=_SERVE_TINY, steps=5
+    )
+    assert len(results) == 5 and all(r is not None for r in results), (
+        f"serve smoke: missing results {results}"
+    )
+    bad = [r.rid for r in results if r.status != "ok"]
+    assert not bad, f"serve smoke: non-ok results {bad}"
+    shared = [r for r in results if r.shared_pages > 0]
+    assert shared, "serve smoke: no request reused cached pages"
+    summary = trainer._serve_final_summary
+    assert summary["deadline_met_rate"] == 1.0, summary
+    samples = 8 * int(trainer.iter_count)
+    return {
+        "smoke_serve_requests": len(results),
+        "smoke_serve_shared_requests": len(shared),
+        "smoke_serve_ttft_p50_s": round(summary["ttft_p50_s"], 3),
+        "smoke_serve_ttft_p95_s": round(summary["ttft_p95_s"], 3),
+        "smoke_serve_queue_wait_p50_s": round(
+            summary["queue_wait_p50_s"], 4
+        ),
+        "smoke_serve_decode_tok_s_p50": round(
+            summary["decode_tok_s_p50"], 2
+        ),
+        "smoke_serve_deadline_met_rate": summary["deadline_met_rate"],
+        "smoke_serve_train_samples_per_sec": round(samples / wall, 3),
+        "smoke_serve_shared_page_hits": int(
+            summary["kv_shared_page_hits"]
+        ),
+    }
+
+
+def bench_serve() -> dict:
+    """Serving section of the full bench (``serve_*`` keys): the SLO
+    ledger under mixed train+serve load — TTFT / per-token decode
+    latency percentiles and training samples/s with a live request
+    stream. CPU containers run the tiny geometry; a TPU run's numbers
+    land in the trajectory via the usual ``bench.py --record``
+    discipline."""
+    _enable_compile_cache()
+    trainer, _stream, results, wall = _serve_load_run("section",
+                                                      serve=_SERVE_TINY,
+                                                      steps=5)
+    summary = trainer._serve_final_summary
+    ok = [r for r in results if r is not None and r.status == "ok"]
+    samples = 8 * int(trainer.iter_count)
+    return {
+        "serve_requests_completed": len(ok),
+        "serve_ttft_p50_s": round(summary.get("ttft_p50_s", 0.0), 3),
+        "serve_ttft_p95_s": round(summary.get("ttft_p95_s", 0.0), 3),
+        "serve_latency_p95_s": round(summary.get("latency_p95_s", 0.0), 3),
+        "serve_decode_tok_s_p50": round(
+            summary.get("decode_tok_s_p50", 0.0), 2
+        ),
+        "serve_deadline_met_rate": summary.get("deadline_met_rate", 0.0),
+        "serve_train_samples_per_sec_mixed": round(samples / wall, 3),
+        "serve_shared_page_hits": int(
+            summary.get("kv_shared_page_hits", 0)
+        ),
+        "serve_pinned_pages": int(summary.get("engine_pinned_pages", 0)),
+    }
+
+
 def bench_smoke() -> dict:
     """Dispatch-path perf smoke (`python bench.py --smoke`, also
     scripts/bench_smoke.py): ONE tiny PPO cycle run through BOTH train
@@ -1476,6 +1660,7 @@ def bench_smoke() -> dict:
         "smoke_last_loss_looped": round(last_loss, 6),
         **_smoke_engine(),
         **_smoke_obs(),
+        **_smoke_serve(),
     }
 
 
@@ -1651,11 +1836,16 @@ def bench_chaos() -> dict:
     # trips the `memory` signal, and preflight rejects an over-budget
     # config with an itemized report before any compile
     mem_leg = bench_chaos_memory()
+    # serving-tier leg: training-vs-serving bit-equal isolation, lane
+    # starvation + request-timeout deadline eviction (pinned session
+    # pages reclaimed), transport drop -> retry/dedup exactly-once
+    serve_leg = bench_chaos_serve()
     return {
         **stall,
         **exp_leg,
         **fleet_leg,
         **mem_leg,
+        **serve_leg,
         "chaos_completed_steps": int(trainer.iter_count),
         "chaos_rollbacks": int(trainer.guardrails.rollbacks),
         "chaos_actions": list(trainer.guardrails.actions_taken),
@@ -1971,6 +2161,95 @@ def bench_chaos_memory() -> dict:
         "memory_degrade_persisted": degrade,
         "memory_preflight_rejected": rejected,
         "memory_leg_wall_s": round(time.time() - t0, 1),
+    }
+
+
+def bench_chaos_serve() -> dict:
+    """Serving-tier chaos proof (part of ``bench.py --chaos``):
+
+    1. ISOLATION — a tiny PPO learn() under a background serve load
+       (shared prefix + two-turn session, shared-fs backend) must leave
+       the training loss/reward stream BIT-IDENTICAL to the no-serving
+       run on the same seed, while every request completes within its
+       deadline with page reuse.
+    2. CHAOS SCHEDULE — ``serve_lane_starvation`` (training saturates
+       the lanes: requests age, serving-starved ticks are counted),
+       ``serve_request_timeout`` (a request arriving already expired is
+       deadline-EVICTED with a timeout result), ``serve_transport_drop``
+       (a result frame lost on the wire is re-posted and dedup makes
+       delivery exactly-once), and an idle session whose deadline
+       passes must have its pinned pages RECLAIMED.
+    """
+    t0 = time.time()
+    base, stream_off, _, _ = _serve_load_run("iso_off", serve=None,
+                                             load=False, steps=5)
+    on, stream_on, results, _ = _serve_load_run("iso_on",
+                                                serve=_SERVE_TINY, steps=5)
+    assert stream_on == stream_off, (
+        "training loss stream DIVERGED under serving load:\n"
+        f"{stream_off}\n{stream_on}"
+    )
+    assert len(results) == 5 and all(
+        r is not None and r.status == "ok" for r in results
+    ), f"serve isolation leg: bad results {results}"
+    assert any(r.shared_pages > 0 for r in results)
+    iso_summary = on._serve_final_summary
+    assert iso_summary["deadline_met_rate"] == 1.0, iso_summary
+
+    def chaos_client(spec, results):
+        from trlx_tpu.serve.client import ServeClient
+
+        c = ServeClient(spec)
+        # names pin the intake (sort) order: the at=2 request_timeout
+        # consult lands on b_req
+        ra = c.submit([100, 101], max_tokens=4, deadline_s=240.0,
+                      rid="a_req")
+        rb = c.submit([105, 106], max_tokens=4, deadline_s=240.0,
+                      rid="b_req")
+        rs = c.submit(list(range(120, 129)), max_tokens=4,
+                      deadline_s=240.0, session_id="cs", rid="c_sess")
+        results.append(("a", c.result(ra, timeout_s=300.0)))
+        results.append(("b", c.result(rb, timeout_s=300.0)))
+        results.append(("s", c.result(rs, timeout_s=300.0)))
+
+    # session deadline far below the inter-tick gap of the warm tiny
+    # cycles, so the idle pin demonstrably expires DURING the run
+    serve_cfg = dict(_SERVE_TINY, session_deadline_s=0.05)
+    chaos = dict(
+        seed=0,
+        faults=[
+            {"fault": "serve_lane_starvation", "at": 1, "span": 2},
+            {"fault": "serve_request_timeout", "at": 2},
+            {"fault": "serve_transport_drop", "at": 1},
+        ],
+    )
+    trainer, _stream, chaos_results, _ = _serve_load_run(
+        "chaos", serve=serve_cfg, chaos=chaos, steps=4,
+        client_fn=chaos_client,
+    )
+    got = dict(chaos_results)
+    assert got["a"] is not None and got["a"].status == "ok", got["a"]
+    assert got["b"] is not None and got["b"].status == "timeout", got["b"]
+    assert got["s"] is not None and got["s"].status == "ok", got["s"]
+    s = trainer._serve_final_summary
+    assert s["serving_starved_ticks"] >= 1, s
+    assert s["deadline_evictions"] >= 1, s
+    assert s["transport_drops"] >= 1, s
+    # deadline eviction reclaims the idle session's pinned pages
+    assert s["kv_deadline_evicted_entries"] >= 1, s
+    assert s["kv_reclaimed_pages"] >= 1, s
+    return {
+        "serve_iso_bit_equal": True,
+        "serve_iso_shared_requests": sum(
+            1 for r in results if r.shared_pages > 0
+        ),
+        "serve_chaos_starved_ticks": int(s["serving_starved_ticks"]),
+        "serve_chaos_deadline_evictions": int(s["deadline_evictions"]),
+        "serve_chaos_session_pages_reclaimed": int(
+            s["kv_reclaimed_pages"]
+        ),
+        "serve_chaos_transport_drops": int(s["transport_drops"]),
+        "serve_leg_wall_s": round(time.time() - t0, 1),
     }
 
 
@@ -2612,6 +2891,9 @@ SECTIONS = [
     # configuration) — warm-cache sized; cold, the section self-trims
     # via its per-row try/except
     ("large_gen", "bench_large_gen", 170.0, "BENCH_LARGE_GEN"),
+    # serving tier: SLO ledger (TTFT / decode percentiles) + training
+    # samples/s under a live mixed request load
+    ("serve", "bench_serve", 90.0, "BENCH_SERVE"),
     ("longctx_gpt", "bench_longctx_gpt", 55.0, "BENCH_LONGCTX"),
     ("longctx_t5", "bench_longctx_t5", 55.0, "BENCH_LONGCTX"),
     ("longctx_attn", "bench_longctx_attn", 45.0, "BENCH_LONGCTX"),

@@ -38,6 +38,14 @@ without an abort; and a deliberately over-budget config must be
 REJECTED by preflight with an itemized per-phase HBM report before
 any rollout or compile is paid.
 
+And it proves the SERVING TIER (`train.serve`, trlx_tpu/serve/): a
+background serve load must leave the training loss stream BIT-IDENTICAL
+to the no-serving run; `serve_lane_starvation` ages requests into
+deadline eviction (with an idle pinned session's pages RECLAIMED),
+`serve_request_timeout` evicts an already-expired request with a
+`timeout` result, and `serve_transport_drop` message loss converges to
+exactly-once delivery via re-post + dedup.
+
 CPU-friendly (tiny random model, byte tokenizer, zero egress) — run it
 after touching guardrails / checkpointing / the rollout loop:
 `python scripts/chaos_smoke.py` (equivalently `python bench.py --chaos`).

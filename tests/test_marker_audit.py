@@ -27,7 +27,16 @@ import os
 TIER1_BUDGETS = {
     "test_chunked_loss.py": 10,
     "test_configs.py": 5,
-    "test_curves.py": 10,
+    # r14: serving-tier suite (ledger fuzz + engine warm-pool goldens +
+    # frontend units + ONE two-learn e2e) — measured ~45s serial on the
+    # r13 1-core container (2026-08-04; the 8-way box runs the learns
+    # faster). Paid under the unchanged 780 ceiling by trimming files
+    # measured FAST EVEN ON THIS SLOWER BOX (examples 0.3s, curves
+    # 0.08s, mcts 4.9s serial 2026-08-04) plus r07-measured slack
+    # (supervisor 8s) and the version-gated skip files (remat 0.3,
+    # multihost 0.05, properties 0.06, pipeline_parallel 4.9 measured
+    # 2026-08-03).
+    "test_curves.py": 3,
     "test_deferred_stats.py": 5,
     "test_dpo.py": 15,
     # r09 re-baseline: every touched-or-large budget re-measured
@@ -38,7 +47,7 @@ TIER1_BUDGETS = {
     # generation 11.5s, seq2seq 16.6s, remat 0.3s, models 16.2s
     # (raised 15->20), peft 13.9s, trainers 7.9s
     "test_elastic.py": 34,
-    "test_examples.py": 20,
+    "test_examples.py": 4,
     "test_exp_queue.py": 29,
     "test_fault_tolerance.py": 63,
     "test_flash_attention.py": 15,
@@ -58,7 +67,7 @@ TIER1_BUDGETS = {
     # nan/sigterm); whole file re-measured 99.9s serial
     "test_guardrails.py": 103,
     "test_marker_audit.py": 2,
-    "test_mcts_value_branch.py": 15,
+    "test_mcts_value_branch.py": 8,
     # r10: memory-doctor suite (ladder units are fake-clock-fast; the
     # cost is the split-grads golden + three tiny trainer builds) —
     # measured 32s serial on the idle 8-way CPU mesh (2026-08-03).
@@ -72,7 +81,7 @@ TIER1_BUDGETS = {
     # files' tier-1 portions are mostly version-gated skips/deselects —
     # multihost 0.05s, pipeline_parallel 4.9s, ring_attention 6.3s,
     # sharding 6.1s, properties 0.06s measured 2026-08-03
-    "test_multihost.py": 5,
+    "test_multihost.py": 2,
     # r11: flight-recorder suite (fake-clock units + ONE tiny learn()
     # integration) — measured ~20s serial on the 8-way CPU mesh
     # (2026-08-04). Paid for under the unchanged ceiling by trimming
@@ -84,18 +93,19 @@ TIER1_BUDGETS = {
     "test_obs.py": 25,
     "test_ops.py": 10,
     "test_peft.py": 14,
-    "test_pipeline_parallel.py": 10,
+    "test_pipeline_parallel.py": 7,
     "test_pipelines.py": 10,
-    "test_properties.py": 5,
+    "test_properties.py": 2,
     "test_reference_harness.py": 10,
-    "test_remat.py": 5,
+    "test_remat.py": 2,
     "test_resilient.py": 5,
     "test_ring_attention.py": 10,
     "test_scanned_epochs.py": 46,
     "test_seq2seq.py": 20,
+    "test_serve.py": 46,
     "test_sharding.py": 10,
     "test_summarize_eval.py": 5,
-    "test_supervisor.py": 15,
+    "test_supervisor.py": 11,
     "test_sweep.py": 15,
     "test_trainers.py": 10,
     "test_utils.py": 5,
@@ -129,6 +139,9 @@ LEARN_IN_TIER1_ALLOWLIST = {
     "test_fault_tolerance.py",  # kill/resume + chaos scenarios
     "test_guardrails.py",       # rollback/requeue under chaos
     "test_scanned_epochs.py",   # scanned-vs-looped golden equivalence
+    "test_serve.py",            # serving-vs-no-serving loss bit-equality
+                                # needs two tiny learns (the acceptance
+                                # criterion)
     "test_examples.py",         # example-surface smoke
     "test_sweep.py",            # sweep driver over tiny trials
     "test_curves.py",           # recorded-curve contract

@@ -575,8 +575,12 @@ def test_chaos_sites_appended_not_inserted():
     from trlx_tpu.utils.chaos import FAULT_SITES
 
     # appended AFTER every pre-existing site, so per-site RNG streams
-    # derived from the site index stay unshifted
-    assert FAULT_SITES[-3:] == ("oom_fused_block", "oom_prefill", "hbm_creep")
+    # derived from the site index stay unshifted. The invariant is the
+    # memory-doctor sites' absolute INDICES (18..20), not tail position
+    # — later subsystems (the serving tier) legally append after them.
+    assert FAULT_SITES[18:21] == (
+        "oom_fused_block", "oom_prefill", "hbm_creep"
+    )
 
 
 def test_engine_compaction_reclaims_pad_pages():

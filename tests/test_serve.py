@@ -1,0 +1,721 @@
+"""Serving-tier invariants (ISSUE 14 tentpole).
+
+The live-traffic tier must be correct on every axis it touches:
+
+  * refcounted paged KV — acquire/release/adopt/evict interleavings
+    never double-free a page, refcount-zero means on-the-free-stack,
+    and the pool is conserved (device half: paged_kv.release_refcounted;
+    host half: serve.kv.PageLedger),
+  * prefix reuse — decode over SHARED prefix pages is bit-equal to an
+    independent prefill of the same row,
+  * sessions — pinned pages carry a conversation across turns without
+    leaking pages or double-counting reclaims (the PR 10 compaction
+    counters),
+  * SLO scheduling — EDF admission, deadline eviction (pages
+    reclaimed), starvation reported rather than wedged,
+  * transport — the tcp backend is golden bit-equal to shared-fs, and
+    injected message loss converges to exactly-once via retry + dedup,
+  * end to end — a PPO learn() with the frontend enabled serves
+    mid-training requests within their deadlines, demonstrably reuses
+    pages, and leaves the training loss stream BIT-EQUAL to the
+    no-serving run (the acceptance criterion).
+
+Everything is CPU-sized (2-layer/16-hidden model, byte tokenizer for
+the e2e); perf claims live in bench.py's serve section.
+"""
+
+import json
+import os
+import random
+import shutil
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.gen_engine import EngineSpec, engine_generate
+from trlx_tpu.models.generation import SamplerSettings
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.ops import paged_kv
+from trlx_tpu.serve.config import ServeConfig
+from trlx_tpu.serve.frontend import ServeFrontend
+from trlx_tpu.serve.kv import PageLedger, aligned_len
+from trlx_tpu.serve.request import ServeRequest
+from trlx_tpu.serve.scheduler import SLOScheduler
+
+EOS, PAD = 7, 9
+PS, P, N, NP = 4, 16, 6, 48
+
+
+# -- device refcounts ---------------------------------------------------
+
+
+def test_release_refcounted_unit():
+    """Decrement semantics: unshared (count 0) pages free exactly like
+    push_free; shared pages decrement down to the cache hold and stay
+    off the stack; duplicates of a shared page in one release are safe."""
+    free, ntop = paged_kv.init_alloc(8)
+    refcnt = paged_kv.init_refcounts(8)
+    # pop three pages (7, 6, 5)
+    got, free, ntop = paged_kv.pop_pages(
+        free, ntop, jnp.asarray([True, True, True])
+    )
+    assert got.tolist() == [7, 6, 5]
+    # page 7 is shared by a cache entry + two rows -> count 3
+    refcnt = refcnt.at[7].set(3)
+    # both rows release page 7 in ONE event + row pages 6, 5 unshared
+    pages = jnp.asarray([7, 7, 6, 5])
+    real = jnp.asarray([True, True, True, True])
+    free, ntop, refcnt = paged_kv.release_refcounted(
+        free, ntop, refcnt, pages, real
+    )
+    assert int(refcnt[7]) == 1  # the cache hold survives
+    stack = np.asarray(free)[: int(ntop)].tolist()
+    assert 7 not in stack and 6 in stack and 5 in stack
+    # with all-zero refcounts the release IS push_free
+    free2, ntop2 = paged_kv.init_alloc(8)
+    g2, free2, ntop2 = paged_kv.pop_pages(
+        free2, ntop2, jnp.asarray([True, True])
+    )
+    a_free, a_ntop = paged_kv.push_free(free2, ntop2, g2, jnp.asarray([True, True]))
+    b_free, b_ntop, _ = paged_kv.release_refcounted(
+        free2, ntop2, paged_kv.init_refcounts(8), g2,
+        jnp.asarray([True, True]),
+    )
+    assert int(a_ntop) == int(b_ntop)
+    np.testing.assert_array_equal(np.asarray(a_free), np.asarray(b_free))
+
+
+# -- host ledger fuzz ---------------------------------------------------
+
+
+def test_ledger_interleaving_fuzz():
+    """Seeded random interleavings of pop/adopt/acquire/release/drop/
+    lru-evict/deadline-expire hold the invariants at every step: no
+    page both free and held, no duplicate on the stack, refcount-zero
+    entries evictable, pool conserved."""
+    rng = random.Random(7)
+    ledger = PageLedger(32, 4)
+    now = [0.0]
+    live_keys = []
+    for step in range(400):
+        now[0] += rng.random()
+        op = rng.randrange(6)
+        if op == 0 and ledger.ntop >= 2:
+            # an "engine call" pins pages into a new entry: pop from
+            # the mirror, adopt
+            k = rng.randrange(1, min(3, ledger.ntop) + 1)
+            pages = [int(ledger.free[ledger.ntop - 1 - i]) for i in range(k)]
+            ledger.ntop -= k
+            key = f"e{step}"
+            deadline = now[0] + rng.random() * 2 if rng.random() < 0.5 else None
+            ledger.adopt(
+                key, rng.choice(["prefix", "session"]),
+                np.asarray(pages, np.int32),
+                np.zeros(k * 4, np.int32), np.ones(k * 4, np.int32),
+                [], now=now[0], deadline_t=deadline,
+            )
+            live_keys.append(key)
+        elif op == 1 and live_keys:
+            key = rng.choice(live_keys)
+            if ledger.get(key) is not None:
+                ledger.acquire(key, now[0])
+        elif op == 2 and live_keys:
+            key = rng.choice(live_keys)
+            e = ledger.get(key)
+            if e is not None and e.refs > 0:
+                ledger.release(key)
+        elif op == 3 and live_keys:
+            key = rng.choice(live_keys)
+            e = ledger.get(key)
+            if e is not None and e.refs == 0:
+                ledger.drop(key)
+                live_keys.remove(key)
+        elif op == 4:
+            ledger.evict_for(rng.randrange(1, 8), max_entries=4)
+            live_keys = [k for k in live_keys if ledger.get(k) is not None]
+        else:
+            ledger.expire_deadlines(now[0])
+            live_keys = [k for k in live_keys if ledger.get(k) is not None]
+        # invariants, including conservation, after EVERY op (active
+        # refs only pin entries, never pages outside the ledger)
+        ledger.check_invariants()
+    # drain: after releasing every ref and dropping every entry the
+    # whole pool is back on the stack
+    for key in list(ledger.entries):
+        e = ledger.entries[key]
+        e.refs = 0
+        ledger.drop(key)
+    ledger.check_invariants()
+    assert ledger.accounting()["free"] == ledger.accounting()["total"]
+
+
+# -- engine warm-pool goldens -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=16, n_layer=2, n_head=2, n_positions=64,
+        dtype=jnp.float32,
+    )
+    lm = TransformerLM(cfg)
+    return lm, lm.init(jax.random.PRNGKey(0))
+
+
+def _settings():
+    return SamplerSettings(
+        max_new_tokens=N, do_sample=True, eos_token_id=EOS, pad_token_id=PAD
+    )
+
+
+def _spec():
+    return EngineSpec(slots=2, page_size=PS, paged=True, pool_pages=NP)
+
+
+def _host_pool(lm):
+    pool = paged_kv.init_pool(
+        lm.cfg.n_layer, NP, PS, lm.cfg.n_kv_head, lm.cfg.head_dim, None,
+        lm.cfg.dtype,
+    )
+    free, ntop = paged_kv.init_alloc(NP)
+    return pool, np.asarray(free).copy(), int(ntop)
+
+
+def _warm_run(lm, params, pool, free, ntop, ids, mask, table, ready, pin,
+              rngrow, budget, refcnt=None):
+    warm = {
+        "pool": pool, "free": jnp.asarray(free), "ntop": jnp.int32(ntop),
+        "refcnt": jnp.asarray(
+            refcnt if refcnt is not None else np.zeros(NP, np.int32)
+        ),
+        "row_table": jnp.asarray(table),
+    }
+    return engine_generate(
+        lm, params, jnp.asarray(ids), jnp.asarray(mask),
+        jax.random.PRNGKey(5), _settings(), _spec(),
+        row_budget=jnp.asarray(budget, jnp.int32), warm=warm,
+        q_pin=jnp.asarray(pin), q_ready=jnp.asarray(ready, jnp.int32),
+        q_rng_row=jnp.asarray(rngrow, jnp.int32),
+    )
+
+
+PREFIX = np.arange(20, 28, dtype=np.int32)  # 8 tokens = 2 full pages
+
+
+def _row(suffix, head=PREFIX):
+    gap = P - len(head) - len(suffix)
+    ids = np.concatenate([head, np.full(gap, PAD, np.int32),
+                          np.asarray(suffix, np.int32)])
+    mask = np.concatenate([np.ones(len(head), np.int32),
+                           np.zeros(gap, np.int32),
+                           np.ones(len(suffix), np.int32)])
+    return ids, mask
+
+
+def test_prefix_reuse_golden(tiny_lm):
+    """Decode over shared prefix pages (prefilled once by a pinned
+    pioneer) is BIT-EQUAL to independent prefill of the same rows, the
+    cache hold survives every in-call release, and the pool is
+    conserved."""
+    lm, params = tiny_lm
+    MP = paged_kv.pages_per_slot(P, N, PS)
+    pool, free, ntop = _host_pool(lm)
+    ids, mask = _row([41, 43])
+    out = _warm_run(
+        lm, params, pool, free, ntop, ids[None], mask[None],
+        np.zeros((1, MP), np.int32), [0], [True], [11], [3],
+    )
+    kv = out["kv_state"]
+    saved = np.asarray(kv["saved_tables"][0])
+    A = aligned_len(len(PREFIX), PS)
+    keep = saved[: A // PS]
+    assert np.all(keep > 0)
+    # host adoption: hold the aligned pages, free the rest
+    free = np.asarray(kv["free"]).copy()
+    ntop = int(kv["ntop"])
+    for p in saved[A // PS:]:
+        if p > 0:
+            free[ntop] = p
+            ntop += 1
+    pool = kv["pool"]
+
+    rows = np.stack([_row([51, 52, 53])[0], _row([61])[0]])
+    masks = np.stack([_row([51, 52, 53])[1], _row([61])[1]])
+    table = np.zeros((2, MP), np.int32)
+    table[0, :2] = keep
+    table[1, :2] = keep
+    refcnt = np.zeros(NP, np.int32)
+    refcnt[keep] = 1 + 2  # cache hold + one per sharing row
+    shared = _warm_run(
+        lm, params, pool, free, ntop, rows, masks, table, [A, A],
+        [False, False], [21, 22], [N, N], refcnt=refcnt,
+    )
+    pool2, free2, ntop2 = _host_pool(lm)
+    indep = _warm_run(
+        lm, params, pool2, free2, ntop2, rows, masks,
+        np.zeros((2, MP), np.int32), [0, 0], [False, False], [21, 22],
+        [N, N],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(shared["response_ids"]), np.asarray(indep["response_ids"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(shared["response_mask"]),
+        np.asarray(indep["response_mask"]),
+    )
+    # cache hold survived; every non-held page is back on the stack
+    rc_end = np.asarray(shared["kv_state"]["refcnt"])
+    assert np.all(rc_end[keep] == 1)
+    assert int(shared["gen_stats"]["free_pages"]) == ntop
+    assert int(shared["gen_stats"]["pinned_pages"]) == 0
+
+
+# -- frontend rig -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_rig(tiny_lm):
+    """A factory building fresh frontends over ONE jitted engine entry
+    (same spec/settings -> one compile for the whole module)."""
+    lm, params = tiny_lm
+    spec = _spec()
+    settings = _settings()
+
+    @jax.jit
+    def jfn(p, ids, mask, rng, budget, warm, pin, ready, rngrow):
+        return engine_generate(
+            lm, p, ids, mask, rng, settings, spec, row_budget=budget,
+            warm=warm, q_pin=pin, q_ready=ready, q_rng_row=rngrow,
+        )
+
+    def runner(ids, mask, rng, budget, warm, pin, ready, rngrow):
+        return jfn(params, ids, mask, rng, budget, warm, pin, ready, rngrow)
+
+    def build(tmpdir, serve_overrides=None, chaos=None):
+        cfg = ServeConfig.from_dict(dict(
+            dict(
+                enabled=True, max_batch=2, page_size=PS, max_prompt_len=P,
+                max_new_tokens=N, default_max_tokens=4, pool_pages=NP,
+            ),
+            **(serve_overrides or {}),
+        ))
+        geom = dict(
+            P=P, N=N, page_size=PS, pool_pages=NP, pad_token_id=PAD,
+            n_layer=lm.cfg.n_layer, n_kv_head=lm.cfg.n_kv_head,
+            head_dim=lm.cfg.head_dim, kv_quant=None, dtype=lm.cfg.dtype,
+        )
+        return ServeFrontend(cfg, runner, geom, str(tmpdir), chaos=chaos)
+
+    return build
+
+
+def _client(fe):
+    from trlx_tpu.serve.client import ServeClient
+
+    return ServeClient(fe.transport_spec)
+
+
+def test_session_multi_turn_no_leak_no_double_count(serve_rig, tmp_path):
+    """The satellite regression: a pinned session across N turns
+    neither leaks pages nor double-counts reclaims — after every turn
+    the ledger partitions the pool exactly (free + held == total), each
+    turn past the first reuses pinned pages, and evicting the session
+    at the end returns the WHOLE pool to the free stack. The serving
+    ledger is also structurally separate from the training rollout
+    stats: these counters live in serve.* / the frontend summary, never
+    in rollout/engine_reclaimed_pages (the e2e bit-equality test proves
+    training telemetry is untouched)."""
+    fe = serve_rig(tmp_path / "sess")
+    c = _client(fe)
+    total = fe.ledger.accounting()["total"]
+    reclaim_counts = []
+    for turn in range(3):
+        rid = c.submit([30 + turn, 31 + turn], max_tokens=2,
+                       deadline_s=60.0, session_id="chat",
+                       rid=f"turn{turn}")
+        fe.tick(turn)
+        res = c.result(rid, timeout_s=10.0)
+        assert res is not None and res.status == "ok", res
+        if turn > 0:
+            assert res.shared_pages > 0, f"turn {turn} did not reuse pages"
+        fe.ledger.check_invariants()
+        acct = fe.ledger.accounting()
+        assert acct["free"] + acct["held"] == total
+        reclaim_counts.append(fe.ledger.stats["reclaimed_pages"])
+    # reclaim counters are monotone bookkeeping, not per-turn re-counts
+    # of the same pinned pages
+    assert reclaim_counts == sorted(reclaim_counts)
+    entry = fe.ledger.get("sess:chat")
+    assert entry is not None and entry.refs == 0
+    fe.ledger.drop("sess:chat")
+    fe.ledger.check_invariants()
+    assert fe.ledger.accounting()["free"] == total, "session leaked pages"
+    fe.close()
+
+
+def test_session_stream_deterministic_across_frontends(serve_rig, tmp_path):
+    """The same two-turn conversation replayed on a FRESH frontend
+    (fresh pool, fresh cache) produces identical tokens — the
+    per-request RNG row keying makes serving deterministic by request
+    id, independent of pool history."""
+    outs = []
+    for tag in ("one", "two"):
+        fe = serve_rig(tmp_path / tag)
+        c = _client(fe)
+        toks = []
+        for turn in range(2):
+            rid = c.submit([40 + turn], max_tokens=3, deadline_s=60.0,
+                           session_id="s", rid=f"t{turn}")
+            fe.tick(turn)
+            res = c.result(rid, timeout_s=10.0)
+            assert res.status == "ok"
+            toks.append(tuple(res.tokens))
+        outs.append(toks)
+        fe.close()
+    assert outs[0] == outs[1]
+
+
+# -- SLO scheduler ------------------------------------------------------
+
+
+def test_scheduler_edf_order_and_starvation_streaks():
+    s = SLOScheduler(default_deadline_s=10.0, max_batch=2)
+    s.submit(ServeRequest(rid="late", prompt_ids=[1], deadline_s=30.0), 0.0)
+    s.submit(ServeRequest(rid="soon", prompt_ids=[1], deadline_s=5.0), 0.0)
+    s.submit(ServeRequest(rid="mid", prompt_ids=[1], deadline_s=15.0), 0.0)
+    batch = s.pick(0.0)
+    assert [p.req.rid for p in batch] == ["soon", "mid"]  # EDF
+    s.requeue(batch)
+    assert s.pending == 3
+    # expiry pops exactly the past-deadline requests
+    dead = s.expire(6.0)
+    assert [p.req.rid for p in dead] == ["soon"]
+    # starvation streaks report once at the threshold
+    reports = []
+    for _ in range(3):
+        reports.extend(s.note_tick(True, False, report_after=3))
+    assert reports == ["training_starved"]
+    assert s.stats["training_deferred_ticks"] == 3
+
+
+def test_deadline_eviction_reclaims_pinned_pages(serve_rig, tmp_path):
+    """An idle session past serve.session_deadline_s is evicted by the
+    next tick and its pinned pages land back on the free stack; a
+    request arriving already expired gets a timeout result without
+    burning a lane."""
+    clock = [100.0]
+    fe = serve_rig(tmp_path / "dl", serve_overrides=dict(
+        session_deadline_s=5.0,
+    ))
+    fe._clock = lambda: clock[0]
+    c = _client(fe)
+    total = fe.ledger.accounting()["total"]
+    rid = c.submit([33, 34], max_tokens=2, deadline_s=60.0,
+                   session_id="idle", rid="turn0")
+    fe.tick(0)
+    assert c.result(rid, timeout_s=10.0).status == "ok"
+    held = fe.ledger.accounting()["held"]
+    assert held > 0
+    # a request whose deadline is already spent: evicted, not served
+    dead_rid = c.submit([35], max_tokens=2, deadline_s=0.0, rid="dead")
+    clock[0] += 6.0  # the idle session's deadline passes too
+    batches = fe.tick(1)
+    res = c.result(dead_rid, timeout_s=10.0)
+    assert res is not None and res.status == "timeout"
+    assert fe.sched.stats["deadline_evictions"] >= 1
+    assert fe.ledger.stats["deadline_evicted_entries"] == 1
+    fe.ledger.check_invariants()
+    assert fe.ledger.accounting()["free"] == total, (
+        "deadline eviction did not reclaim the pinned pages"
+    )
+    assert batches == 0  # nothing admitted: the expired request never ran
+    fe.close()
+
+
+def test_lane_starvation_reported_never_wedged(serve_rig, tmp_path):
+    """Chaos serve_lane_starvation (training load saturating the
+    lanes): starved ticks serve nothing and are counted; once capacity
+    returns the queue drains — the loop never wedges."""
+    from trlx_tpu.utils.chaos import ChaosMonkey
+
+    chaos = ChaosMonkey(dict(seed=0, faults=[
+        {"fault": "serve_lane_starvation", "at": 1, "span": 2},
+    ]))
+    fe = serve_rig(tmp_path / "starve", serve_overrides=dict(
+        starvation_report_after=2,
+    ), chaos=chaos)
+    c = _client(fe)
+    rid = c.submit([44, 45], max_tokens=2, deadline_s=300.0, rid="r")
+    assert fe.tick(0) == 0 and fe.tick(1) == 0  # starved ticks
+    assert fe.sched.stats["serving_starved_ticks"] == 2
+    assert fe.stats["starvation_reports"] == 1
+    assert fe.tick(2) == 1  # capacity back: the queue drains
+    assert c.result(rid, timeout_s=10.0).status == "ok"
+    fe.close()
+
+
+# -- transport ----------------------------------------------------------
+
+
+def test_transport_contract_sharedfs_and_tcp(tmp_path):
+    """Both backends implement the same mailbox contract: committed
+    messages round-trip exactly, a duplicate put reports False, delete
+    is idempotent, lists are sorted."""
+    from trlx_tpu.exp.net import SharedFSTransport, TcpHub, TcpTransport
+
+    hub = TcpHub()
+    backends = [
+        SharedFSTransport(str(tmp_path / "fs")),
+        TcpTransport(hub.host, hub.port),
+    ]
+    arrays = {"x": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    try:
+        for tr in backends:
+            assert tr.put("topic", "m1", {"a": 1}, arrays) is True
+            assert tr.put("topic", "m1", {"a": 2}, arrays) is False  # dedup
+            meta, arrs = tr.get("topic", "m1")
+            assert meta["a"] == 1
+            np.testing.assert_array_equal(arrs["x"], arrays["x"])
+            assert tr.get_meta("topic", "m1")["a"] == 1
+            assert tr.get("topic", "absent") is None
+            tr.put("topic", "m0", {}, None)
+            assert tr.list("topic") == ["m0", "m1"]
+            tr.delete("topic", "m0")
+            tr.delete("topic", "m0")  # idempotent
+            assert tr.list("topic") == ["m1"]
+    finally:
+        hub.close()
+
+
+def test_serve_tcp_golden_bit_equal_to_sharedfs(serve_rig, tmp_path):
+    """The SAME request stream served over the tcp hub and over the
+    shared filesystem produces identical tokens — the transport backend
+    is invisible to the sampled stream."""
+    streams = []
+    for overrides, tag in (
+        (dict(), "fs"),
+        (dict(transport={"backend": "tcp", "port": 0}), "tcp"),
+    ):
+        fe = serve_rig(tmp_path / tag, serve_overrides=overrides)
+        c = _client(fe)
+        toks = []
+        r1 = c.submit([71, 72], max_tokens=4, deadline_s=60.0,
+                      prefix_ids=PREFIX.tolist(), rid="g1")
+        fe.tick(0)
+        toks.append(tuple(c.result(r1, timeout_s=10.0).tokens))
+        r2 = c.submit([73], max_tokens=4, deadline_s=60.0,
+                      prefix_ids=PREFIX.tolist(), rid="g2")
+        fe.tick(1)
+        res2 = c.result(r2, timeout_s=10.0)
+        assert res2.status == "ok"
+        if tag == "fs":
+            assert res2.shared_pages > 0  # the pioneer's pages are live
+        toks.append(tuple(res2.tokens))
+        streams.append(toks)
+        fe.close()
+    assert streams[0] == streams[1]
+
+
+def test_transport_drop_retries_to_exactly_once(serve_rig, tmp_path):
+    """Chaos serve_transport_drop: the first result post is lost on the
+    wire; the frontend re-posts under the same request id next tick and
+    the transport dedup makes delivery exactly-once."""
+    from trlx_tpu.utils.chaos import ChaosMonkey
+
+    chaos = ChaosMonkey(dict(seed=0, faults=[
+        {"fault": "serve_transport_drop", "at": 1},
+    ]))
+    fe = serve_rig(tmp_path / "drop", chaos=chaos)
+    c = _client(fe)
+    rid = c.submit([81, 82], max_tokens=2, deadline_s=60.0, rid="d")
+    fe.tick(0)
+    # the result was produced but its post dropped
+    assert fe.stats["transport_drops"] == 1
+    assert c.result(rid, timeout_s=0.2) is None
+    fe.tick(1)  # re-post; hub/fs dedup would drop a second copy
+    res = c.result(rid, timeout_s=10.0)
+    assert res is not None and res.status == "ok"
+    fe.close()
+
+
+def test_fleet_chunk_messaging_over_tcp(tmp_path):
+    """The fleet's dispatch/delivery protocol rides the same Transport
+    interface with the LEARNER hosting the hub
+    (method.fleet.transport {backend: tcp}): a coordinator dispatch is
+    visible to a worker-side transport built from the coordinator's
+    advertised spec, the delivery dedups, and clear_chunk removes both
+    sides — no shared filesystem involved for the chunk traffic."""
+    from trlx_tpu.exp.net import make_transport
+    from trlx_tpu.fleet.config import FleetConfig
+    from trlx_tpu.fleet.coordinator import (
+        CHUNKS_DIR,
+        DISPATCH_DIR,
+        FleetCoordinator,
+    )
+
+    cfg = FleetConfig.from_dict(dict(
+        enabled=True, transport={"backend": "tcp", "port": 0},
+    ))
+    coord = FleetCoordinator(cfg, str(tmp_path / "fleet"))
+    try:
+        assert coord.hub is not None
+        worker = make_transport(dict(coord.transport_spec), ".")
+        arrays = {"prompt_input_ids": np.ones((2, 4), np.int32)}
+        coord.dispatch((1, 1), 1, "w0", {"iter_count": 0}, arrays)
+        names = worker.list(DISPATCH_DIR)
+        assert names == ["e1_s1_a1"]
+        meta = worker.get_meta(DISPATCH_DIR, "e1_s1_a1",
+                               meta_name="assignment.json")
+        assert meta["worker"] == "w0"
+        _, arrs = worker.get(DISPATCH_DIR, "e1_s1_a1",
+                             meta_name="assignment.json")
+        np.testing.assert_array_equal(arrs["prompt_input_ids"],
+                                      arrays["prompt_input_ids"])
+        # delivery: first wins, the redelivery dedups (at-least-once)
+        assert worker.put(CHUNKS_DIR, "e1_s1", {"chunk_id": [1, 1]},
+                          arrays, meta_name="chunk.json") is True
+        assert worker.put(CHUNKS_DIR, "e1_s1", {"chunk_id": [1, 1]},
+                          arrays, meta_name="chunk.json") is False
+        assert coord.poll_delivery((1, 1)) is not None
+        coord.clear_chunk((1, 1))
+        assert coord.transport.list(DISPATCH_DIR) == []
+        assert coord.transport.list(CHUNKS_DIR) == []
+    finally:
+        coord.shutdown()
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError, match="unknown keys"):
+        ServeConfig.from_dict({"nope": 1})
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        ServeConfig.from_dict({"max_new_tokens": 0})
+    with pytest.raises(ValueError, match="kv_quant"):
+        ServeConfig.from_dict({"kv_quant": "fp4"})
+    with pytest.raises(ValueError, match="backend"):
+        from trlx_tpu.exp.net import make_transport
+
+        make_transport({"backend": "carrier_pigeon"}, ".")
+
+
+# -- end to end: the acceptance test ------------------------------------
+
+
+def _tiny_ppo_config(ckpt_dir, serve):
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    return default_ppo_config().evolve(
+        train=dict(
+            batch_size=8, total_steps=5, eval_interval=100,
+            checkpoint_interval=100, seq_length=24, epochs=64,
+            tracker="jsonl", checkpoint_dir=ckpt_dir, save_best=False,
+            serve=serve,
+        ),
+        model=dict(
+            model_path="random", num_layers_unfrozen=-1,
+            model_extra_configs={
+                "transformer": dict(
+                    vocab_size=258, hidden_size=32, n_layer=2, n_head=2,
+                    n_positions=64,
+                )
+            },
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        method=dict(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1,
+            gen_kwargs=dict(max_new_tokens=8, top_k=0, top_p=1.0,
+                            do_sample=True),
+        ),
+    )
+
+
+def _run_learn(tmp_path, tag, serve, client_body=None):
+    import trlx_tpu
+
+    ckpt_dir = os.path.join(str(tmp_path), tag)
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    threads = []
+    if client_body is not None:
+        spec = {"backend": "shared_fs", "root": os.path.join(ckpt_dir,
+                                                             "serve")}
+        t = threading.Thread(target=client_body, args=(spec,), daemon=True)
+        t.start()
+        threads.append(t)
+    trainer = trlx_tpu.train(
+        reward_fn=lambda samples, prompts, outputs, **kw: [
+            float(len(o.split())) for o in outputs
+        ],
+        prompts=["hello world", "the cat", "a b", "xyz",
+                 "what is", "I am", "go", "ok"],
+        config=_tiny_ppo_config(ckpt_dir, serve),
+    )
+    for t in threads:
+        t.join(timeout=60)
+    with open(os.path.join(ckpt_dir, "logs", "metrics.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    stream = [
+        {k: v for k, v in r.items()
+         if k.startswith("losses/") or k == "reward/mean"}
+        for r in recs
+    ]
+    return trainer, [s for s in stream if s]
+
+
+def test_e2e_ppo_learn_with_serving_bit_equal(tmp_path):
+    """THE acceptance criterion: a PPO learn() with the serving
+    frontend enabled serves requests admitted mid-training within
+    their deadlines, shared-prefix requests demonstrably reuse pages
+    (pool accounting), and the training loss stream is BIT-EQUAL to the
+    no-serving run on the same seed."""
+    results = []
+
+    def client_body(spec):
+        from trlx_tpu.serve.client import ServeClient
+
+        c = ServeClient(spec)
+        prefix = list(range(50, 66))  # 2 pages at page_size 8
+        r0 = c.submit([100, 101, 102], max_tokens=6, deadline_s=240.0,
+                      prefix_ids=prefix, rid="req0")
+        results.append(c.result(r0, timeout_s=300.0))
+        rids = [
+            c.submit([110 + i], max_tokens=6, deadline_s=240.0,
+                     prefix_ids=prefix, rid=f"req{i + 1}")
+            for i in range(2)
+        ]
+        for rid in rids:
+            results.append(c.result(rid, timeout_s=300.0))
+        s1 = c.submit(list(range(120, 129)), max_tokens=6,
+                      deadline_s=240.0, session_id="alice", rid="sess1")
+        results.append(c.result(s1, timeout_s=300.0))
+        s2 = c.submit([60], max_tokens=4, deadline_s=240.0,
+                      session_id="alice", rid="sess2")
+        results.append(c.result(s2, timeout_s=300.0))
+
+    serve_cfg = dict(
+        enabled=True, max_batch=4, page_size=8, max_prompt_len=32,
+        max_new_tokens=8, default_max_tokens=6, pool_pages=64,
+    )
+    _, stream_off = _run_learn(tmp_path, "off", {})
+    trainer, stream_on = _run_learn(tmp_path, "on", serve_cfg,
+                                    client_body=client_body)
+    assert stream_on == stream_off, (
+        "training loss stream diverged under serving load:\n"
+        f"{stream_off}\n{stream_on}"
+    )
+    assert len(results) == 5 and all(r is not None for r in results)
+    assert all(r.status == "ok" for r in results), [
+        (r.rid, r.status, r.detail) for r in results
+    ]
+    # prefix sharers and the session's second turn reused cached pages
+    assert results[1].shared_pages > 0 and results[2].shared_pages > 0
+    assert results[4].shared_pages > 0
+    summary = trainer._serve_final_summary
+    assert summary["deadline_met_rate"] == 1.0, summary
+    assert summary["kv_shared_page_hits"] > 0
+    # serving telemetry stays out of the training rollout ledger: the
+    # serve engine's reclaimed/pinned pages are serve-summary numbers,
+    # while the metrics stream (asserted bit-equal above) carries the
+    # training rollout/engine_reclaimed_pages untouched
+    assert summary["engine_pinned_pages"] > 0

@@ -332,6 +332,26 @@ class TrainConfig(_Section):
     # restores pre-obs behavior. Render with scripts/flight_report.py;
     # runbook: docs/observability.md.
     obs: Dict[str, Any] = field(default_factory=dict)
+    # --- live-traffic serving tier --------------------------------------
+    # Parsed by serve.config.ServeConfig (enabled/max_batch/slots/
+    # page_size/pool_pages/max_prompt_len/max_new_tokens/
+    # default_max_tokens/default_deadline_s/kv_quant/
+    # max_batches_per_tick/starvation_report_after/prefix_cache/
+    # sessions/session_deadline_s/max_cache_entries/transport/seed).
+    # Default {} = disabled. When enabled, learn() hosts a serving
+    # frontend on the SAME continuous-batching decode engine that
+    # produces training rollouts, on the live policy params: external
+    # requests (prompt, max_tokens, sampling seed-by-request-id,
+    # deadline) are admitted at the lane-refill decision points with
+    # SLO scheduling (EDF; serving outranks training refills under a
+    # bounded per-tick allowance; deadline-expired requests are evicted
+    # with their pages reclaimed), a refcounted prefix/session KV cache
+    # shares page-aligned system prompts across requests and pins
+    # multi-turn sessions, and requests arrive over a pluggable
+    # transport (shared_fs under <checkpoint_dir>/serve, or a tcp hub).
+    # The training loss stream stays bit-equal to a no-serving run by
+    # construction. See docs/serving.md.
+    serve: Dict[str, Any] = field(default_factory=dict)
     # --- chaos injection (tests/CI only) --------------------------------
     # Parsed by utils/chaos.ChaosMonkey: {"seed": int, "faults": [
     # {"fault": "nan_loss"|"sigterm"|"nan_reward"|"reward_timeout"|
@@ -339,7 +359,9 @@ class TrainConfig(_Section):
     # "stall_rollout"|"stall_reward"|"stall_collective"|
     # "worker_death_mid_lease"|"duplicate_delivery"|"stale_flood"|
     # "queue_wedge"|"fleet_worker_death"|"fleet_partition"|
-    # "broadcast_corrupt"|"oom_fused_block"|"oom_prefill"|"hbm_creep",
+    # "broadcast_corrupt"|"oom_fused_block"|"oom_prefill"|"hbm_creep"|
+    # "serve_request_timeout"|"serve_lane_starvation"|
+    # "serve_transport_drop",
     # "at": k | "every": n | "p": x,
     # "span": m}], "reward_delay": s, "stall_delay": s}. None/{}
     # disables. Deterministic given the seed — see docs/robustness.md

@@ -25,6 +25,12 @@ chaos-proven layer instead of leaking into every trainer.
                 consume-side ``poll``/``admit``/``committed`` (dedup +
                 staleness), epoch aborts for guardrail requeue/rollback,
                 and ``state_dict``/``load_state_dict`` for resume.
+  net.py        the PROCESS-BOUNDARY substrate: the pluggable topic/
+                message transport (atomic-rename shared-fs, or a tcp
+                hub) that carries fleet chunk dispatch/delivery and
+                the serving tier's request/response traffic across
+                machines. ``transport.py`` is the delivery state
+                machine; ``net.py`` is the wire it can ride.
 
 Everything here is pure host-side bookkeeping — no jax at module scope
 — with injectable clocks, so tier-1 tests cover every delivery

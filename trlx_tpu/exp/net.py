@@ -1,0 +1,427 @@
+"""Pluggable message transport: the process-boundary substrate shared
+by the experience/fleet plumbing (trlx_tpu/fleet/) and the serving tier
+(trlx_tpu/serve/).
+
+Before this module the atomic-rename shared-filesystem protocol
+(fleet/serde.py ``commit_message_dir``/``read_message_dir``) was wired
+directly into the fleet coordinator and worker. It is now ONE backend
+behind a small interface, so the learner, the rollout fleet and the
+serving frontend can cross a real machine boundary by swapping config,
+not code:
+
+  shared_fs   the golden path: topic = a subdirectory, message = an
+              atomically-renamed ``{meta.json, arrays.npz}`` dir.
+              Byte-identical layout to the pre-interface fleet — the
+              refactor is behavior-preserving by construction (the
+              backend calls the very same serde functions).
+  tcp         a socket/RPC backend: one :class:`TcpHub` process holds
+              the topic store in memory; clients PUT/GET/LIST/DELETE
+              over length-prefixed JSON+binary frames. Delivery is
+              at-least-once with consumer-visible dedup — a PUT of an
+              existing (topic, name) reports ``duplicate`` exactly like
+              the shared-fs rename race — so a dropped/retried message
+              (chaos ``serve_transport_drop``) converges to
+              exactly-once.
+
+The message model is deliberately tiny: a *topic* (mailbox) holding
+named messages, each a JSON-safe ``meta`` dict plus an optional dict of
+numpy arrays. Names are unique per topic; a second put of the same name
+is a no-op returning False. That single primitive covers fleet chunk
+dispatch/delivery and serve request/response traffic; richer semantics
+(ordering, leases, staleness) stay where they are — in exp/transport.py
+and the consumers — on top of it.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+Message = Tuple[Dict[str, Any], Dict[str, np.ndarray]]
+
+
+class Transport:
+    """Topic/message transport interface (see module docstring)."""
+
+    def put(
+        self,
+        topic: str,
+        name: str,
+        meta: Dict[str, Any],
+        arrays: Optional[Dict[str, np.ndarray]] = None,
+        meta_name: str = "meta.json",
+    ) -> bool:
+        """Publish a message. Returns False when (topic, name) already
+        exists — the racing-duplicate outcome callers treat as
+        success-by-dedup."""
+        raise NotImplementedError
+
+    def get(
+        self, topic: str, name: str, meta_name: str = "meta.json"
+    ) -> Optional[Message]:
+        """The committed message, or None when absent/not yet landed."""
+        raise NotImplementedError
+
+    def get_meta(
+        self, topic: str, name: str, meta_name: str = "meta.json"
+    ) -> Optional[Dict[str, Any]]:
+        """Meta-only read (cheap routing without the arrays payload)."""
+        raise NotImplementedError
+
+    def list(self, topic: str) -> List[str]:
+        """Committed message names in the topic, sorted."""
+        raise NotImplementedError
+
+    def delete(self, topic: str, name: str) -> None:
+        """Drop a message (idempotent; absent is fine)."""
+        raise NotImplementedError
+
+    def delete_prefix(self, topic: str, prefix: str) -> None:
+        for name in self.list(topic):
+            if name.startswith(prefix):
+                self.delete(topic, name)
+
+    def close(self) -> None:
+        pass
+
+
+class SharedFSTransport(Transport):
+    """The atomic-rename shared-filesystem backend — the pre-interface
+    fleet protocol verbatim (delegates to fleet/serde.py, so the wire
+    layout stays golden bit-equal: ``<root>/<topic>/<name>/{<meta_name>,
+    arrays.npz}``)."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _dir(self, topic: str, name: str = "") -> str:
+        return os.path.join(self.root, topic, name) if name else os.path.join(
+            self.root, topic
+        )
+
+    def put(self, topic, name, meta, arrays=None, meta_name="meta.json"):
+        from trlx_tpu.fleet import serde
+
+        return serde.commit_message_dir(
+            self._dir(topic, name), meta, dict(arrays or {}),
+            meta_name=meta_name,
+        )
+
+    def get(self, topic, name, meta_name="meta.json"):
+        from trlx_tpu.fleet import serde
+
+        return serde.read_message_dir(
+            self._dir(topic, name), meta_name=meta_name
+        )
+
+    def get_meta(self, topic, name, meta_name="meta.json"):
+        from trlx_tpu.fleet import serde
+
+        return serde.read_message_meta(
+            self._dir(topic, name), meta_name=meta_name
+        )
+
+    def list(self, topic):
+        try:
+            entries = sorted(os.listdir(self._dir(topic)))
+        except OSError:
+            return []
+        # ".tmp_" entries are half-committed message dirs mid-rename
+        return [
+            e for e in entries if not e.startswith(".") and ".tmp" not in e
+        ]
+
+    def delete(self, topic, name):
+        shutil.rmtree(self._dir(topic, name), ignore_errors=True)
+
+
+# -- TCP backend --------------------------------------------------------
+#
+# Frame format (both directions): 4-byte big-endian header length, the
+# JSON header, then `blob_len` raw bytes (the npz payload). One
+# request/response pair per connection — simple, stateless, and immune
+# to half-closed-socket bookkeeping; the payloads (rollout chunks,
+# serve prompts) dwarf the connect cost.
+
+
+def _send_frame(sock: socket.socket, header: Dict[str, Any], blob: bytes):
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack(">I", len(h)) + h + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("transport: peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[Dict[str, Any], bytes]:
+    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen).decode())
+    blob = _recv_exact(sock, int(header.get("blob_len", 0)))
+    return header, blob
+
+
+def _pack_arrays(arrays: Optional[Dict[str, np.ndarray]]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in (arrays or {}).items()})
+    return buf.getvalue()
+
+
+def _unpack_arrays(blob: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(blob)) as z:
+        return {k: z[k] for k in z.files}
+
+
+class _HubHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        hub: "TcpHub" = self.server.hub  # type: ignore[attr-defined]
+        try:
+            header, blob = _recv_frame(self.request)
+        except (ConnectionError, ValueError, json.JSONDecodeError):
+            return
+        cmd = header.get("cmd")
+        topic = header.get("topic", "")
+        name = header.get("name", "")
+        resp: Dict[str, Any] = {"ok": True}
+        out_blob = b""
+        with hub._lock:
+            store = hub._topics.setdefault(topic, {})
+            if cmd == "put":
+                if name in store:
+                    resp["status"] = "duplicate"
+                else:
+                    store[name] = (dict(header.get("meta") or {}), blob)
+                    resp["status"] = "accepted"
+            elif cmd == "get":
+                msg = store.get(name)
+                if msg is None:
+                    resp["found"] = False
+                else:
+                    resp["found"] = True
+                    resp["meta"] = msg[0]
+                    if header.get("meta_only"):
+                        out_blob = b""
+                    else:
+                        out_blob = msg[1]
+            elif cmd == "list":
+                resp["names"] = sorted(store)
+            elif cmd == "delete":
+                store.pop(name, None)
+            else:
+                resp = {"ok": False, "error": f"unknown cmd {cmd!r}"}
+        resp["blob_len"] = len(out_blob)
+        try:
+            _send_frame(self.request, resp, out_blob)
+        except OSError:
+            pass
+
+
+class _HubServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class TcpHub:
+    """In-memory topic store behind a threaded TCP server. Run one next
+    to the consumer (the learner / the serving frontend); producers and
+    clients connect with :class:`TcpTransport`. Contents are volatile —
+    exactly as durable as the consumer process itself, which is the
+    right durability class for redeliverable traffic (chunks regenerate
+    from replay snapshots, serve requests are client-retried)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = _HubServer((host, port), _HubHandler)
+        self._server.hub = self  # type: ignore[attr-defined]
+        self._topics: Dict[str, Dict[str, Tuple[Dict[str, Any], bytes]]] = {}
+        self._lock = threading.Lock()
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="transport-hub",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("transport hub listening on %s:%d", self.host, self.port)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TcpTransport(Transport):
+    """Socket client for a :class:`TcpHub`. ``retries`` transparently
+    re-sends on connection errors; because PUT is deduplicating by
+    (topic, name), the retry loop is idempotent — a lost response whose
+    request actually landed converges to ``duplicate``, which callers
+    already treat as success."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        retries: int = 3,
+        timeout_s: float = 10.0,
+        drop_hook=None,
+    ):
+        self.host, self.port = host, int(port)
+        self.retries = int(retries)
+        self.timeout_s = float(timeout_s)
+        # chaos seam (serve_transport_drop): called before each send;
+        # returning True "loses" the frame — the retry loop + hub dedup
+        # must make delivery exactly-once anyway
+        self.drop_hook = drop_hook
+        self.stats = {"sent": 0, "dropped": 0, "retried": 0}
+
+    def _rpc(
+        self, header: Dict[str, Any], blob: bytes = b""
+    ) -> Tuple[Dict[str, Any], bytes]:
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                self.stats["retried"] += 1
+            if self.drop_hook is not None and self.drop_hook():
+                # the frame is "lost on the wire": no send this attempt
+                self.stats["dropped"] += 1
+                last = ConnectionError("transport: frame dropped (chaos)")
+                continue
+            try:
+                with socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout_s
+                ) as sock:
+                    header = dict(header, blob_len=len(blob))
+                    _send_frame(sock, header, blob)
+                    self.stats["sent"] += 1
+                    return _recv_frame(sock)
+            except (OSError, ConnectionError, ValueError) as e:
+                last = e
+        raise ConnectionError(
+            f"transport: rpc {header.get('cmd')!r} to "
+            f"{self.host}:{self.port} failed after {self.retries + 1} "
+            f"attempts: {last}"
+        )
+
+    def put(self, topic, name, meta, arrays=None, meta_name="meta.json"):
+        resp, _ = self._rpc(
+            {"cmd": "put", "topic": topic, "name": name, "meta": meta},
+            _pack_arrays(arrays),
+        )
+        return resp.get("status") == "accepted"
+
+    def get(self, topic, name, meta_name="meta.json"):
+        resp, blob = self._rpc({"cmd": "get", "topic": topic, "name": name})
+        if not resp.get("found"):
+            return None
+        return resp.get("meta") or {}, _unpack_arrays(blob)
+
+    def get_meta(self, topic, name, meta_name="meta.json"):
+        resp, _ = self._rpc(
+            {"cmd": "get", "topic": topic, "name": name, "meta_only": True}
+        )
+        return (resp.get("meta") or {}) if resp.get("found") else None
+
+    def list(self, topic):
+        resp, _ = self._rpc({"cmd": "list", "topic": topic})
+        return list(resp.get("names") or [])
+
+    def delete(self, topic, name):
+        self._rpc({"cmd": "delete", "topic": topic, "name": name})
+
+
+def make_hub_transport(
+    spec: Optional[Dict[str, Any]],
+) -> Tuple[TcpHub, TcpTransport, Dict[str, Any]]:
+    """The SERVER side of the tcp backend (the serving frontend, the
+    fleet learner): host the hub the spec names and return ``(hub,
+    local client, advertised client spec)`` — remote peers connect
+    with the advertised spec via :func:`make_transport`. ``bind``
+    (default 127.0.0.1; use 0.0.0.0 to accept remote peers) is the
+    listen address, ``host`` the address advertised to peers, ``port``
+    0 = ephemeral (the advertised spec carries the real port)."""
+    spec = dict(spec or {})
+    if spec.pop("backend", None) != "tcp":
+        raise ValueError("make_hub_transport: spec.backend must be 'tcp'")
+    known = {"host", "port", "retries", "timeout_s", "bind"}
+    unknown = set(spec) - known
+    if unknown:
+        raise ValueError(f"transport (tcp hub): unknown keys {sorted(unknown)}")
+    hub = TcpHub(spec.get("bind", "127.0.0.1"), int(spec.get("port", 0)))
+    client = TcpTransport(
+        "127.0.0.1", hub.port,
+        retries=int(spec.get("retries", 3)),
+        timeout_s=float(spec.get("timeout_s", 10.0)),
+    )
+    advertised = {
+        "backend": "tcp", "host": spec.get("host", hub.host),
+        "port": hub.port,
+    }
+    return hub, client, advertised
+
+
+def make_server_transport(
+    spec: Optional[Dict[str, Any]], default_root: str
+) -> Tuple[Optional[TcpHub], Transport, Dict[str, Any]]:
+    """The CONSUMER side's one-stop bootstrap (serving frontend, fleet
+    learner): ``(hub_or_None, transport, advertised client spec)``.
+    tcp specs host the hub via :func:`make_hub_transport`; everything
+    else resolves through :func:`make_transport` (shared-fs peers use
+    the advertised root)."""
+    spec = dict(spec or {})
+    if spec.get("backend") == "tcp":
+        return make_hub_transport(spec)
+    transport = make_transport(spec, default_root)
+    return None, transport, {
+        "backend": "shared_fs", "root": spec.get("root") or default_root,
+    }
+
+
+def make_transport(
+    spec: Optional[Dict[str, Any]], default_root: str
+) -> Transport:
+    """Config -> backend (the CLIENT side for tcp). ``spec`` keys:
+    ``backend`` ("shared_fs", default, or "tcp"), ``root``
+    (shared_fs), ``host``/``port`` (tcp client; ``bind`` is tolerated
+    so server and client can share one spec dict),
+    ``retries``/``timeout_s`` (tcp). Unknown keys fail loudly — a
+    typo'd backend must not silently fall back to the default."""
+    spec = dict(spec or {})
+    backend = spec.pop("backend", "shared_fs")
+    known = {
+        "shared_fs": {"root"},
+        "tcp": {"host", "port", "retries", "timeout_s", "bind"},
+    }
+    if backend not in known:
+        raise ValueError(
+            f"transport.backend must be one of {sorted(known)}, "
+            f"got {backend!r}"
+        )
+    unknown = set(spec) - known[backend]
+    if unknown:
+        raise ValueError(
+            f"transport ({backend}): unknown keys {sorted(unknown)}"
+        )
+    if backend == "tcp":
+        if "port" not in spec:
+            raise ValueError("transport.backend tcp needs host/port")
+        return TcpTransport(
+            spec.get("host", "127.0.0.1"), spec["port"],
+            retries=int(spec.get("retries", 3)),
+            timeout_s=float(spec.get("timeout_s", 10.0)),
+        )
+    return SharedFSTransport(spec.get("root") or default_root)

@@ -20,6 +20,12 @@ The bounded waits (back-pressure, lease expiry) take a ``wait``
 callable so the trainer can thread watchdog heartbeats through them —
 a queue wedge then shows up as the ``exp_wait`` phase going silent,
 never as an undiagnosable hang.
+
+This class is in-process delivery STATE (ordering, dedup, staleness,
+cursors); the bytes that cross a process/machine boundary ride the
+pluggable topic transport in :mod:`trlx_tpu.exp.net` (shared-fs or
+tcp) — the fleet's chunk messaging and the serving tier's
+request/response traffic both use it.
 """
 
 from __future__ import annotations

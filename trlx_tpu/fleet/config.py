@@ -60,6 +60,19 @@ class FleetConfig:
                        verification).
     attach_timeout_s   how long a WORKER waits for the learner's
                        membership record to appear before giving up.
+    transport          chunk dispatch/delivery backend (exp/net.py
+                       spec): ``{}`` = ``{backend: shared_fs}`` rooted
+                       at ``dir`` (the golden pre-interface layout,
+                       bit-equal). ``{backend: tcp, port: N, host:
+                       <learner addr>, bind: 0.0.0.0}`` makes the
+                       LEARNER host a socket hub for the chunk traffic
+                       (use a fixed non-zero port so workers can find
+                       it; workers connect to ``host:port`` with the
+                       same spec dict) so workers can sit on another
+                       machine. Membership + weight broadcast still
+                       live under ``dir`` in v1 — a cross-machine
+                       fleet needs it network-mounted (docs/serving.md
+                       "Transport backends").
     """
 
     enabled: bool = False
@@ -74,6 +87,7 @@ class FleetConfig:
     broadcast_every: int = 1
     broadcast_keep: int = 2
     attach_timeout_s: float = 120.0
+    transport: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "FleetConfig":

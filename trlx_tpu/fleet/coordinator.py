@@ -13,11 +13,17 @@ invisible in the consumed stream. When the live fleet falls below
 falls back to in-process production (the ``fleet`` guardrail signal
 trips once per transition).
 
-Message layout under the fleet dir (all atomic-rename commits,
-``fleet/serde.py``)::
+Chunk messaging rides the pluggable transport (``exp/net.py``). On the
+default shared-fs backend the layout under the fleet dir is the
+original atomic-rename protocol, byte for byte::
 
     dispatch/e{epoch}_s{seq}_a{attempt}/   assignment for one worker
     chunks/e{epoch}_s{seq}/                the delivered chunk payload
+
+On the tcp backend the same (topic, name) messages live in a
+:class:`trlx_tpu.exp.net.TcpHub` — workers then need no shared
+filesystem for chunk traffic (membership + broadcast still use ``dir``
+in v1).
 
 Delivery is naturally deduplicating: the chunk dir name carries no
 attempt, so whichever attempt's rename lands first wins and the other
@@ -27,7 +33,6 @@ drops itself (both are bit-identical by the replay contract anyway).
 from __future__ import annotations
 
 import os
-import shutil
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -36,7 +41,6 @@ import numpy as np
 from trlx_tpu.fleet.broadcast import WeightBroadcast
 from trlx_tpu.fleet.config import FleetConfig
 from trlx_tpu.fleet.membership import WorkerRegistry
-from trlx_tpu.fleet import serde
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -57,10 +61,27 @@ class FleetCoordinator:
         root: str,
         owner: str = "learner",
         clock: Callable[[], float] = time.time,
+        transport=None,
     ):
+        from trlx_tpu.exp.net import make_server_transport
+
         self.cfg = cfg
         self.root = root
         self._clock = clock
+        # chunk dispatch/delivery rides the pluggable transport; the
+        # default shared-fs backend reproduces the pre-interface
+        # message-dir layout byte for byte. On the tcp backend the
+        # LEARNER hosts the hub (workers connect with the same spec's
+        # host/port). Membership + broadcast stay under `root`
+        # regardless of backend (v1 scope).
+        self.hub = None
+        if transport is not None:
+            self.transport = transport
+            self.transport_spec = None  # caller-supplied: unknown wire
+        else:
+            self.hub, self.transport, self.transport_spec = (
+                make_server_transport(cfg.transport, root)
+            )
         os.makedirs(os.path.join(root, DISPATCH_DIR), exist_ok=True)
         os.makedirs(os.path.join(root, CHUNKS_DIR), exist_ok=True)
         self.registry = WorkerRegistry(
@@ -180,8 +201,8 @@ class FleetCoordinator:
         arrays: Dict[str, np.ndarray],
     ) -> None:
         name = f"{chunk_name(chunk_id)}_a{int(attempt)}"
-        serde.commit_message_dir(
-            os.path.join(self.root, DISPATCH_DIR, name),
+        self.transport.put(
+            DISPATCH_DIR, name,
             {**meta, "worker": worker, "attempt": int(attempt),
              "chunk_id": list(chunk_id)},
             arrays,
@@ -198,9 +219,8 @@ class FleetCoordinator:
     def poll_delivery(
         self, chunk_id: Tuple[int, int]
     ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
-        msg = serde.read_message_dir(
-            os.path.join(self.root, CHUNKS_DIR, chunk_name(chunk_id)),
-            meta_name="chunk.json",
+        msg = self.transport.get(
+            CHUNKS_DIR, chunk_name(chunk_id), meta_name="chunk.json"
         )
         if msg is not None:
             self.stats["delivered"] += 1
@@ -211,25 +231,15 @@ class FleetCoordinator:
         delivery from an abandoned attempt) — the outstanding dispatch
         assignment stays, so the currently-assigned worker is not
         stranded."""
-        shutil.rmtree(
-            os.path.join(self.root, CHUNKS_DIR, chunk_name(chunk_id)),
-            ignore_errors=True,
-        )
+        self.transport.delete(CHUNKS_DIR, chunk_name(chunk_id))
 
     def clear_chunk(self, chunk_id: Tuple[int, int]) -> None:
         """Drop a consumed chunk's delivery + dispatch messages (the
         transport queue owns the payload now; leftovers would only
         confuse a postmortem)."""
         name = chunk_name(chunk_id)
-        shutil.rmtree(
-            os.path.join(self.root, CHUNKS_DIR, name), ignore_errors=True
-        )
-        ddir = os.path.join(self.root, DISPATCH_DIR)
-        for entry in os.listdir(ddir):
-            if entry.startswith(f"{name}_a"):
-                shutil.rmtree(
-                    os.path.join(ddir, entry), ignore_errors=True
-                )
+        self.transport.delete(CHUNKS_DIR, name)
+        self.transport.delete_prefix(DISPATCH_DIR, f"{name}_a")
 
     # -- persistence / teardown ------------------------------------------
 
@@ -250,6 +260,8 @@ class FleetCoordinator:
 
     def shutdown(self, reason: str = "clean finish") -> None:
         self.registry.shutdown(reason)
+        if self.hub is not None:
+            self.hub.close()
 
     def stats_summary(self) -> Dict[str, Any]:
         return {

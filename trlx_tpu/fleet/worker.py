@@ -56,10 +56,16 @@ class FleetWorker:
         cfg: FleetConfig,
         worker_id: Optional[str] = None,
         max_chunks: Optional[int] = None,
+        transport=None,
     ):
+        from trlx_tpu.exp.net import make_transport
+
         self.trainer = trainer
         self.root = root
         self.cfg = cfg
+        # chunk assignment/delivery messaging (exp/net.py): must be the
+        # SAME backend the learner's coordinator built
+        self.transport = transport or make_transport(cfg.transport, root)
         self.worker_id = worker_id or f"worker-{os.getpid()}"
         self.max_chunks = max_chunks
         self.broadcast = WeightBroadcast(
@@ -156,24 +162,30 @@ class FleetWorker:
     # -- assignments ------------------------------------------------------
 
     def _scan_assignments(self) -> List[str]:
-        ddir = os.path.join(self.root, DISPATCH_DIR)
         try:
-            entries = sorted(os.listdir(ddir))
-        except OSError:
+            entries = self.transport.list(DISPATCH_DIR)
+        except (OSError, ConnectionError):
             return []
         out = []
         for entry in entries:
-            # ".tmp_" entries are half-committed message dirs mid-write
-            # (serde.commit_message_dir renames them in when complete)
-            if entry.startswith(".") or ".tmp" in entry or "_a" not in entry:
+            if "_a" not in entry:
                 continue
             chunk = entry.rsplit("_a", 1)[0]
-            if entry in self._done or os.path.isdir(
-                os.path.join(self.root, CHUNKS_DIR, chunk)
-            ):
+            if entry in self._done or self._delivered(chunk):
                 continue
             out.append(entry)
         return out
+
+    def _delivered(self, chunk: str) -> bool:
+        try:
+            return (
+                self.transport.get_meta(
+                    CHUNKS_DIR, chunk, meta_name="chunk.json"
+                )
+                is not None
+            )
+        except (OSError, ConnectionError):
+            return False
 
     def _next_assignment(self):
         """The oldest undelivered assignment addressed to this worker
@@ -187,14 +199,24 @@ class FleetWorker:
                 best[chunk] = entry
         for chunk in sorted(best):
             entry = best[chunk]
-            ddir = os.path.join(self.root, DISPATCH_DIR, entry)
-            # route on the meta alone — N idle workers polling every
-            # fraction of a second must not each load every in-flight
-            # assignment's full prompt arrays off the shared filesystem
-            meta = serde.read_message_meta(ddir, meta_name="assignment.json")
-            if meta is None or meta.get("worker") != self.worker_id:
-                continue
-            msg = serde.read_message_dir(ddir, meta_name="assignment.json")
+            try:
+                # route on the meta alone — N idle workers polling every
+                # fraction of a second must not each load every
+                # in-flight assignment's full prompt arrays off the
+                # transport
+                meta = self.transport.get_meta(
+                    DISPATCH_DIR, entry, meta_name="assignment.json"
+                )
+                if meta is None or meta.get("worker") != self.worker_id:
+                    continue
+                msg = self.transport.get(
+                    DISPATCH_DIR, entry, meta_name="assignment.json"
+                )
+            except (OSError, ConnectionError):
+                # transient transport outage (tcp hub restart, shared-fs
+                # hiccup): the next poll tick retries — a worker must
+                # not die for a blip the scan path already tolerates
+                return None
             if msg is not None:
                 return msg
         return None
@@ -237,11 +259,9 @@ class FleetWorker:
         rollout_batch, rows_local = t._score_and_assemble(
             batch, gen_out, stats, iter_count, Clock()
         )
-        delivered = serde.commit_message_dir(
-            os.path.join(
-                self.root, CHUNKS_DIR,
-                f"e{chunk_id[0]}_s{chunk_id[1]}",
-            ),
+        delivered = self.transport.put(
+            CHUNKS_DIR,
+            f"e{chunk_id[0]}_s{chunk_id[1]}",
             {
                 "chunk_id": list(chunk_id),
                 "policy_version": int(self._held_version or 0),

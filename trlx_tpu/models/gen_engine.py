@@ -202,6 +202,10 @@ def engine_generate(
     spec: EngineSpec,
     draft_params: Optional[Dict] = None,
     row_budget: Optional[Array] = None,  # [Q] per-row max_new (<= N)
+    warm: Optional[Dict[str, Array]] = None,
+    q_pin: Optional[Array] = None,  # [Q] bool: keep pages at finish
+    q_ready: Optional[Array] = None,  # [Q] page-aligned shared prefix len
+    q_rng_row: Optional[Array] = None,  # [Q] per-row RNG id base
 ) -> Dict[str, Array]:
     """Generate a continuation for every queue row through the engine.
 
@@ -214,6 +218,29 @@ def engine_generate(
     reclaimed_pages (prompt-pad compaction: pages holding nothing but
     left-pad KV, released back to the free stack at refill), and in
     speculative mode drafted / accepted / spec_rounds.
+
+    Serving mode (``warm`` given — the trlx_tpu/serve/ tier): the call
+    enters with a PERSISTENT page pool instead of a fresh one.
+    ``warm`` carries ``pool`` (pre-populated leaves), ``free``/``ntop``
+    (the host's free stack, minus every page a cached prefix/session
+    entry holds), ``refcnt`` (per-page counts, paged_kv.init_refcounts
+    contract) and ``row_table`` [Q, MP] (each row's shared-page
+    mapping; entries past ``q_ready[q] // page_size`` must be 0).
+    A row with ``q_ready[q] = A`` has its first A slot positions
+    already present in shared pages: refill maps those pages
+    read-only, pops fresh pages only for the rest, and the prefill
+    scatter is gated off positions < A (copy-on-write: the divergent
+    suffix always lands in the row's own pages). Rows with
+    ``q_pin[q]`` keep ALL their pages at finish — the final table row
+    and KV length come back in ``kv_state.saved_tables`` /
+    ``saved_len`` for the host to adopt into its session/prefix cache
+    — and are counted in ``gen_stats.pinned_pages``, NEVER in
+    ``reclaimed_pages`` or ``oom_truncated`` (a pin is a normal
+    finish, not a truncation, and the pages are alive, not reclaimed).
+    ``q_rng_row`` replaces the queue index in the RNG id space so a
+    request's sampled stream is invariant to which call/batch serves
+    it. The output gains ``kv_state`` = the end-of-call pool + free
+    stack + refcounts for the host to carry into the next call.
     """
     Q, P = q_ids.shape
     N = settings.max_new_tokens
@@ -245,6 +272,21 @@ def engine_generate(
     pad = jnp.int32(settings.pad_token_id)
     if spec.spec_decode and draft_params is None:
         raise ValueError("spec_decode needs draft_params (the reference)")
+    serving = warm is not None
+    if serving:
+        if not spec.paged:
+            raise ValueError("serving (warm pool) requires spec.paged")
+        if spec.spec_decode:
+            raise ValueError(
+                "serving (warm pool) does not compose with spec_decode "
+                "in v1 (the draft pool has no shared-page story yet)"
+            )
+        if q_pin is None:
+            q_pin = jnp.zeros((Q,), bool)
+        q_pin = q_pin.astype(bool)
+        if q_ready is None:
+            q_ready = jnp.zeros((Q,), jnp.int32)
+        q_ready = q_ready.astype(jnp.int32)
 
     params = cast_params_for_decode(params, cfg.dtype)
     from trlx_tpu.parallel.sharding import unshard_for_decode
@@ -275,6 +317,14 @@ def engine_generate(
     OFF_ACC = (Q + 1) * N
     OFF_RES = 2 * (Q + 1) * N
 
+    def _rng_ids(ix: Array) -> Array:
+        """RNG id base per queue row: the queue index by default; the
+        caller-supplied per-request id in serving mode, so a request's
+        sampled stream is invariant to batch composition across calls."""
+        if q_rng_row is None:
+            return ix
+        return q_rng_row.astype(jnp.int32)[jnp.clip(ix, 0, Q - 1)]
+
     # pallas prefill wants a 128-aligned temp cache + 8-row-aligned
     # queries, mirroring generate()'s gate; otherwise it falls back to
     # XLA inside the same code path
@@ -285,21 +335,32 @@ def engine_generate(
         return base
 
     def _init_state() -> Dict[str, Any]:
-        pool = paged_kv.init_pool(
-            cfg.n_layer, NP, PS, cfg.n_kv_head, cfg.head_dim, quant, cfg.dtype
-        )
-        state: Dict[str, Any] = {"pool": pool}
-        if spec.spec_decode:
-            state["dpool"] = paged_kv.init_pool(
+        if serving:
+            state: Dict[str, Any] = {"pool": dict(warm["pool"])}
+            state["free"] = warm["free"]
+            state["ntop"] = warm["ntop"].astype(jnp.int32)
+            state["refcnt"] = warm["refcnt"].astype(jnp.int32)
+            state["table"] = jnp.zeros((SLOTS, MP), jnp.int32)
+            state["saved_tables"] = jnp.zeros((Q, MP), jnp.int32)
+            state["saved_len"] = jnp.zeros((Q,), jnp.int32)
+            state["pinned"] = jnp.int32(0)
+        else:
+            pool = paged_kv.init_pool(
                 cfg.n_layer, NP, PS, cfg.n_kv_head, cfg.head_dim, quant,
                 cfg.dtype,
             )
-        if spec.paged:
-            free, ntop = paged_kv.init_alloc(NP)
-            state["free"], state["ntop"] = free, ntop
-            state["table"] = jnp.zeros((SLOTS, MP), jnp.int32)
-        else:
-            state["table"] = _contig_table()
+            state = {"pool": pool}
+            if spec.spec_decode:
+                state["dpool"] = paged_kv.init_pool(
+                    cfg.n_layer, NP, PS, cfg.n_kv_head, cfg.head_dim, quant,
+                    cfg.dtype,
+                )
+            if spec.paged:
+                free, ntop = paged_kv.init_alloc(NP)
+                state["free"], state["ntop"] = free, ntop
+                state["table"] = jnp.zeros((SLOTS, MP), jnp.int32)
+            else:
+                state["table"] = _contig_table()
         state.update(
             pos=jnp.zeros((SLOTS,), jnp.int32),
             npad=jnp.zeros((SLOTS,), jnp.int32),
@@ -337,9 +398,16 @@ def engine_generate(
             cache["contiguous"] = True
         return cache
 
-    def _prefill_into_slots(prms, pool, state, ids, mask, posns, slot, do):
+    def _prefill_into_slots(
+        prms, pool, state, ids, mask, posns, slot, do, ready=None
+    ):
         """Dense prefill of [R, P] prompts, scattered into `slot`'s
-        pages. Returns (pool, last_hidden [R, E])."""
+        pages. Returns (pool, last_hidden [R, E]). ``ready`` [R] gates
+        the scatter off slot positions < ready (serving: those
+        positions live in SHARED pages, already prefilled by the
+        request that created the cache entry — this v1 recomputes their
+        KV transiently in the temp cache but never writes it, which is
+        what makes the shared pages safely read-only)."""
         key_mask = jnp.concatenate(
             [mask, jnp.zeros((R, Pc - P), jnp.int32)], axis=1
         ) if Pc != P else mask
@@ -354,6 +422,10 @@ def engine_generate(
             jnp.arange(P, dtype=jnp.int32)[None, :], (R, P)
         )
         pids, offs = paged_kv.write_positions(tbl, prompt_pos, PS, lane_valid=do)
+        if ready is not None:
+            # copy-on-write boundary: shared positions route to the
+            # null page (their KV is already in the shared pages)
+            pids = jnp.where(prompt_pos < ready[:, None], 0, pids)
         if quant == "int8":
             kq, ks = paged_kv.quantize_rows(ck)
             vq, vs = paged_kv.quantize_rows(cv)
@@ -393,6 +465,11 @@ def engine_generate(
         ids = q_ids[qc]
         mask = q_mask[qc]
 
+        ready_r = None
+        ready_pg = None
+        if serving:
+            ready_r = jnp.where(do, q_ready[qc], 0)
+            ready_pg = ready_r // PS
         if spec.paged:
             # return the refilled slots' old pages, then allocate fresh
             # prompt pages (often the very pages just freed)
@@ -402,17 +479,32 @@ def engine_generate(
                 jnp.repeat(do, MP),
             )
             table = state["table"].at[slot].set(0, mode="drop")
-            got, free, ntop = paged_kv.pop_pages(
-                free, ntop, jnp.repeat(do, PP)
+            pgrid_pp = jnp.arange(PP, dtype=jnp.int32)[None, :]
+            if serving:
+                # pop fresh pages only for the NON-shared prompt part;
+                # the shared prefix maps the cache entry's pages
+                want = do[:, None] & (pgrid_pp >= ready_pg[:, None])
+                got, free, ntop = paged_kv.pop_pages(
+                    free, ntop, want.reshape(-1)
+                )
+                shared = warm["row_table"][qc][:, :PP]
+                entries = jnp.where(
+                    pgrid_pp < ready_pg[:, None], shared, got.reshape(R, PP)
+                )
+            else:
+                got, free, ntop = paged_kv.pop_pages(
+                    free, ntop, jnp.repeat(do, PP)
+                )
+                entries = got.reshape(R, PP)
+            table = table.at[slot[:, None], pgrid_pp].set(
+                entries, mode="drop"
             )
-            table = table.at[
-                slot[:, None], jnp.arange(PP, dtype=jnp.int32)[None, :]
-            ].set(got.reshape(R, PP), mode="drop")
             state = dict(state, free=free, ntop=ntop, table=table)
 
         posns = jnp.maximum(jnp.cumsum(mask, axis=1) - 1, 0)
         pool, h_last = _prefill_into_slots(
-            params, state["pool"], state, ids, mask, posns, slot, do
+            params, state["pool"], state, ids, mask, posns, slot, do,
+            ready=ready_r,
         )
         state = dict(state, pool=pool)
         if spec.spec_decode:
@@ -422,35 +514,47 @@ def engine_generate(
             state = dict(state, dpool=dpool)
 
         if spec.paged:
-            # prompt-pad page COMPACTION: a LEFT-padded prompt's leading
-            # pages can hold nothing but pad KV (every position in them
-            # sits below npad, so its kmask bit is 0 forever) — dead
-            # weight parked on the lane from refill to finish. Release
-            # them right after prefill: reads of those positions gather
-            # the null page under a zero key mask, and neither prefill
-            # (done) nor decode (writes only at >= P) ever touches them
-            # again. This lowers the engine's HBM floor on ragged
-            # prompt mixes — the pool only has to hold REAL tokens plus
-            # page-rounding, not the pad overhang of the widest prompt.
-            npad_r = P - mask.sum(axis=1).astype(jnp.int32)
-            dead = jnp.minimum(npad_r // PS, PP)
+            # prompt-pad page COMPACTION: a prompt page holding nothing
+            # but pad KV (every position in it has mask 0, so its kmask
+            # bit is 0 forever) is dead weight parked on the lane from
+            # refill to finish. Release such pages right after prefill:
+            # reads of those positions gather the null page under a
+            # zero key mask, and neither prefill (done) nor decode
+            # (writes only at >= P) ever touches them again. This
+            # lowers the engine's HBM floor on ragged prompt mixes —
+            # the pool only has to hold REAL tokens plus page-rounding,
+            # not the pad overhang of the widest prompt. Detection is
+            # per-page over the mask (covers the leading left-pad block
+            # AND the serving tier's internal pad gap between a shared
+            # prefix and the divergent suffix); shared-prefix entries
+            # (< ready_pg) are never candidates — their pages belong to
+            # the cache, not this lane.
+            mask_pp = jnp.concatenate(
+                [mask, jnp.zeros((R, PP * PS - P), jnp.int32)], axis=1
+            ) if PP * PS != P else mask
+            page_has_real = mask_pp.reshape(R, PP, PS).sum(axis=2) > 0
             pgrid = jnp.arange(PP, dtype=jnp.int32)[None, :]
-            is_dead = (pgrid < dead[:, None]) & do[:, None]  # [R, PP]
+            is_dead = ~page_has_real & do[:, None]  # [R, PP]
+            if serving:
+                is_dead = is_dead & (pgrid >= ready_pg[:, None])
             rows_tbl = state["table"][jnp.clip(slot, 0, SLOTS - 1)][:, :PP]
+            # the freed pages are this refill's own fresh pops (never a
+            # cache entry's), so the refcount-free push is exact
             free, ntop = paged_kv.push_free(
                 state["free"], state["ntop"], rows_tbl.reshape(-1),
-                is_dead.reshape(-1),
+                (is_dead & (rows_tbl > 0)).reshape(-1),
             )
+            reclaimed_now = (is_dead & (rows_tbl > 0)).sum().astype(jnp.int32)
             table = state["table"].at[slot[:, None], pgrid].set(
                 jnp.where(is_dead, 0, rows_tbl), mode="drop"
             )
             state = dict(
                 state, free=free, ntop=ntop, table=table,
-                reclaimed=state["reclaimed"] + is_dead.sum().astype(jnp.int32),
+                reclaimed=state["reclaimed"] + reclaimed_now,
             )
 
         logits0 = logit_projection(params)(h_last)
-        keys0 = lane_keys(rng, qc * N)
+        keys0 = lane_keys(rng, _rng_ids(qc) * N)
         tok0 = sample_token_lanes(keys0, logits0, settings)
         bud = row_budget[qc]
         eos0 = tok0 == eos
@@ -496,10 +600,43 @@ def engine_generate(
         """Return `lanes`' pages to the free stack the moment the lane
         finishes: a finished response's KV is dead, and reclaiming it
         immediately is what lets the refill gate (`ntop >= PP`) admit
-        the next prompt without a separate scavenging pass."""
+        the next prompt without a separate scavenging pass.
+
+        Serving mode: a PINNED lane (multi-turn session / a request
+        adopted into the prefix cache) keeps every page — its final
+        table row and KV length are saved for the host to adopt, and
+        its page count lands in the ``pinned_pages`` stat (a pin is a
+        normal finish: deliberately NOT counted as reclaimed or
+        truncated). Unpinned lanes release through the refcounted path,
+        so a shared prefix page only ever decrements down to the
+        cache's own hold."""
         if not spec.paged:
             return state
         rows = state["table"]
+        if serving:
+            pidx = jnp.clip(state["pidx"], 0, Q - 1)
+            pin = q_pin[pidx] & lanes
+            wrow = jnp.where(pin, state["pidx"], Q)
+            saved_tables = state["saved_tables"].at[wrow].set(
+                rows, mode="drop"
+            )
+            saved_len = state["saved_len"].at[wrow].set(
+                state["pos"], mode="drop"
+            )
+            pinned = state["pinned"] + (
+                (rows > 0) & pin[:, None]
+            ).sum().astype(jnp.int32)
+            release = lanes & ~pin
+            free, ntop, refcnt = paged_kv.release_refcounted(
+                state["free"], state["ntop"], state["refcnt"],
+                rows.reshape(-1), jnp.repeat(release, MP),
+            )
+            return dict(
+                state, free=free, ntop=ntop, refcnt=refcnt,
+                table=jnp.where(lanes[:, None], 0, rows),
+                saved_tables=saved_tables, saved_len=saved_len,
+                pinned=pinned,
+            )
         free, ntop = paged_kv.push_free(
             state["free"], state["ntop"], rows.reshape(-1),
             jnp.repeat(lanes, MP),
@@ -551,7 +688,7 @@ def engine_generate(
             if k in out["cache"]
         }
         j = jnp.clip(state["new"], 0, N - 1)
-        keys = lane_keys(rng, state["pidx"] * N + j)
+        keys = lane_keys(rng, _rng_ids(state["pidx"]) * N + j)
         tok = sample_token_lanes(keys, out["logits"][:, -1], settings)
         eos_hit = tok == eos
         budget_hit = state["new"] + 1 >= state["budget"]
@@ -602,7 +739,9 @@ def engine_generate(
                 if k in out["cache"]
             }
             ql = process_logits(out["logits"][:, -1], settings)
-            keys = lane_keys(rng, state["pidx"] * N + state["new"] + j)
+            keys = lane_keys(
+                rng, _rng_ids(state["pidx"]) * N + state["new"] + j
+            )
             if settings.do_sample:
                 g = jax.vmap(lambda k2: jax.random.gumbel(k2, (ql.shape[-1],)))(
                     keys
@@ -651,7 +790,8 @@ def engine_generate(
             qj = qprobs[j]
             if settings.do_sample:
                 ukeys = lane_keys(
-                    rng, OFF_ACC + state["pidx"] * N + state["new"] + j
+                    rng,
+                    OFF_ACC + _rng_ids(state["pidx"]) * N + state["new"] + j,
                 )
                 u = jax.vmap(lambda k2: jax.random.uniform(k2, ()))(ukeys)
                 px = jnp.take_along_axis(pj, xj[:, None], axis=1)[:, 0]
@@ -661,7 +801,8 @@ def engine_generate(
                 rs = res.sum(axis=-1, keepdims=True)
                 res = jnp.where(rs > 1e-12, res / jnp.maximum(rs, 1e-30), pj)
                 rkeys = lane_keys(
-                    rng, OFF_RES + state["pidx"] * N + state["new"] + j
+                    rng,
+                    OFF_RES + _rng_ids(state["pidx"]) * N + state["new"] + j,
                 )
                 tok_rej = categorical_lanes(rkeys, res)
             else:
@@ -757,12 +898,26 @@ def engine_generate(
             drafted=final["drafted"],
             accepted=final["accepted"],
         )
-    return {
+    out = {
         "sequences": jnp.concatenate([q_ids, resp_ids], axis=1),
         "response_ids": resp_ids,
         "response_mask": final["resp_mask"],
         "gen_stats": stats,
     }
+    if serving:
+        stats["pinned_pages"] = final["pinned"]
+        stats["free_pages"] = final["ntop"]
+        # the persistent pool state the serving host carries into the
+        # next call (plus per-row pin adoptions)
+        out["kv_state"] = {
+            "pool": final["pool"],
+            "free": final["free"],
+            "ntop": final["ntop"],
+            "refcnt": final["refcnt"],
+            "saved_tables": final["saved_tables"],
+            "saved_len": final["saved_len"],
+        }
+    return out
 
 
 def make_engine_fn(

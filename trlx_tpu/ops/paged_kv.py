@@ -11,7 +11,12 @@ int8 by default) plus a per-slot page table, so
     (a row that stops at 10 tokens never touches its other pages),
   * a refilled slot (continuous batching, models/gen_engine.py) returns
     its pages to a free stack and the next prompt reuses them,
-  * the pool is sized to expected LIVE tokens, not slots × max length.
+  * the pool is sized to expected LIVE tokens, not slots × max length,
+  * pages can carry REFERENCE COUNTS (init_refcounts /
+    release_refcounted) so the serving tier's shared system-prompt
+    prefixes and pinned multi-turn sessions keep their pages alive
+    across requests and engine calls; free-at-finish is the
+    refcount-zero degenerate case and stays the training-path default.
 
 Quantization is symmetric per-(slot, kv-head) over the D axis for BOTH
 K and V (the same `_quantize_kv` formula the dense int8 cache applies
@@ -124,6 +129,43 @@ def pop_pages(
     ids = free[src] * ((want > 0) & have)
     taken = ((want > 0) & have).sum(dtype=jnp.int32)
     return ids.astype(jnp.int32), free, ntop - taken
+
+
+def init_refcounts(n_pages: int) -> Array:
+    """Per-page reference counts, all zero. The serving tier
+    (trlx_tpu/serve/) is the count authority: before an engine call it
+    sets ``refcnt[p] = 1 + (#queue rows mapping p)`` for every page a
+    cached prefix/session entry holds, so in-call releases can only
+    ever decrement a shared page down to the cache's own hold — never
+    onto the free stack. Engine-allocated (unshared) pages stay at 0
+    and free exactly like the refcount-free path."""
+    return jnp.zeros((n_pages,), jnp.int32)
+
+
+def release_refcounted(
+    free: Array, ntop: Array, refcnt: Array, pages: Array, is_real: Array
+) -> Tuple[Array, Array, Array]:
+    """Refcount-aware page release: decrement each released page's
+    count once; pages at (or already below) zero after the decrement
+    return to the free stack in input order, exactly like
+    :func:`push_free`.
+
+    ``pages`` [M] int32 with duplicates allowed for SHARED pages only
+    (two lanes sharing a prefix finishing in the same event): the
+    caller's invariant — count >= 1 + (#rows mapping the page) at call
+    entry — guarantees a duplicated page stays positive and is never
+    pushed twice. An unshared page (count 0) appears at most once by
+    allocator construction, so the single push cannot double-free.
+    Returns (free, ntop, refcnt)."""
+    is_real = is_real & (pages > 0)
+    dec = is_real.astype(jnp.int32)
+    # scatter-add the decrements (dup-safe); non-real entries route to
+    # the reserved null page 0 with a zero decrement
+    safe = jnp.where(is_real, pages, 0)
+    refcnt = refcnt.at[safe].add(-dec)
+    freed = is_real & (refcnt[pages] <= 0)
+    free, ntop = push_free(free, ntop, pages, freed)
+    return free, ntop, jnp.maximum(refcnt, 0)
 
 
 def quantize_rows(x: Array) -> Tuple[Array, Array]:

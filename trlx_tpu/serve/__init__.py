@@ -1,0 +1,29 @@
+"""Live-traffic serving tier (``train.serve.*``).
+
+External generation requests enter the SAME continuous-batching decode
+engine that produces training rollouts (models/gen_engine.py), on the
+same live policy weights — "train and serve the same model" with the
+staleness machinery already solved by the versioned weight broadcast
+(the serving frontend always samples the learner's current params, so
+its staleness is zero by construction).
+
+Pieces:
+
+  config.py     ``ServeConfig`` parsed from the ``train.serve`` dict.
+  request.py    request/result wire records + RNG row derivation.
+  kv.py         the host-side refcounted page ledger behind the
+                prefix/session KV cache (the engine's device half is
+                ops/paged_kv.py refcounts + gen_engine warm pools).
+  scheduler.py  SLO admission: EDF ordering, deadline eviction,
+                training/serving starvation accounting.
+  frontend.py   the orchestrator a trainer ticks at its lane-refill
+                decision points.
+  client.py     submit/await over any exp/net.py transport backend.
+
+Runbook: docs/serving.md.
+"""
+
+from trlx_tpu.serve.config import ServeConfig
+from trlx_tpu.serve.request import ServeRequest, ServeResult
+
+__all__ = ["ServeConfig", "ServeRequest", "ServeResult"]

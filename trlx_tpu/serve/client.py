@@ -1,0 +1,74 @@
+"""Serve client: submit requests / await results over any exp/net.py
+transport backend.
+
+The client is transport-symmetric with the frontend: pass the
+``transport_spec`` the frontend advertises (shared_fs root for
+same-filesystem callers, tcp host/port to cross a machine). Submission
+is idempotent by request id — a retried submit of the same rid dedups
+at the transport — and the result poll is a plain bounded wait, so a
+client can always be restarted without double-serving a request.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from trlx_tpu.exp.net import make_transport
+from trlx_tpu.serve.request import (
+    REQUESTS_TOPIC,
+    RESULTS_TOPIC,
+    ServeRequest,
+    ServeResult,
+)
+
+
+class ServeClient:
+    def __init__(self, transport_spec: Dict[str, Any]):
+        self.transport = make_transport(dict(transport_spec), ".")
+
+    def submit(
+        self,
+        prompt_ids: List[int],
+        max_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        prefix_ids: Optional[List[int]] = None,
+        session_id: Optional[str] = None,
+        rid: Optional[str] = None,
+    ) -> str:
+        rid = rid or uuid.uuid4().hex[:12]
+        req = ServeRequest(
+            rid=rid, prompt_ids=list(prompt_ids), max_tokens=max_tokens,
+            deadline_s=deadline_s, prefix_ids=list(prefix_ids or []),
+            session_id=session_id,
+        )
+        self.transport.put(REQUESTS_TOPIC, rid, req.to_meta())
+        return rid
+
+    def result(
+        self, rid: str, timeout_s: float = 60.0, poll_s: float = 0.05
+    ) -> Optional[ServeResult]:
+        """Poll for the result; None on timeout (the request may still
+        complete later — poll again or treat as an SLO miss). A picked-
+        up result is deleted from the transport: the frontend's bounded
+        retention is the backstop, not the steady state."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            meta = self.transport.get_meta(RESULTS_TOPIC, rid)
+            if meta is not None:
+                self.transport.delete(RESULTS_TOPIC, rid)
+                return ServeResult.from_meta(meta)
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(poll_s)
+
+    def request_and_wait(self, prompt_ids: List[int], **kw) -> ServeResult:
+        timeout_s = kw.pop("timeout_s", 120.0)
+        rid = self.submit(prompt_ids, **kw)
+        res = self.result(rid, timeout_s=timeout_s)
+        if res is None:
+            raise TimeoutError(
+                f"serve client: no result for {rid} within {timeout_s}s"
+            )
+        return res

@@ -1,0 +1,118 @@
+"""Parsed ``train.serve`` section (plain dict in YAML; host-only)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """``train.serve.*`` knobs (default off).
+
+    enabled            master switch: the trainer builds a serving
+                       frontend at learn() start and ticks it at the
+                       lane-refill decision points (rollout chunk
+                       boundaries + once per optimization cycle).
+                       Serving runs SEPARATE engine calls on the live
+                       policy params with its own RNG/pool, so the
+                       training loss stream stays bit-equal to a
+                       no-serving run by construction.
+    max_batch          queue rows per serve engine call (one compiled
+                       executable; short ticks pad with dummy rows).
+    slots              decode lanes per call; 0 = max_batch.
+    page_size          KV page size of the PERSISTENT serve pool.
+    pool_pages         pages in the serve pool; 0 = worst case for
+                       max_batch rows (no headroom for cached
+                       prefixes/sessions — size it up to actually
+                       cache).
+    max_prompt_len     serve row width (prompt + session history
+                       budget). Requests longer than this are rejected
+                       with an ``error`` result, never wedged.
+    max_new_tokens     hard cap on a request's ``max_tokens`` (the
+                       engine's N; also the per-request ``row_budget``
+                       ceiling).
+    default_max_tokens when a request omits ``max_tokens``.
+    default_deadline_s when a request omits ``deadline_s`` (relative
+                       to arrival at the frontend).
+    kv_quant           "int8" | "none" | null (null follows the
+                       model's kv_cache_quant, like the rollout
+                       engine).
+    max_batches_per_tick  serve batches one tick may run before
+                       handing the lanes back to training — the bound
+                       that makes "serving outranks training refills"
+                       a priority, not a wedge.
+    starvation_report_after  consecutive full-allowance ticks (with
+                       requests still pending) before the frontend
+                       loudly reports a starved training loop; and
+                       consecutive starved ticks (no lane capacity —
+                       chaos ``serve_lane_starvation``) before it
+                       reports starved serving.
+    prefix_cache       share page-aligned system-prompt prefixes
+                       across requests (refcounted; prefilled once by
+                       the pioneering request).
+    sessions           pin multi-turn sessions' pages across turns.
+    session_deadline_s idle seconds before a session's pinned pages
+                       are evicted (deadline eviction reclaims them).
+    max_cache_entries  prefix + session entries kept before LRU
+                       eviction of refcount-zero entries.
+    transport          request/response backend (exp/net.py spec):
+                       ``{}`` = shared_fs under
+                       ``<train.checkpoint_dir>/serve``; ``{backend:
+                       tcp, port: N}`` makes the frontend host a
+                       socket hub (port 0 = ephemeral) so clients
+                       cross a machine boundary.
+    seed               serving RNG seed (independent of the training
+                       stream — serving must never touch the
+                       trainer's key chain).
+    """
+
+    enabled: bool = False
+    max_batch: int = 4
+    slots: int = 0
+    page_size: int = 64
+    pool_pages: int = 0
+    max_prompt_len: int = 128
+    max_new_tokens: int = 32
+    default_max_tokens: int = 32
+    default_deadline_s: float = 120.0
+    kv_quant: Optional[str] = None
+    max_batches_per_tick: int = 1
+    starvation_report_after: int = 8
+    prefix_cache: bool = True
+    sessions: bool = True
+    session_deadline_s: float = 600.0
+    max_cache_entries: int = 32
+    transport: Optional[Dict[str, Any]] = None
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ServeConfig":
+        d = dict(d or {})
+        known = set(cls.__dataclass_fields__)
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"train.serve: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        cfg = cls(**d)
+        if cfg.max_batch < 1:
+            raise ValueError("train.serve.max_batch must be >= 1")
+        if cfg.page_size < 1:
+            raise ValueError("train.serve.page_size must be >= 1")
+        if cfg.max_new_tokens < 1:
+            raise ValueError("train.serve.max_new_tokens must be >= 1")
+        if cfg.max_prompt_len < 2:
+            raise ValueError("train.serve.max_prompt_len must be >= 2")
+        if cfg.default_max_tokens > cfg.max_new_tokens:
+            raise ValueError(
+                "train.serve.default_max_tokens exceeds max_new_tokens"
+            )
+        if cfg.kv_quant not in (None, "none", "int8"):
+            raise ValueError(
+                f"train.serve.kv_quant must be none/int8, got {cfg.kv_quant!r}"
+            )
+        if cfg.max_batches_per_tick < 1:
+            raise ValueError("train.serve.max_batches_per_tick must be >= 1")
+        return cfg

@@ -1,0 +1,750 @@
+"""The serving frontend: transport intake -> SLO scheduler -> engine.
+
+One instance lives on the learner and is TICKED by the trainer at its
+lane-refill decision points (rollout chunk boundaries + once per
+optimization cycle). A tick drains newly-arrived requests from the
+transport, evicts the deadline-expired, and runs up to
+``serve.max_batches_per_tick`` engine batches on the LIVE policy params
+— serving requests outrank the next training refill, training backfills
+the lanes the moment the allowance is spent, and a starved side (either
+one) is reported, never wedged.
+
+Isolation contract: serving owns its rng (``serve.seed``), its page
+pool, and its engine executables. It reads ``trainer.params`` and
+touches NOTHING else — which is why the training loss stream is
+bit-equal to a no-serving run by construction (pinned by
+tests/test_serve.py and the chaos serving leg).
+
+The timing ledger is honest about v1 granularity: a request's whole
+decode runs inside one engine dispatch, so TTFT == request latency
+here; ``queue_wait_s`` and the batch-level per-token decode rate are
+reported separately. Segmented decode (the session machinery already
+carries KV across calls) is the follow-up that separates them.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from trlx_tpu.serve import kv as skv
+from trlx_tpu.serve.config import ServeConfig
+from trlx_tpu.serve.request import (
+    CANCELLED,
+    ERROR,
+    OK,
+    REQUESTS_TOPIC,
+    RESULTS_TOPIC,
+    TIMEOUT,
+    ServeRequest,
+    ServeResult,
+    rng_row,
+)
+from trlx_tpu.serve.scheduler import Pending, SLOScheduler
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+class RowError(ValueError):
+    """A request that can never be served (too long, session overflow)."""
+
+
+class DeferRow(Exception):
+    """The request must wait for a later tick (a same-batch request
+    already holds its session — one in-flight turn per session)."""
+
+
+@dataclass
+class _RowCtx:
+    pend: Pending
+    ids: np.ndarray
+    mask: np.ndarray
+    budget: int
+    pin: bool
+    ready: int
+    rngrow: int
+    table_row: np.ndarray
+    entry_key: Optional[str] = None  # acquired entry to release
+    adopt_session: Optional[str] = None  # session key to adopt at finish
+    adopt_prefix: Optional[List[int]] = None  # pioneer's prefix ids
+    shared_pages: int = 0
+    note: str = ""  # surfaced in the result's detail
+
+
+@dataclass
+class _Record:
+    latency_s: float
+    queue_wait_s: float
+    decode_tok_s: float
+    deadline_met: bool
+
+
+class ServeFrontend:
+    """See module docstring. ``runner`` is the trainer-built jitted
+    engine entry: ``runner(q_ids, q_mask, rng, row_budget, warm, q_pin,
+    q_ready, q_rng_row) -> engine output`` (models/gen_engine.py
+    serving mode)."""
+
+    def __init__(
+        self,
+        cfg: ServeConfig,
+        runner: Callable[..., Dict[str, Any]],
+        geom: Dict[str, Any],
+        checkpoint_dir: str,
+        chaos=None,
+        obs=None,
+        clock: Callable[[], float] = time.time,
+    ):
+        import jax
+
+        from trlx_tpu.exp import net
+        from trlx_tpu.ops import paged_kv
+
+        self.cfg = cfg
+        self.runner = runner
+        self.chaos = chaos
+        self.obs = obs
+        self._clock = clock
+        # engine geometry (must match the spec the runner was traced
+        # with): P row width, N budget ceiling, PS page size, NP pool
+        self.P = int(geom["P"])
+        self.N = int(geom["N"])
+        self.PS = int(geom["page_size"])
+        self.MP = paged_kv.pages_per_slot(self.P, self.N, self.PS)
+        self.NP = int(geom["pool_pages"])
+        self.PP = -(-self.P // self.PS)
+        self.pad_id = int(geom["pad_token_id"])
+        # the persistent serve pool (device) + its host ledger
+        self.pool = paged_kv.init_pool(
+            geom["n_layer"], self.NP, self.PS, geom["n_kv_head"],
+            geom["head_dim"], geom["kv_quant"], geom["dtype"],
+        )
+        self.ledger = skv.PageLedger(self.NP, self.PS)
+        self.sched = SLOScheduler(cfg.default_deadline_s, cfg.max_batch)
+        # serving RNG: ONE fixed base key — the engine folds the
+        # per-request rng_row in, so a request's stream depends only on
+        # (serve.seed, request id), never on batch composition or which
+        # tick served it
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        # transport: hosts the hub on the tcp backend; clients connect
+        # with `transport_spec`. Spec parsing/validation lives in
+        # exp/net.py (a typo'd backend fails loudly, never a silent
+        # shared-fs fallback).
+        import os
+
+        self.hub, self.transport, self.transport_spec = (
+            net.make_server_transport(
+                cfg.transport, os.path.join(checkpoint_dir, "serve")
+            )
+        )
+        # bounded intake/result bookkeeping: a long-lived frontend must
+        # not grow without bound with the request count. _seen only has
+        # to cover the list->get->delete race window; posted results
+        # are retained on the transport for a bounded tail (clients
+        # also delete their result on pickup — see ServeClient.result)
+        self._seen: "OrderedDict[str, None]" = OrderedDict()
+        self._posted: deque = deque()
+        self._result_queue: List[ServeResult] = []
+        self._records: List[_Record] = []
+        self._gen_stats: Dict[str, float] = {}
+        self.stats: Dict[str, Any] = {
+            "ticks": 0,
+            "batches": 0,
+            "completed": 0,
+            "errors": 0,
+            "cancelled": 0,
+            "deadline_missed": 0,
+            "transport_drops": 0,
+            "starvation_reports": 0,
+        }
+        logger.info(
+            "serve frontend up: P=%d N=%d page_size=%d pool_pages=%d "
+            "transport=%s", self.P, self.N, self.PS, self.NP,
+            self.transport_spec,
+        )
+
+    # -- intake ------------------------------------------------------------
+
+    def _poll_requests(self, now: float) -> None:
+        try:
+            names = self.transport.list(REQUESTS_TOPIC)
+        except (OSError, ConnectionError) as e:
+            logger.warning("serve: request poll failed (%s)", e)
+            return
+        for name in names:
+            if name in self._seen:
+                continue
+            try:
+                meta = self.transport.get_meta(REQUESTS_TOPIC, name)
+                if meta is None:
+                    continue
+                self.transport.delete(REQUESTS_TOPIC, name)
+            except (OSError, ConnectionError) as e:
+                # transient outage mid-intake: leave the request on the
+                # transport UNMARKED so the next tick retries it — a
+                # request must never be dropped by a blip
+                logger.warning(
+                    "serve: request intake of %r failed (%s) — retrying "
+                    "next tick", name, e,
+                )
+                continue
+            self._seen[name] = None
+            while len(self._seen) > 8192:
+                self._seen.popitem(last=False)
+            try:
+                req = ServeRequest.from_meta(meta)
+            except (KeyError, TypeError, ValueError) as e:
+                self._post(ServeResult(rid=name, status=ERROR,
+                                       detail=f"malformed request: {e}"))
+                continue
+            if self.chaos is not None and self.chaos.consult(
+                "serve_request_timeout"
+            ):
+                # chaos: the request spent its whole deadline in some
+                # upstream queue — it arrives already expired and must
+                # be evicted (pages reclaimed via the session sweep),
+                # never admitted
+                req.deadline_s = 0.0
+            self.sched.submit(req, now)
+
+    # -- tick --------------------------------------------------------------
+
+    def tick(self, step: int = 0) -> int:
+        """One lane-refill decision point. Returns batches run."""
+        now = self._clock()
+        self.stats["ticks"] += 1
+        self._poll_requests(now)
+        # deadline eviction: queued requests past their deadline, and
+        # idle sessions past theirs (reclaiming their pinned pages)
+        for pend in self.sched.expire(now):
+            self._post(ServeResult(
+                rid=pend.req.rid, status=TIMEOUT,
+                detail="deadline expired before service",
+                latency_s=now - pend.arrival_t,
+                session_id=pend.req.session_id,
+            ))
+        # a session with a turn already QUEUED must not lose its pinned
+        # history to the idle-deadline sweep out from under that turn
+        self.ledger.expire_deadlines(
+            now, skip=self.sched.pending_session_keys()
+        )
+        starved = self.chaos is not None and self.chaos.consult(
+            "serve_lane_starvation"
+        )
+        ran = 0
+        if not starved:
+            while ran < self.cfg.max_batches_per_tick:
+                batch = self.sched.pick(self._clock())
+                if not batch:
+                    break
+                self._run_batch(batch)
+                ran += 1
+        for report in self.sched.note_tick(
+            ran >= self.cfg.max_batches_per_tick, starved,
+            self.cfg.starvation_report_after,
+        ):
+            self.stats["starvation_reports"] += 1
+            logger.warning(
+                "serve: %s — %d requests pending after %d consecutive "
+                "ticks (%s)", report, self.sched.pending,
+                self.cfg.starvation_report_after,
+                "serving used its full lane allowance; training refills "
+                "are being deferred (bounded by max_batches_per_tick — "
+                "training proceeds, slower)"
+                if report == "training_starved" else
+                "no lane capacity reached serving; aging requests will "
+                "be deadline-evicted",
+            )
+            if self.obs is not None:
+                self.obs.record("serve_starvation", kind=report,
+                                pending=self.sched.pending, step=step)
+        self._flush_results()
+        return ran
+
+    # -- row construction --------------------------------------------------
+
+    def _compose(self, head_ids, head_mask, tail_ids, tail_mask):
+        """[head | pad gap | tail] at width P (the serve row layout:
+        internal pads between the shared/aligned head and the divergent
+        tail keep shared tokens at canonical slot positions while
+        cumsum-derived rotary positions stay those of the unpadded
+        conversation)."""
+        gap = self.P - len(head_ids) - len(tail_ids)
+        if gap < 0:
+            raise RowError(
+                f"request needs {len(head_ids) + len(tail_ids)} prompt "
+                f"slots, row width is {self.P} (train.serve.max_prompt_len)"
+            )
+        ids = np.concatenate([
+            np.asarray(head_ids, np.int32),
+            np.full(gap, self.pad_id, np.int32),
+            np.asarray(tail_ids, np.int32),
+        ])
+        mask = np.concatenate([
+            np.asarray(head_mask, np.int32),
+            np.zeros(gap, np.int32),
+            np.asarray(tail_mask, np.int32),
+        ])
+        return ids, mask
+
+    def _build_row(
+        self, pend: Pending, now: float, used_keys: set
+    ) -> _RowCtx:
+        req = pend.req
+        budget = min(
+            int(req.max_tokens or self.cfg.default_max_tokens), self.N
+        )
+        budget = max(budget, 1)
+        table_row = np.zeros(self.MP, np.int32)
+        rrow = rng_row(req.rid, self.N)
+        if not req.prompt_ids and not req.session_id:
+            raise RowError("empty prompt")
+
+        # -- multi-turn session continuation
+        if req.session_id and self.cfg.sessions:
+            key = skv.session_key(req.session_id)
+            if key in used_keys:
+                # one in-flight turn per session: a same-batch second
+                # turn would fork the pinned conversation
+                raise DeferRow()
+            used_keys.add(key)
+            entry = self.ledger.acquire(key, now)
+            if entry is not None:
+                tail_ids = list(entry.pending_ids) + list(req.prompt_ids)
+                tail_mask = list(entry.pending_mask) + [1] * len(
+                    req.prompt_ids
+                )
+                try:
+                    ids, mask = self._compose(
+                        entry.layout_ids, entry.layout_mask, tail_ids,
+                        tail_mask,
+                    )
+                except RowError:
+                    self.ledger.release(key)
+                    raise RowError(
+                        "session overflow: the pinned conversation plus "
+                        "the new turn no longer fits the serve row — end "
+                        "the session or raise max_prompt_len"
+                    )
+                npg = len(entry.pages)
+                table_row[:npg] = entry.pages
+                return _RowCtx(
+                    pend=pend, ids=ids, mask=mask, budget=budget, pin=True,
+                    ready=entry.kv_len, rngrow=rrow, table_row=table_row,
+                    entry_key=key, adopt_session=key,
+                    shared_pages=int((entry.pages > 0).sum()),
+                )
+            # new session: a plain (optionally prefix-shared) row,
+            # pinned. The note keeps history loss HONEST: a client that
+            # expected a continuation (entry deadline-evicted between
+            # turns) can see it was served without context
+            ctx = self._prefix_or_plain(
+                pend, budget, rrow, now, pin=True, used_keys=used_keys
+            )
+            ctx.adopt_session = key
+            ctx.note = "fresh session (no pinned history)"
+            return ctx
+
+        return self._prefix_or_plain(
+            pend, budget, rrow, now, pin=False, used_keys=used_keys
+        )
+
+    def _prefix_or_plain(
+        self, pend: Pending, budget: int, rrow: int, now: float, pin: bool,
+        used_keys: set,
+    ) -> _RowCtx:
+        req = pend.req
+        table_row = np.zeros(self.MP, np.int32)
+        prefix = list(req.prefix_ids or [])
+        A = skv.aligned_len(len(prefix), self.PS)
+        if self.cfg.prefix_cache and A >= self.PS:
+            key = skv.prefix_key(prefix)
+            entry = self.ledger.acquire(key, now)
+            if entry is not None:
+                try:
+                    ids, mask = self._compose(
+                        entry.layout_ids, entry.layout_mask,
+                        prefix[A:] + list(req.prompt_ids),
+                        [1] * (len(prefix) - A + len(req.prompt_ids)),
+                    )
+                except RowError:
+                    # over-long request: the acquired ref must not
+                    # outlive the row (a leaked ref would pin the
+                    # entry's pages against eviction forever)
+                    self.ledger.release(key)
+                    raise
+                npg = len(entry.pages)
+                table_row[:npg] = entry.pages
+                return _RowCtx(
+                    pend=pend, ids=ids, mask=mask, budget=budget, pin=pin,
+                    ready=entry.kv_len, rngrow=rrow, table_row=table_row,
+                    entry_key=key,
+                    shared_pages=int((entry.pages > 0).sum()),
+                )
+            if key not in used_keys:
+                # pioneer: prefix at canonical slots 0..Lp-1, pinned so
+                # the aligned pages can be adopted into the cache at
+                # finish. Only ONE pioneer per prefix per batch —
+                # same-batch peers run unshared below and share from
+                # the next batch on.
+                used_keys.add(key)
+                ids, mask = self._compose(
+                    prefix, [1] * len(prefix), list(req.prompt_ids),
+                    [1] * len(req.prompt_ids),
+                )
+                return _RowCtx(
+                    pend=pend, ids=ids, mask=mask, budget=budget,
+                    pin=True, ready=0, rngrow=rrow, table_row=table_row,
+                    adopt_prefix=prefix,
+                )
+        # plain: classic left-padded row
+        ids, mask = self._compose(
+            [], [], prefix + list(req.prompt_ids),
+            [1] * (len(prefix) + len(req.prompt_ids)),
+        )
+        return _RowCtx(
+            pend=pend, ids=ids, mask=mask, budget=budget, pin=pin,
+            ready=0, rngrow=rrow, table_row=table_row,
+        )
+
+    # -- the engine call ---------------------------------------------------
+
+    def _run_batch(self, batch: List[Pending]) -> None:
+        now = self._clock()
+        rows: List[_RowCtx] = []
+        used_keys: set = set()
+        deferred: List[Pending] = []
+        for pend in batch:
+            try:
+                rows.append(self._build_row(pend, now, used_keys))
+            except DeferRow:
+                deferred.append(pend)
+            except RowError as e:
+                self.stats["errors"] += 1
+                self._post(ServeResult(
+                    rid=pend.req.rid, status=ERROR, detail=str(e),
+                    latency_s=self._clock() - pend.arrival_t,
+                    session_id=pend.req.session_id,
+                ))
+        if deferred:
+            self.sched.requeue(deferred)
+        if not rows:
+            return
+        try:
+            self._dispatch_rows(rows)
+        except Exception:
+            # a failed batch (device error, transport hiccup mid-result)
+            # must not strand its requests: release every still-held
+            # cache ref and hand the requests back to the queue — they
+            # retry next tick, bounded by their own deadlines (a
+            # persistent failure degrades to deadline eviction, never a
+            # wedge or a leaked pin)
+            for c in rows:
+                if c.entry_key is not None:
+                    self.ledger.release(c.entry_key)
+                    c.entry_key = None
+            self.sched.requeue([c.pend for c in rows])
+            self.stats["batch_failures"] = (
+                self.stats.get("batch_failures", 0) + 1
+            )
+            raise
+
+    def _dispatch_rows(self, rows: List[_RowCtx]) -> None:
+        import jax.numpy as jnp
+
+        # pool pressure: make room for the batch's worst-case pages —
+        # prompt AND response (a lane can grow to MP pages through
+        # decode) — by LRU-evicting refcount-zero entries; a shortfall
+        # degrades to fewer admitted lanes inside the engine
+        # (oom-truncation, reported as an error result), never a
+        # deadlock
+        self.ledger.evict_for(
+            len(rows) * self.MP, self.cfg.max_cache_entries
+        )
+        Q = self.cfg.max_batch
+        ids = np.full((Q, self.P), self.pad_id, np.int32)
+        mask = np.zeros((Q, self.P), np.int32)
+        # dummy rows: one real token, budget 1 — finished at refill
+        ids[:, -1] = 0
+        mask[:, -1] = 1
+        budget = np.ones(Q, np.int32)
+        pin = np.zeros(Q, bool)
+        ready = np.zeros(Q, np.int32)
+        rngrow = np.zeros(Q, np.int32)
+        table = np.zeros((Q, self.MP), np.int32)
+        for i, c in enumerate(rows):
+            ids[i], mask[i] = c.ids, c.mask
+            budget[i] = c.budget
+            pin[i] = c.pin
+            ready[i] = c.ready
+            rngrow[i] = c.rngrow
+            table[i] = c.table_row
+        refcnt = self.ledger.compose_refcnt(
+            [c.table_row for c in rows if c.ready > 0]
+        )
+        warm = {
+            "pool": self.pool,
+            "free": jnp.asarray(self.ledger.free),
+            "ntop": jnp.int32(self.ledger.ntop),
+            "refcnt": jnp.asarray(refcnt),
+            "row_table": jnp.asarray(table),
+        }
+        t0 = self._clock()
+        out = self.runner(
+            jnp.asarray(ids), jnp.asarray(mask), self._rng,
+            jnp.asarray(budget), warm, jnp.asarray(pin),
+            jnp.asarray(ready), jnp.asarray(rngrow),
+        )
+        resp = np.asarray(out["response_ids"])
+        rmask = np.asarray(out["response_mask"])
+        kvs = out["kv_state"]
+        saved_t = np.asarray(kvs["saved_tables"])
+        saved_l = np.asarray(kvs["saved_len"])
+        wall = max(self._clock() - t0, 1e-9)
+        self.stats["batches"] += 1
+        g = {k: float(np.asarray(v)) for k, v in out["gen_stats"].items()}
+        # honest accounting: the batch is padded to max_batch with dummy
+        # lanes (1 emitted token each) — count only REAL requests'
+        # tokens, and drop the dummy-polluted ratios
+        real_toks = int(rmask[: len(rows)].sum())
+        g["real_tokens"] = float(real_toks)
+        g.pop("truncated", None)
+        g.pop("occupancy", None)
+        for k, v in g.items():
+            self._gen_stats[k] = self._gen_stats.get(k, 0.0) + v
+        # gauges, not counters: free_pages is the end-of-call stack
+        # depth; pinned_pages re-counts a session's whole page set
+        # every turn, so the accumulated sum is meaningless — keep the
+        # last call's value (current pinned residency lives in
+        # kv_held_pages in the summary)
+        self._gen_stats["free_pages"] = g.get("free_pages", 0.0)
+        self._gen_stats["pinned_pages"] = g.get("pinned_pages", 0.0)
+        # adopt the end-of-call pool + free stack
+        self.pool = kvs["pool"]
+        self.ledger.adopt_stack(np.asarray(kvs["free"]), int(kvs["ntop"]))
+        decode_tok_s = real_toks / wall
+        done = self._clock()
+        for i, c in enumerate(rows):
+            if c.entry_key is not None:
+                self.ledger.release(c.entry_key)
+                c.entry_key = None  # the failure handler must not re-release
+            n = int(rmask[i].sum())
+            if c.pin:
+                self._adopt_row(c, ids[i], mask[i], resp[i], n,
+                                saved_t[i], saved_l[i], done)
+            met = done <= c.pend.deadline_t
+            if not met:
+                self.stats["deadline_missed"] += 1
+            self.stats["completed"] += 1
+            if n == 0:
+                # the engine could not admit the lane at all (pool
+                # exhausted past what eviction could reclaim): an
+                # honest error beats a silent empty completion
+                self.stats["errors"] += 1
+            if n == 0:
+                parts = ["unserved: serve pool exhausted"]
+            else:
+                parts = [p for p in (
+                    c.note, "" if met else "completed past deadline"
+                ) if p]
+            res = ServeResult(
+                rid=c.pend.req.rid,
+                status=OK if n > 0 else ERROR,
+                tokens=[int(t) for t in resp[i][rmask[i] > 0]],
+                detail="; ".join(parts),
+                latency_s=done - c.pend.arrival_t,
+                queue_wait_s=t0 - c.pend.arrival_t,
+                decode_tok_s=decode_tok_s,
+                shared_pages=c.shared_pages,
+                session_id=c.pend.req.session_id,
+            )
+            self._records.append(_Record(
+                latency_s=res.latency_s, queue_wait_s=res.queue_wait_s,
+                decode_tok_s=decode_tok_s, deadline_met=met,
+            ))
+            self._post(res)
+        del self._records[:-512]
+
+    def _adopt_row(self, c, row_ids, row_mask, resp, n, table_row,
+                   saved_len, now) -> None:
+        """Fold a pinned row's pages into the cache (session turn or
+        prefix pioneer); surplus pages past the aligned boundary go
+        straight back to the free stack (the copy-on-write half: the
+        next turn/request re-prefills the unaligned remainder into its
+        own pages)."""
+        saved_len = int(saved_len)
+        A = skv.aligned_len(saved_len, self.PS)
+        npg = A // self.PS
+        surplus = table_row[npg:][table_row[npg:] > 0]
+        if c.adopt_session is not None and self.cfg.sessions and n > 0:
+            full_ids = np.concatenate(
+                [row_ids, resp[: max(saved_len - self.P, 0)]]
+            )[:saved_len]
+            full_mask = np.concatenate(
+                [row_mask, np.ones(max(saved_len - self.P, 0), np.int32)]
+            )[:saved_len]
+            # page-granular SLOT compaction: an all-pad page (the
+            # engine already released it — its table entry is 0)
+            # contributes no KV and no rotary positions, so its PS-slot
+            # block can be dropped from the pinned layout outright.
+            # Without this every turn would bake its pad gap into the
+            # session forever and a handful of turns would overflow the
+            # row width; with it the session's slot budget tracks REAL
+            # conversation content (plus page rounding).
+            keep_pages, keep_blocks_ids, keep_blocks_mask = [], [], []
+            corrupt = False
+            for j in range(npg):
+                blk = slice(j * self.PS, (j + 1) * self.PS)
+                if table_row[j] > 0:
+                    keep_pages.append(int(table_row[j]))
+                    keep_blocks_ids.append(full_ids[blk])
+                    keep_blocks_mask.append(full_mask[blk])
+                elif int(full_mask[blk].sum()) > 0:
+                    # a null page under REAL tokens: nothing valid to
+                    # pin — refuse the adoption rather than cache a
+                    # corrupt conversation
+                    corrupt = True
+                    break
+            if corrupt:
+                logger.error(
+                    "serve: session %s adoption refused (real tokens on "
+                    "a released page) — pages freed, session not pinned",
+                    c.adopt_session,
+                )
+                self.ledger.push_unheld(table_row)
+                return
+            self.ledger.adopt(
+                c.adopt_session, "session",
+                np.asarray(keep_pages, np.int32),
+                np.concatenate(keep_blocks_ids)
+                if keep_blocks_ids else np.zeros(0, np.int32),
+                np.concatenate(keep_blocks_mask)
+                if keep_blocks_mask else np.zeros(0, np.int32),
+                pending_ids=[int(t) for t in full_ids[A:]]
+                + [int(resp[n - 1])],
+                pending_mask=[int(m) for m in full_mask[A:]] + [1],
+                now=now,
+                deadline_t=now + self.cfg.session_deadline_s,
+            )
+            self.ledger.push(surplus)
+            return
+        if (
+            c.adopt_prefix is not None
+            and saved_len >= len(c.adopt_prefix)
+            and npg > 0
+        ):
+            Ap = skv.aligned_len(len(c.adopt_prefix), self.PS)
+            npp = Ap // self.PS
+            self.ledger.adopt(
+                skv.prefix_key(c.adopt_prefix), "prefix",
+                table_row[:npp],
+                np.asarray(c.adopt_prefix[:Ap], np.int32),
+                np.ones(Ap, np.int32),
+                pending_ids=[], now=now,
+            )
+            self.ledger.push(table_row[npp:][table_row[npp:] > 0])
+            return
+        # nothing adoptable: free everything the pin kept
+        self.ledger.push_unheld(table_row)
+
+    # -- results -----------------------------------------------------------
+
+    def _post(self, res: ServeResult) -> None:
+        self._result_queue.append(res)
+
+    def _flush_results(self) -> None:
+        remaining: List[ServeResult] = []
+        for res in self._result_queue:
+            if self.chaos is not None and self.chaos.consult(
+                "serve_transport_drop"
+            ):
+                # chaos: the result frame is lost on the wire — keep it
+                # queued; the re-post under the same name dedups at the
+                # hub/filesystem, so delivery converges to exactly-once
+                self.stats["transport_drops"] += 1
+                remaining.append(res)
+                continue
+            try:
+                self.transport.put(RESULTS_TOPIC, res.rid, res.to_meta())
+            except (OSError, ConnectionError) as e:
+                logger.warning("serve: result post failed (%s) — retrying "
+                               "next tick", e)
+                remaining.append(res)
+                continue
+            # bounded retention: results a client never picks up (its
+            # own delete on read is the fast path) age out of the
+            # transport after a generous tail
+            self._posted.append(res.rid)
+            while len(self._posted) > 2048:
+                old = self._posted.popleft()
+                try:
+                    self.transport.delete(RESULTS_TOPIC, old)
+                except (OSError, ConnectionError):
+                    pass
+        self._result_queue = remaining
+
+    # -- teardown / reporting ----------------------------------------------
+
+    def close(self) -> None:
+        """Flush, cancel whatever is still queued (a client must never
+        hang on a frontend that went away), and stop the hub."""
+        now = self._clock()
+        # final transport poll: a request that landed after the last
+        # tick must get a cancelled result, not a client-side hang
+        self._poll_requests(now)
+        # drain EVERYTHING still pending with a cancelled result
+        while True:
+            batch = self.sched.pick(now)
+            if not batch:
+                break
+            for pend in batch:
+                self.stats["cancelled"] += 1
+                self._post(ServeResult(
+                    rid=pend.req.rid, status=CANCELLED,
+                    detail="serving frontend shut down",
+                    latency_s=now - pend.arrival_t,
+                    session_id=pend.req.session_id,
+                ))
+        self._flush_results()
+        if self.hub is not None:
+            self.hub.close()
+        logger.info("serve frontend closed: %s", self.stats_summary())
+
+    def stats_summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {**self.stats, **self.sched.stats}
+        out.update({f"kv_{k}": v for k, v in self.ledger.stats.items()})
+        out.update(
+            {f"engine_{k}": v for k, v in self._gen_stats.items()}
+        )
+        out["pending"] = self.sched.pending
+        out["cache_entries"] = len(self.ledger.entries)
+        out["kv_held_pages"] = self.ledger.accounting()["held"]
+        out.update(self.slo_report())
+        return out
+
+    def slo_report(self) -> Dict[str, float]:
+        """Latency/decode percentiles over the recent request window —
+        the numbers the bench serve section records."""
+        if not self._records:
+            return {}
+        lat = np.asarray([r.latency_s for r in self._records])
+        qw = np.asarray([r.queue_wait_s for r in self._records])
+        dec = np.asarray([r.decode_tok_s for r in self._records])
+        met = np.asarray([r.deadline_met for r in self._records])
+        return {
+            # v1: whole-request decode in one dispatch => ttft == latency
+            "ttft_p50_s": float(np.percentile(lat, 50)),
+            "ttft_p95_s": float(np.percentile(lat, 95)),
+            "latency_p50_s": float(np.percentile(lat, 50)),
+            "latency_p95_s": float(np.percentile(lat, 95)),
+            "queue_wait_p50_s": float(np.percentile(qw, 50)),
+            "queue_wait_p95_s": float(np.percentile(qw, 95)),
+            "decode_tok_s_p50": float(np.percentile(dec, 50)),
+            "deadline_met_rate": float(met.mean()),
+            "window_requests": int(len(self._records)),
+        }

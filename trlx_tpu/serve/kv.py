@@ -1,0 +1,322 @@
+"""Host-side refcounted page ledger behind the prefix/session KV cache.
+
+The device half of the serving KV story is ops/paged_kv.py refcounts +
+the gen_engine warm pool; THIS module is the authority over page
+lifetimes between engine calls:
+
+  * the free-stack mirror (adopted from each call's ``kv_state``, plus
+    host-side frees from adoptions and evictions),
+  * per-page cache holds (refcount 1 while a prefix/session entry owns
+    the page; the per-call row shares are composed transiently in
+    :meth:`compose_refcnt` and released by the engine in-call),
+  * the entry table itself — shared system-prompt prefixes and pinned
+    multi-turn sessions — with active-user refcounts, LRU ordering,
+    and refcount-zero + LRU eviction under pool pressure.
+
+Copy-on-write is structural rather than a page copy: an entry shares
+only its PAGE-ALIGNED pages; the divergent suffix (the unaligned
+remainder plus everything request-specific) always prefills into the
+request's own freshly-popped pages, so shared pages are read-only by
+construction and two requests can never write the same page.
+
+Everything here is plain python/numpy over page IDS — no jax — which
+is what lets tests fuzz acquire/release/adopt/evict interleavings
+cheaply and assert the invariants (never double-free; refcount-zero
+implies on the free stack; pages conserved).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+@dataclass
+class CacheEntry:
+    """One cached prefix or pinned session."""
+
+    key: str
+    kind: str  # "prefix" | "session"
+    pages: np.ndarray  # aligned page ids (0 = compacted-pad placeholder)
+    kv_len: int  # aligned token coverage = len(pages) * page_size
+    layout_ids: np.ndarray  # slot-layout tokens [kv_len]
+    layout_mask: np.ndarray  # 1 = real, 0 = pad (positions ride cumsum)
+    # the unaligned tail past kv_len (ids + mask — the prompt's internal
+    # pads can straddle the aligned boundary): re-prefilled by the next
+    # turn into its own pages (the copy-on-write half)
+    pending_ids: List[int] = field(default_factory=list)
+    pending_mask: List[int] = field(default_factory=list)
+    refs: int = 0  # active in-flight users (evictable only at 0)
+    last_used: float = 0.0
+    deadline_t: Optional[float] = None  # sessions: idle eviction time
+
+
+class PageLedger:
+    """Free-stack mirror + cache holds over the serve pool's page ids."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        # mirrors ops/paged_kv.init_alloc: free[:ntop] are free ids,
+        # popped from the top
+        self.free = np.concatenate(
+            [np.arange(1, n_pages, dtype=np.int32), np.zeros(1, np.int32)]
+        )
+        self.ntop = n_pages - 1
+        # cache hold COUNTS: a page can be held by more than one entry
+        # (a session whose pinned table maps a shared prefix's pages is
+        # the canonical case); it returns to the free stack only when
+        # the last holder drops
+        self.hold = np.zeros(n_pages, np.int32)
+        self.entries: Dict[str, CacheEntry] = {}
+        self.stats = {
+            "adopted_entries": 0,
+            "evicted_entries": 0,
+            "deadline_evicted_entries": 0,
+            "reclaimed_pages": 0,
+            "shared_page_hits": 0,
+        }
+
+    # -- free-stack plumbing ---------------------------------------------
+
+    def adopt_stack(self, free: np.ndarray, ntop: int) -> None:
+        """Adopt the engine call's end-of-call stack as the new mirror."""
+        self.free = np.asarray(free, np.int32).copy()
+        self.ntop = int(ntop)
+
+    def push(self, pages) -> int:
+        """Host-side free (adoption surplus, evictions). Returns the
+        number of real pages pushed."""
+        n = 0
+        for p in np.asarray(pages, np.int32).reshape(-1):
+            if p <= 0:
+                continue
+            if self.hold[p]:
+                raise AssertionError(
+                    f"ledger: freeing page {int(p)} still held by a cache "
+                    "entry (double-free)"
+                )
+            self.free[self.ntop] = p
+            self.ntop += 1
+            n += 1
+        return n
+
+    def push_unheld(self, pages) -> int:
+        """Free only the pages NO entry holds — the refusal paths of a
+        pinned-row adoption use this: a refused row's table can map a
+        surviving entry's shared pages, whose lifecycle stays the
+        entry's."""
+        pages = np.asarray(pages, np.int32).reshape(-1)
+        pages = pages[pages > 0]
+        return self.push(pages[self.hold[pages] == 0])
+
+    def free_pages(self) -> int:
+        return self.ntop
+
+    # -- cache holds -------------------------------------------------------
+
+    def compose_refcnt(self, row_shares: List[np.ndarray]) -> np.ndarray:
+        """The device refcount array for one engine call: the cache's
+        own hold plus one count per queue row mapping the page —
+        in-call releases then decrement at most down to the hold, so a
+        shared page can never reach the free stack mid-call."""
+        refcnt = self.hold.astype(np.int32).copy()
+        for pages in row_shares:
+            for p in np.asarray(pages, np.int32).reshape(-1):
+                if p > 0:
+                    refcnt[p] += 1
+        return refcnt
+
+    def _hold_pages(self, pages: np.ndarray) -> None:
+        for p in pages:
+            if p > 0:
+                self.hold[p] += 1
+
+    def _drop_hold(self, pages: np.ndarray) -> List[int]:
+        """Decrement holds; returns the pages that just hit zero (the
+        ones the dropping entry must free or transfer)."""
+        released = []
+        for p in pages:
+            if p <= 0:
+                continue
+            if self.hold[p] <= 0:
+                raise AssertionError(
+                    f"ledger: dropping a hold on page {int(p)} that has "
+                    "none (double-release)"
+                )
+            self.hold[p] -= 1
+            if self.hold[p] == 0:
+                released.append(int(p))
+        return released
+
+    # -- entries -----------------------------------------------------------
+
+    def get(self, key: str) -> Optional[CacheEntry]:
+        return self.entries.get(key)
+
+    def acquire(self, key: str, now: float) -> Optional[CacheEntry]:
+        entry = self.entries.get(key)
+        if entry is None:
+            return None
+        entry.refs += 1
+        entry.last_used = now
+        self.stats["shared_page_hits"] += int((entry.pages > 0).sum())
+        return entry
+
+    def release(self, key: str) -> None:
+        entry = self.entries.get(key)
+        if entry is not None and entry.refs > 0:
+            entry.refs -= 1
+
+    def adopt(
+        self,
+        key: str,
+        kind: str,
+        pages: np.ndarray,
+        layout_ids: np.ndarray,
+        layout_mask: np.ndarray,
+        pending_ids: List[int],
+        now: float,
+        deadline_t: Optional[float] = None,
+        pending_mask: Optional[List[int]] = None,
+    ) -> CacheEntry:
+        """Adopt aligned pages (just pinned by the engine) into a new
+        entry, replacing any previous entry under the key. A session
+        turn's new table CONTAINS the old entry's shared pages, so the
+        old hold is dropped first and the union re-held — pages moving
+        between the versions transfer without touching the free stack."""
+        pages = np.asarray(pages, np.int32).copy()
+        old = self.entries.pop(key, None)
+        if old is not None:
+            released = self._drop_hold(old.pages)
+            stale = sorted(
+                set(released) - set(int(p) for p in pages if p > 0)
+            )
+            # pages the new version no longer covers AND no other entry
+            # holds (a shrunk session cannot happen today, but the
+            # ledger must not leak if it ever does)
+            self.push(np.asarray(stale, np.int32))
+        self._hold_pages(pages)
+        entry = CacheEntry(
+            key=key, kind=kind, pages=pages,
+            kv_len=len(pages) * self.page_size,
+            layout_ids=np.asarray(layout_ids, np.int32).copy(),
+            layout_mask=np.asarray(layout_mask, np.int32).copy(),
+            pending_ids=[int(t) for t in pending_ids],
+            pending_mask=[int(m) for m in (
+                pending_mask if pending_mask is not None
+                else [1] * len(pending_ids)
+            )],
+            refs=0, last_used=now, deadline_t=deadline_t,
+        )
+        self.entries[key] = entry
+        self.stats["adopted_entries"] += 1
+        return entry
+
+    def drop(self, key: str, reason: str = "evicted") -> int:
+        """Evict an entry, reclaiming its pages. Returns pages freed."""
+        entry = self.entries.pop(key, None)
+        if entry is None:
+            return 0
+        if entry.refs > 0:
+            raise AssertionError(
+                f"ledger: dropping entry {key} with {entry.refs} active "
+                "users"
+            )
+        released = self._drop_hold(entry.pages)
+        n = self.push(np.asarray(released, np.int32))
+        self.stats["evicted_entries"] += 1
+        self.stats["reclaimed_pages"] += n
+        logger.info(
+            "serve kv: %s entry %s %s — %d pages reclaimed",
+            entry.kind, key, reason, n,
+        )
+        return n
+
+    def expire_deadlines(self, now: float, skip=()) -> List[str]:
+        """Deadline eviction: drop idle entries whose deadline passed
+        (sessions mainly — their pinned pages are exactly what pool
+        pressure needs back). In-use entries (refs > 0) survive until
+        released, then fall to the next sweep; ``skip`` names entries
+        the caller knows are about to be used (a queued session turn)."""
+        out = []
+        skip = set(skip)
+        for key in list(self.entries):
+            if key in skip:
+                continue
+            e = self.entries[key]
+            if e.deadline_t is not None and now >= e.deadline_t and e.refs == 0:
+                self.drop(key, reason="deadline-expired")
+                self.stats["deadline_evicted_entries"] += 1
+                out.append(key)
+        return out
+
+    def evict_for(self, pages_needed: int, max_entries: int) -> int:
+        """LRU eviction of refcount-zero entries until ``pages_needed``
+        fit on the stack (and the entry count is back under
+        ``max_entries``). Returns pages reclaimed; a shortfall is the
+        caller's problem (degrade to plain prefill — never deadlock)."""
+        freed = 0
+        while self.entries:
+            over_cap = len(self.entries) > max_entries
+            if self.ntop >= pages_needed and not over_cap:
+                break
+            idle = [e for e in self.entries.values() if e.refs == 0]
+            if not idle:
+                break
+            victim = min(idle, key=lambda e: e.last_used)
+            freed += self.drop(victim.key, reason="lru-evicted")
+        return freed
+
+    # -- invariants --------------------------------------------------------
+
+    def accounting(self) -> Dict[str, int]:
+        held = int((self.hold > 0).sum())  # unique pages under any hold
+        return {
+            "free": int(self.ntop),
+            "held": held,
+            "total": self.n_pages - 1,  # page 0 reserved
+        }
+
+    def check_invariants(self) -> None:
+        """Between engine calls: free ∪ held partitions the pool (no
+        page both free and held; refcount-zero == on the stack), and
+        the stack holds no duplicates."""
+        stack = self.free[: self.ntop]
+        if len(set(stack.tolist())) != len(stack):
+            raise AssertionError("ledger: duplicate page on the free stack")
+        for p in stack:
+            if p <= 0 or self.hold[p]:
+                raise AssertionError(
+                    f"ledger: page {int(p)} is on the free stack while "
+                    "held by an entry"
+                )
+        acct = self.accounting()
+        if acct["free"] + acct["held"] != acct["total"]:
+            raise AssertionError(
+                f"ledger: page leak — free {acct['free']} + held "
+                f"{acct['held']} != pool {acct['total']}"
+            )
+
+
+def prefix_key(prefix_ids: List[int]) -> str:
+    import hashlib
+
+    h = hashlib.sha256(
+        np.asarray(prefix_ids, np.int32).tobytes()
+    ).hexdigest()[:16]
+    return f"px:{h}"
+
+
+def session_key(session_id: str) -> str:
+    return f"sess:{session_id}"
+
+
+def aligned_len(n: int, page_size: int) -> int:
+    return (n // page_size) * page_size

@@ -1,0 +1,122 @@
+"""Serve request/result wire records.
+
+Everything crossing the client/frontend boundary is JSON-safe meta on
+the pluggable transport (exp/net.py) — token id lists, not arrays
+(requests are tiny next to fleet chunks). ``rng_row`` derives the
+per-request RNG id the engine keys sampling on: a pure function of the
+request id, so the SAME request produces the SAME tokens regardless of
+transport backend, batch composition, or which tick serves it (the
+RPC-vs-shared-fs golden in tests/test_serve.py pins this).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+REQUESTS_TOPIC = "requests"
+RESULTS_TOPIC = "results"
+
+# request terminal states
+OK = "ok"
+TIMEOUT = "timeout"  # deadline expired before (or while) being served
+ERROR = "error"  # malformed / over-budget request
+CANCELLED = "cancelled"  # frontend shut down with the request queued
+
+
+def rng_row(rid: str, max_new: int) -> int:
+    """Deterministic per-request RNG row id, bounded so
+    ``row * max_new + j`` stays inside int32 in the engine's id space.
+
+    Honesty note on the hash: the row space is ``2**30 // max_new``
+    (~33M at max_new=32), so at large request volumes DISTINCT request
+    ids can land on the same sampling stream (birthday bound: ~50% of
+    one collision existing after ~7k requests). A collision only
+    reduces sampling diversity between two requests with identical
+    prompts — correctness, isolation and determinism are unaffected.
+    Widening needs a second fold-in slot in the engine's RNG id space;
+    noted as follow-up in docs/serving.md."""
+    return int(zlib.crc32(rid.encode("utf-8")) % (2**30 // max(max_new, 1)))
+
+
+@dataclass
+class ServeRequest:
+    """One external generation request.
+
+    deadline_s is RELATIVE to arrival at the frontend (client clocks
+    are not trusted); ``prefix_ids`` marks the shareable system-prompt
+    prefix (cached page-aligned across requests); ``session_id`` pins
+    the request's KV across turns — a follow-up turn sends ONLY the new
+    user tokens in ``prompt_ids``.
+    """
+
+    rid: str
+    prompt_ids: List[int]
+    max_tokens: Optional[int] = None
+    deadline_s: Optional[float] = None
+    prefix_ids: List[int] = field(default_factory=list)
+    session_id: Optional[str] = None
+
+    def to_meta(self) -> Dict[str, Any]:
+        return {
+            "rid": self.rid,
+            "prompt_ids": [int(t) for t in self.prompt_ids],
+            "max_tokens": self.max_tokens,
+            "deadline_s": self.deadline_s,
+            "prefix_ids": [int(t) for t in self.prefix_ids],
+            "session_id": self.session_id,
+        }
+
+    @classmethod
+    def from_meta(cls, meta: Dict[str, Any]) -> "ServeRequest":
+        return cls(
+            rid=str(meta["rid"]),
+            prompt_ids=[int(t) for t in meta.get("prompt_ids") or []],
+            max_tokens=meta.get("max_tokens"),
+            deadline_s=meta.get("deadline_s"),
+            prefix_ids=[int(t) for t in meta.get("prefix_ids") or []],
+            session_id=meta.get("session_id"),
+        )
+
+
+@dataclass
+class ServeResult:
+    """What the frontend posts back under the request's id."""
+
+    rid: str
+    status: str
+    tokens: List[int] = field(default_factory=list)
+    detail: str = ""
+    latency_s: float = 0.0  # arrival -> result ready
+    queue_wait_s: float = 0.0  # arrival -> engine dispatch
+    decode_tok_s: float = 0.0  # batch real tokens / batch wall
+    shared_pages: int = 0  # prefix/session pages REUSED (not prefilled)
+    session_id: Optional[str] = None
+
+    def to_meta(self) -> Dict[str, Any]:
+        return {
+            "rid": self.rid,
+            "status": self.status,
+            "tokens": [int(t) for t in self.tokens],
+            "detail": self.detail,
+            "latency_s": float(self.latency_s),
+            "queue_wait_s": float(self.queue_wait_s),
+            "decode_tok_s": float(self.decode_tok_s),
+            "shared_pages": int(self.shared_pages),
+            "session_id": self.session_id,
+        }
+
+    @classmethod
+    def from_meta(cls, meta: Dict[str, Any]) -> "ServeResult":
+        return cls(
+            rid=str(meta["rid"]),
+            status=str(meta["status"]),
+            tokens=[int(t) for t in meta.get("tokens") or []],
+            detail=str(meta.get("detail", "")),
+            latency_s=float(meta.get("latency_s", 0.0)),
+            queue_wait_s=float(meta.get("queue_wait_s", 0.0)),
+            decode_tok_s=float(meta.get("decode_tok_s", 0.0)),
+            shared_pages=int(meta.get("shared_pages", 0)),
+            session_id=meta.get("session_id"),
+        )

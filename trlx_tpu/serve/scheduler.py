@@ -1,0 +1,129 @@
+"""SLO admission scheduler: EDF ordering, deadline eviction, starvation
+accounting.
+
+Priority semantics at the lane-refill decision point (the trainer's
+rollout-chunk boundary): serving requests OUTRANK training refills —
+the frontend's tick runs its serve batches before the next training
+chunk dispatches — but the allowance is bounded
+(``serve.max_batches_per_tick``), so a flood of requests slows training
+and is REPORTED (the starvation counters below + a loud log + a flight
+event), it never wedges the loop. Within serving, admission is earliest
+deadline first; a request whose deadline has already passed is evicted
+with a ``timeout`` result instead of burning lanes on an answer nobody
+is waiting for.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from trlx_tpu.serve.request import ServeRequest
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+@dataclass
+class Pending:
+    req: ServeRequest
+    arrival_t: float
+    deadline_t: float
+
+
+class SLOScheduler:
+    def __init__(self, default_deadline_s: float, max_batch: int):
+        self.default_deadline_s = float(default_deadline_s)
+        self.max_batch = int(max_batch)
+        self._queue: List[Pending] = []
+        self.stats = {
+            "submitted": 0,
+            "deadline_evictions": 0,
+            "training_deferred_ticks": 0,
+            "serving_starved_ticks": 0,
+        }
+        # consecutive-tick streaks behind the two starvation reports
+        self._training_streak = 0
+        self._serving_streak = 0
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, req: ServeRequest, now: float) -> None:
+        deadline = req.deadline_s
+        if deadline is None:
+            deadline = self.default_deadline_s
+        # a non-positive deadline means ALREADY EXPIRED (the chaos
+        # serve_request_timeout contract; also what a client asking for
+        # "0 seconds" deserves) — the same tick's expire() sweep evicts
+        # it before admission
+        self._queue.append(
+            Pending(req=req, arrival_t=now, deadline_t=now + float(deadline))
+        )
+        self.stats["submitted"] += 1
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    def pending_session_keys(self) -> set:
+        """Cache keys of sessions with a turn waiting in the queue —
+        the ledger's deadline sweep must not evict their history out
+        from under the queued turn."""
+        from trlx_tpu.serve.kv import session_key
+
+        return {
+            session_key(p.req.session_id)
+            for p in self._queue if p.req.session_id
+        }
+
+    # -- deadline eviction -------------------------------------------------
+
+    def expire(self, now: float) -> List[Pending]:
+        """Pop every queued request whose deadline already passed (the
+        frontend posts them a ``timeout`` result; a session request's
+        pinned pages are reclaimed by the ledger's deadline sweep)."""
+        dead = [p for p in self._queue if now >= p.deadline_t]
+        if dead:
+            self._queue = [p for p in self._queue if now < p.deadline_t]
+            self.stats["deadline_evictions"] += len(dead)
+        return dead
+
+    # -- admission ---------------------------------------------------------
+
+    def pick(self, now: float, limit: Optional[int] = None) -> List[Pending]:
+        """Admit the next batch, earliest deadline first."""
+        limit = self.max_batch if limit is None else min(limit, self.max_batch)
+        self._queue.sort(key=lambda p: (p.deadline_t, p.arrival_t, p.req.rid))
+        batch, self._queue = self._queue[:limit], self._queue[limit:]
+        return batch
+
+    def requeue(self, batch: List[Pending]) -> None:
+        """Hand a picked batch back (lane starvation: the engine had no
+        capacity this tick). Requests keep their original deadlines, so
+        a long starvation degrades to deadline eviction — visible and
+        bounded — rather than unbounded queue growth."""
+        self._queue.extend(batch)
+
+    # -- starvation accounting ---------------------------------------------
+
+    def note_tick(
+        self, ran_full_allowance: bool, starved: bool, report_after: int
+    ) -> List[str]:
+        """Record one tick's outcome; returns the starvation reports
+        (if any) that just crossed their streak threshold."""
+        out = []
+        if ran_full_allowance and self.pending:
+            self._training_streak += 1
+            self.stats["training_deferred_ticks"] += 1
+            if self._training_streak == report_after:
+                out.append("training_starved")
+        else:
+            self._training_streak = 0
+        if starved and self.pending:
+            self._serving_streak += 1
+            self.stats["serving_starved_ticks"] += 1
+            if self._serving_streak == report_after:
+                out.append("serving_starved")
+        elif not starved:
+            self._serving_streak = 0
+        return out

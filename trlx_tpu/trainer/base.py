@@ -315,6 +315,17 @@ class TPUBaseTrainer(BaseRLTrainer):
         )
         self._engine_fns: Dict[Tuple, Callable] = {}
         self._warned_engine_fallback = False
+        # live-traffic serving tier (train.serve.*): external requests
+        # admitted into the same continuous-batching engine on the live
+        # policy params, ticked at the lane-refill decision points.
+        # Default off; built lazily at learn() start (_serve_start)
+        from trlx_tpu.serve.config import ServeConfig
+
+        self._serve_cfg = ServeConfig.from_dict(
+            getattr(config.train, "serve", None)
+        )
+        self.serve = None  # ServeFrontend while learn() runs
+        self._serve_fn = None  # jitted serving engine entry
         # cross-host consistency watchdog (guardrails.consistency_every)
         self._fingerprint_fn = None  # jitted replicated state reduction
         self._consistency_counter = 0
@@ -944,6 +955,147 @@ class TPUBaseTrainer(BaseRLTrainer):
                 out = fn(self.params, dev_ids, dev_mask, key)
             out = dict(out, prompt_mask=dev_mask)
         return out
+
+    # ------------------------------------------------------------------
+    # live-traffic serving tier (train.serve.*)
+    # ------------------------------------------------------------------
+
+    def _serve_spec(self):
+        """The serving engine geometry: a FIXED spec (one compiled
+        executable for the whole run) over a persistent warm pool,
+        resolved like the rollout engine's but against the serve
+        config's row budget instead of a chunk width."""
+        import dataclasses as _dc
+
+        from trlx_tpu.models.gen_engine import EngineSpec
+        from trlx_tpu.ops import paged_kv
+
+        cfg = self._serve_cfg
+        lm_cfg = self._lm().cfg
+        quant = cfg.kv_quant
+        if quant is None:
+            quant = "int8" if lm_cfg.kv_cache_quant in (
+                "int8", "int8_kernel"
+            ) else "none"
+        slots = min(cfg.slots or cfg.max_batch, cfg.max_batch)
+        MP = paged_kv.pages_per_slot(
+            cfg.max_prompt_len, cfg.max_new_tokens, cfg.page_size
+        )
+        return EngineSpec(
+            slots=slots,
+            page_size=cfg.page_size,
+            paged=True,
+            pool_pages=cfg.pool_pages or (1 + slots * MP),
+            refill_width=0,
+            spec_decode=False,
+            kv_quant=None if quant == "none" else quant,
+        )
+
+    def _serve_start(self) -> None:
+        """Build the serving frontend at learn() start (train.serve.*).
+        Serving shares the engine machinery and the LIVE policy params
+        but owns its rng, pool and executables — the training stream is
+        untouched by construction."""
+        if not self._serve_cfg.enabled or self.serve is not None:
+            return
+        if not self._engine_eligible():
+            raise ValueError(
+                "train.serve.enabled requires the decode engine's v1 "
+                "envelope: causal LM, single data group, no "
+                "soft-prompt/prefix adapters"
+            )
+        from trlx_tpu.models.gen_engine import engine_generate
+        from trlx_tpu.models.generation import SamplerSettings
+        from trlx_tpu.parallel.mesh import replicated_sharding
+        from trlx_tpu.serve.frontend import ServeFrontend
+
+        spec = self._serve_spec()
+        settings = SamplerSettings.from_gen_kwargs(
+            {
+                **self.generate_settings.__dict__,
+                "max_new_tokens": self._serve_cfg.max_new_tokens,
+            }
+        )
+        lm = self._lm()
+        model = self.model
+
+        def fn(params, q_ids, q_mask, rng, row_budget, warm, q_pin,
+               q_ready, q_rng_row):
+            from trlx_tpu.models.wrappers import _effective_base
+
+            return engine_generate(
+                lm, _effective_base(model, params), q_ids, q_mask, rng,
+                settings, spec, row_budget=row_budget, warm=warm,
+                q_pin=q_pin, q_ready=q_ready, q_rng_row=q_rng_row,
+            )
+
+        jfn = jax.jit(fn)
+
+        def runner(q_ids, q_mask, rng, row_budget, warm, q_pin, q_ready,
+                   q_rng_row):
+            with self.mesh:
+                sharding = replicated_sharding(self.mesh)
+                return jfn(
+                    self.params,
+                    jax.device_put(q_ids, sharding),
+                    jax.device_put(q_mask, sharding),
+                    rng, row_budget, warm, q_pin, q_ready, q_rng_row,
+                )
+
+        lm_cfg = lm.cfg
+        geom = {
+            "P": self._serve_cfg.max_prompt_len,
+            "N": self._serve_cfg.max_new_tokens,
+            "page_size": spec.page_size,
+            "pool_pages": spec.pool_pages,
+            "pad_token_id": settings.pad_token_id,
+            "n_layer": lm_cfg.n_layer,
+            "n_kv_head": lm_cfg.n_kv_head,
+            "head_dim": lm_cfg.head_dim,
+            "kv_quant": spec.kv_quant,
+            "dtype": lm_cfg.dtype,
+        }
+        self.serve = ServeFrontend(
+            self._serve_cfg, runner, geom,
+            self.config.train.checkpoint_dir,
+            chaos=self.chaos, obs=self.obs,
+        )
+        self._serve_final_summary = None
+
+    def _serve_tick(self, iter_count: int) -> None:
+        """One lane-refill decision point: pending serve requests run
+        BEFORE the next training dispatch (serving outranks training
+        refills; the allowance is bounded by
+        serve.max_batches_per_tick, so training backfills right after
+        — reported when starved, never wedged). A serving failure must
+        never take the training loop down: it logs loudly and the next
+        tick retries."""
+        if self.serve is None:
+            return
+        with self.watchdog.phase("serve", step=iter_count):
+            try:
+                self.serve.tick(iter_count)
+            except Exception:
+                logger.exception(
+                    "serve tick failed — serving degrades this tick, "
+                    "training continues"
+                )
+
+    def _serve_close(self) -> None:
+        if self.serve is None:
+            return
+        try:
+            # close() FIRST: the final summary must include the
+            # shutdown cancellations and result flush it performs
+            self.serve.close()
+            summary = self.serve.stats_summary()
+            self._serve_final_summary = summary
+            self.obs.record("serve_summary", **{
+                k: v for k, v in summary.items()
+                if isinstance(v, (int, float))
+            })
+        finally:
+            self.serve = None
 
     # ------------------------------------------------------------------
     # decode
@@ -2687,8 +2839,24 @@ class TPUBaseTrainer(BaseRLTrainer):
             mesh={ax: int(s) for ax, s in self.mesh.shape.items()},
         )
         try:
+            # serving frontend (train.serve.*): external requests ride
+            # the engine lanes between training dispatches from here
+            # on. INSIDE the try: a failed start (ineligible model,
+            # transport bind error) must not leak the signal handlers
+            # and monitor threads armed above — the same bug class the
+            # memory-doctor preflight hardening fixed.
+            self._serve_start()
             return self._learn()
         finally:
+            # serving teardown FIRST: still-queued requests get a
+            # cancelled result while the transport is certainly alive.
+            # GUARDED: a teardown failure (transport outage mid-close)
+            # must not skip the watchdog/preemption/tracker teardowns
+            # below or mask the training exception.
+            try:
+                self._serve_close()
+            except Exception:
+                logger.exception("serve teardown failed (continuing)")
             self.memdoctor.sampler.stop()
             self.watchdog.stop()
             self.preemption.uninstall()
@@ -2783,6 +2951,10 @@ class TPUBaseTrainer(BaseRLTrainer):
             if self._should_stop(force=True):
                 self._preemption_exit()
                 return results
+            # serving tick at the cycle boundary: requests that arrived
+            # during the fused optimization block are served before the
+            # next training dispatch
+            self._serve_tick(self.iter_count)
             fused_src = (
                 self._fused_epoch_batch()
                 if self.config.train.fused_inner_loop
@@ -3689,6 +3861,9 @@ class TPUOnlineTrainer(TPUBaseTrainer):
         chunk_rows = len(next_batch.input_ids) * mh.data_group_count(self.mesh)
         while n_collected < num_rollouts:
             self.watchdog.beat("rollout", step=iter_count)
+            # lane-refill decision point: pending serve requests outrank
+            # the next training chunk's dispatch (bounded allowance)
+            self._serve_tick(iter_count)
             if self.chaos is not None:
                 # chaos: the sampler wedges at the top of this chunk —
                 # the rollout phase goes silent and the watchdog's
@@ -4280,6 +4455,9 @@ class TPUOnlineTrainer(TPUBaseTrainer):
         pending_redispatch = None  # a reclaimed/re-leased chunk to produce
         while n_collected < num_rollouts:
             self.watchdog.beat("rollout", step=iter_count)
+            # lane-refill decision point (transport path): serve
+            # requests outrank the next produce/consume step
+            self._serve_tick(iter_count)
             if self.chaos is not None:
                 # chaos: same wedge site as the direct loop — the
                 # producer stalls at the top of a chunk and the

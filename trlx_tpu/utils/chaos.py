@@ -123,6 +123,25 @@ to an exact cycle/call):
                   once per optimization cycle (fused block or per-step
                   dispatch), independent of the guardrails gate.
 
+  Serving-tier sites (``train.serve.enabled``; trlx_tpu/serve/):
+  serve_request_timeout  the request arrives with its deadline already
+                  spent (stuck in an upstream queue): the SLO scheduler
+                  must EVICT it with a ``timeout`` result — and reclaim
+                  any pages a session pin holds — instead of burning
+                  lanes on an answer nobody is waiting for; consulted
+                  in the frontend, once per request intake.
+  serve_lane_starvation  training load saturates the engine lanes: the
+                  serve tick gets NO lane capacity, requests age toward
+                  their deadlines (degrading to deadline eviction), and
+                  past ``serve.starvation_report_after`` consecutive
+                  starved ticks the frontend loudly reports starved
+                  serving; consulted once per serve tick.
+  serve_transport_drop  the result frame is lost on the wire (RPC
+                  message loss): the frontend re-posts under the same
+                  request id next tick and the transport's dedup makes
+                  delivery exactly-once; consulted once per result-post
+                  attempt.
+
 Schedule entries select by count: ``{"fault": "nan_loss", "at": 2}``
 fires on the 2nd consult (1-based), ``{"fault": ..., "at": 2, "span": 3}``
 on consults 2..4, and ``{"fault": ..., "every": 5}`` on every 5th.
@@ -178,6 +197,10 @@ FAULT_SITES = (
     "oom_fused_block",
     "oom_prefill",
     "hbm_creep",
+    # serving-tier sites (appended, same reason)
+    "serve_request_timeout",
+    "serve_lane_starvation",
+    "serve_transport_drop",
 )
 
 
