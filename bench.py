@@ -824,6 +824,13 @@ def bench_decode_engine() -> dict:
                        MORE LANES (slots=32), which amortizes the int8
                        weight stream over 4x the tokens per step — the
                        headline configuration
+      engine_paged_kernel  the SAME geometry as engine_paged with the
+                       pallas paged-attention kernel
+                       (`paged_attention_impl=pallas`) instead of the
+                       XLA gather: pages stream pool->VMEM via the page
+                       table as block index map, so the paged-vs-
+                       paged_kernel delta IS the gather's three extra
+                       O(S*D) materializations per layer
       engine_spec      + reference-drafted speculative decoding
                        (slots=16: the draft pool doubles KV). With
                        random-init weights the policy EQUALS its frozen
@@ -908,9 +915,17 @@ def bench_decode_engine() -> dict:
     pillars = [
         ("cb", EngineSpec(slots=8, page_size=128, paged=False, kv_quant="int8")),
         ("paged", EngineSpec(slots=32, page_size=128, paged=True, kv_quant="int8")),
+        ("paged_kernel", EngineSpec(slots=32, page_size=128, paged=True,
+                                    kv_quant="int8",
+                                    paged_attention_impl="pallas")),
         ("spec", EngineSpec(slots=16, page_size=128, paged=True,
                             kv_quant="int8", spec_decode=True, draft_k=4)),
     ]
+    pillar_impl = {
+        name: spec.paged_attention_impl if spec.paged else "xla"
+        for name, spec in pillars
+    }
+    pillar_impl["baseline"] = "static"
     best = None
     for name, spec in pillars:
         try:
@@ -968,6 +983,9 @@ def bench_decode_engine() -> dict:
     if best is not None:
         out["large_gen_decode_tokens_per_sec"] = round(best[1], 1)
         out["large_gen_decode_engine_pillar"] = best[0]
+        # kernel attribution: the headline must SAY which attend
+        # implementation produced it (xla gather vs pallas paged kernel)
+        out["large_gen_decode_impl"] = pillar_impl.get(best[0], "xla")
     return out
 
 
@@ -1240,10 +1258,29 @@ def _smoke_engine() -> dict:
         "decode engine diverged from the static sampler under greedy — "
         "golden contract broken"
     )
+    # pallas paged-attention kernel leg: same queue through the paged
+    # int8 path with the kernel vs the XLA gather — greedy must be
+    # token-for-token (CPU interpret mode, the tier-1 parity surface)
+    pk_specs = [
+        EngineSpec(slots=4, page_size=8, paged=True, kv_quant="int8",
+                   paged_attention_impl=impl)
+        for impl in ("xla", "pallas")
+    ]
+    pk_xla, pk_pal = (
+        make_engine_fn(lm, st, s)(params, ids, mask, key, budgets)
+        for s in pk_specs
+    )
+    assert np.array_equal(
+        np.asarray(pk_xla["response_ids"]), np.asarray(pk_pal["response_ids"])
+    ), (
+        "pallas paged-attention kernel diverged from the XLA gather "
+        "path under greedy — kernel parity broken"
+    )
     real = float(np.asarray(budgets).sum())
     g = {k: float(np.asarray(v)) for k, v in e["gen_stats"].items()}
     return {
         "smoke_engine_matches_dense": 1,
+        "smoke_engine_paged_kernel_matches_xla": 1,
         "smoke_engine_tokens_per_sec": round(real / max(t_eng, 1e-9), 1),
         "smoke_dense_tokens_per_sec": round(real / max(t_dense, 1e-9), 1),
         "smoke_engine_occupancy": round(g["occupancy"], 3),

@@ -50,11 +50,11 @@ TIER1_BUDGETS = {
     "test_examples.py": 4,
     "test_exp_queue.py": 29,
     "test_fault_tolerance.py": 63,
-    "test_flash_attention.py": 15,
+    "test_flash_attention.py": 14,
     "test_fleet.py": 35,
     "test_gen_engine.py": 34,
-    "test_generation.py": 15,
-    "test_golden.py": 10,
+    "test_generation.py": 14,
+    "test_golden.py": 3,
     # r13: graft-lint suite (pure-AST checker units + one whole-repo
     # lint + two tiny jax-free subprocesses) — measured ~5.2s serial on
     # the 8-way CPU mesh (2026-08-04). Paid for under the unchanged
@@ -67,7 +67,7 @@ TIER1_BUDGETS = {
     # nan/sigterm); whole file re-measured 99.9s serial
     "test_guardrails.py": 103,
     "test_marker_audit.py": 2,
-    "test_mcts_value_branch.py": 8,
+    "test_mcts_value_branch.py": 5,
     # r10: memory-doctor suite (ladder units are fake-clock-fast; the
     # cost is the split-grads golden + three tiny trainer builds) —
     # measured 32s serial on the idle 8-way CPU mesh (2026-08-03).
@@ -75,7 +75,7 @@ TIER1_BUDGETS = {
     # r09 serial measurements left >=5s slack (fault_tolerance 62.4,
     # elastic 32.0, exp_queue 28.2, fleet 33.7, peft 13.9 measured).
     "test_memdoctor.py": 35,
-    "test_models.py": 17,
+    "test_models.py": 14,
     # trimmed r07 against serial measurements (the round-6 note asked
     # the next file to trim instead of raising the ceiling): these
     # files' tier-1 portions are mostly version-gated skips/deselects —
@@ -91,25 +91,40 @@ TIER1_BUDGETS = {
     # memdoctor 40->37 (32), elastic 35->34 (32.0), exp_queue 30->29
     # (28.2), models 18->17 (16.2), peft 15->14 (13.9).
     "test_obs.py": 25,
-    "test_ops.py": 10,
+    # r15: paged-attention kernel + sharded lanes + trunk-sharing suite
+    # (op-level kernel parity grid, engine pallas==xla goldens incl.
+    # the spec verify forward, trunk-shared pool accounting, grouped-
+    # lane stream equality incl. a 2-way mesh, grouped serve frontend)
+    # — measured 88s serial on THIS 1-core container (2026-08-04),
+    # which runs ~2x the historical budget scale (test_gen_engine:
+    # budget 34, 68s here), so budgeted 48. Paid under the unchanged
+    # 780 ceiling by trimming files re-measured on the same container
+    # the same day (scaled /2): golden 0.3s -> 10->3, reference_harness
+    # 1s -> 10->4, pipelines 2s -> 10->4, ops 6s -> 10->5, seq2seq 16s
+    # -> 20->13, mcts 6s -> 8->5, sharding 7s -> 10->7, models 24s ->
+    # 17->14, ring_attention 9s -> 10->8, watchdog 11s -> 10->8,
+    # sweep 23s -> 15->14, trainers 11s -> 10->9, flash_attention 24s
+    # -> 15->14, generation 23s -> 15->14.
+    "test_paged_kernel.py": 48,
+    "test_ops.py": 5,
     "test_peft.py": 14,
     "test_pipeline_parallel.py": 7,
-    "test_pipelines.py": 10,
+    "test_pipelines.py": 4,
     "test_properties.py": 2,
-    "test_reference_harness.py": 10,
+    "test_reference_harness.py": 4,
     "test_remat.py": 2,
     "test_resilient.py": 5,
-    "test_ring_attention.py": 10,
+    "test_ring_attention.py": 8,
     "test_scanned_epochs.py": 46,
-    "test_seq2seq.py": 20,
+    "test_seq2seq.py": 13,
     "test_serve.py": 46,
-    "test_sharding.py": 10,
+    "test_sharding.py": 7,
     "test_summarize_eval.py": 5,
     "test_supervisor.py": 11,
-    "test_sweep.py": 15,
-    "test_trainers.py": 10,
+    "test_sweep.py": 14,
+    "test_trainers.py": 9,
     "test_utils.py": 5,
-    "test_watchdog.py": 10,
+    "test_watchdog.py": 8,
 }
 
 # ceiling: tier-1 runs under `timeout 870` (ROADMAP); budgets must fit

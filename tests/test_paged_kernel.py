@@ -1,0 +1,538 @@
+"""Pallas paged-attention kernel, sharded engine lanes, trunk-KV page
+sharing (ISSUE 15 tentpole).
+
+The paged decode path grew three independently-verifiable properties:
+
+  * **kernel parity** — `paged_attention_step(impl="pallas")` (the page
+    table as block index map, per-row int8 scales folded in-kernel,
+    grouped GQA) matches the XLA gather path in CPU interpret mode
+    across the golden grid: page size x ragged occupancy x int8 on/off
+    x GQA x T in {1, draft_k} x lane_valid masking — and the contiguous
+    `paged=false` layout is bit-exact UNCHANGED (it always takes the
+    XLA path; its gather is already a fused reshape),
+  * **sharded lane groups** — `data_groups=G` splits the queue into G
+    independent engines run as one stacked dispatch; RNG is keyed on
+    the GLOBAL queue row, so greedy output is token-for-token the
+    single-group stream (and sampled streams are the same draws), with
+    or without a mesh sharding the group axis,
+  * **trunk-KV page sharing** — a hydra speculative draft shares its
+    trunk KV with the policy by construction, so the pool stores trunk
+    pages ONCE (layer axis extends by the branch depth instead of
+    doubling) with refcounts tracking the two logical holders; pool
+    accounting balances (`free + held == pool`) after every chunk.
+
+Everything is CPU-sized; the perf claims live in bench.py's
+`large_gen_engine_paged_kernel_*` pillar.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.gen_engine import (
+    EngineSpec,
+    GenEngineConfig,
+    compose_draft_params,
+    engine_generate,
+    engine_generate_grouped,
+    hydra_shared_trunk_layers,
+)
+from trlx_tpu.models.generation import SamplerSettings, generate
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.ops import paged_kv
+from trlx_tpu.ops.decode_attention import paged_attention_step
+
+EOS, PAD = 7, 9
+
+
+# -- op-level kernel parity ---------------------------------------------
+
+
+def _step_setup(quant, Hkv, T, PS, key=0):
+    """A paged pool with 3 lanes at ragged depths (pre-context written
+    through the op's own write path), plus the step's q/k/v and the
+    engine-style additive bias covering causality + per-row lengths."""
+    L, NP, MP, B, D, H = 2, 9, 2, 3, 8, 2 * Hkv  # GQA when Hkv < H
+    S = MP * PS
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(key), 4)
+    q = jax.random.normal(k1, (B, T, H, D), jnp.float32)
+    kn = jax.random.normal(k2, (B, T, Hkv, D), jnp.float32)
+    vn = jax.random.normal(k3, (B, T, Hkv, D), jnp.float32)
+    table = jnp.asarray([[1, 2], [3, 4], [5, 6]], jnp.int32)
+    # ragged occupancy: each lane sits at its own depth
+    slot_pos = jnp.asarray([3, 2, 3], jnp.int32)
+    pools = paged_kv.init_pool(L, NP, PS, Hkv, D, quant, jnp.float32)
+    ctx = jax.random.normal(k4, (B, 3, Hkv, D), jnp.float32)
+    _, pools = paged_attention_step(
+        jnp.zeros((B, 3, H, D)), ctx, ctx, pools, jnp.int32(1), table,
+        jnp.zeros((B,), jnp.int32), jnp.zeros((B, 1, 3, S)), 1.0,
+    )
+    q_slots = slot_pos[:, None] + jnp.arange(T)[None, :]
+    key_mask = (
+        jnp.arange(S)[None, :] < (slot_pos + T)[:, None]
+    ).astype(jnp.int32)
+    causal = q_slots[:, :, None] >= jnp.arange(S)[None, None, :]
+    bias = jnp.where(
+        causal & (key_mask[:, None, :] > 0), 0.0, -1e30
+    )[:, None].astype(jnp.float32)
+    return q, kn, vn, pools, table, slot_pos, bias, D
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+@pytest.mark.parametrize("gqa", [False, True])
+def test_pallas_paged_matches_xla_grid(quant, gqa):
+    """Kernel == gather across the golden grid: page sizes, T=1 decode
+    and T=3 verify shapes, ragged per-row depths, int8 scale folding,
+    grouped GQA, and a masked (lane_valid=False) lane whose write must
+    land in the null page on both paths."""
+    Hkv = 1 if gqa else 2  # H = 2 either way; gqa -> rep 2
+    for T in (1, 3):
+        for PS in (4, 8):
+            q, kn, vn, pools, table, slot_pos, bias, D = _step_setup(
+                quant, Hkv if not gqa else 1, T, PS
+            )
+            if gqa:
+                # widen queries to 2 heads over 1 kv head
+                q = jnp.concatenate([q, q[..., ::-1, :]], axis=2)[:, :, :2]
+            lv = jnp.asarray([True, True, False])
+            outs = {}
+            for impl in ("xla", "pallas"):
+                o, pl_pools = paged_attention_step(
+                    q, kn, vn, pools, jnp.int32(1), table, slot_pos, bias,
+                    1.0 / np.sqrt(D), lane_valid=lv, impl=impl,
+                )
+                outs[impl] = np.asarray(o)
+            np.testing.assert_allclose(
+                outs["xla"], outs["pallas"], atol=2e-5, rtol=1e-5,
+                err_msg=f"quant={quant} gqa={gqa} T={T} PS={PS}",
+            )
+
+
+def test_xla_gqa_grouped_matches_repeat_reference():
+    """The XLA fallback's grouped-GQA einsum (no jnp.repeat head
+    blow-up at S width) matches the repeat-materialized reference
+    computation it replaced."""
+    q, kn, vn, pools, table, slot_pos, bias, D = _step_setup(
+        "int8", 1, 2, 4, key=3
+    )
+    H, Hkv = 2, 1
+    q = jnp.concatenate([q, q * 0.5], axis=2)[:, :, :H]
+    out, new_pools = paged_attention_step(
+        q, kn, vn, pools, jnp.int32(1), table, slot_pos, bias,
+        1.0 / np.sqrt(D), impl="xla",
+    )
+    # reference: gather + repeat to H heads + the pre-grouping formula
+    k_all = paged_kv.gather_layer(new_pools["pk"], jnp.int32(1), table)
+    v_all = paged_kv.gather_layer(new_pools["pv"], jnp.int32(1), table)
+    ks = paged_kv.gather_layer(new_pools["pk_scale"], jnp.int32(1), table)
+    vs = paged_kv.gather_layer(new_pools["pv_scale"], jnp.int32(1), table)
+    k_all = jnp.repeat(k_all, H // Hkv, axis=2)
+    v_all = jnp.repeat(v_all, H // Hkv, axis=2)
+    ks = jnp.repeat(ks, H // Hkv, axis=2)
+    vs = jnp.repeat(vs, H // Hkv, axis=2)
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q, k_all.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) / np.sqrt(D)
+    scores = scores * ks.transpose(0, 2, 1)[:, :, None, :]
+    probs = jax.nn.softmax(scores + bias, axis=-1)
+    probs = (probs * vs.transpose(0, 2, 1)[:, :, None, :]).astype(q.dtype)
+    ref = jnp.einsum("bhts,bshd->bthd", probs, v_all.astype(q.dtype))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5
+    )
+
+
+def test_pallas_impl_rejects_unknown():
+    q, kn, vn, pools, table, slot_pos, bias, D = _step_setup(None, 2, 1, 4)
+    with pytest.raises(ValueError, match="xla/pallas"):
+        paged_attention_step(
+            q, kn, vn, pools, jnp.int32(0), table, slot_pos, bias, 1.0,
+            impl="cuda",
+        )
+
+
+# -- engine-level goldens -----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=16, n_layer=2, n_head=2, n_positions=64,
+        dtype=jnp.float32,
+    )
+    lm = TransformerLM(cfg)
+    return lm, lm.init(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def queue():
+    Q, P = 5, 6
+    ids = jax.random.randint(jax.random.PRNGKey(1), (Q, P), 0, 64)
+    mask = jnp.ones((Q, P), jnp.int32).at[0, :2].set(0).at[3, :1].set(0)
+    return ids, mask
+
+
+def _settings(do_sample, n=8):
+    return SamplerSettings(
+        max_new_tokens=n, do_sample=do_sample, eos_token_id=EOS,
+        pad_token_id=PAD,
+    )
+
+
+def _run(lm, params, ids, mask, settings, spec, draft=None, budget=None,
+         grouped=False):
+    f = engine_generate_grouped if grouped else engine_generate
+    fn = jax.jit(
+        lambda p, d, i, m, r, b: f(
+            lm, p, i, m, r, settings, spec, draft_params=d, row_budget=b
+        )
+    )
+    return fn(params, draft, ids, mask, jax.random.PRNGKey(2), budget)
+
+
+def test_engine_pallas_greedy_matches_xla_incl_spec_verify(tiny_lm, queue):
+    """End to end through the engine: the pallas kernel serves BOTH the
+    T=1 decode step and the T=draft_k speculative verify forward (the
+    draft steps too) and the greedy stream is token-for-token the XLA
+    gather path's — int8 pool, small pages, refills mid-run."""
+    lm, params = tiny_lm
+    ids, mask = queue
+    st = _settings(False)
+    for spec_kw in (
+        dict(),
+        dict(spec_decode=True, draft_k=3),
+    ):
+        base_spec = EngineSpec(
+            slots=2, page_size=4, kv_quant="int8", **spec_kw
+        )
+        draft = params if spec_kw else None
+        a = _run(lm, params, ids, mask, st, base_spec, draft=draft)
+        b = _run(
+            lm, params, ids, mask, st,
+            dataclasses.replace(base_spec, paged_attention_impl="pallas"),
+            draft=draft,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a["response_ids"]), np.asarray(b["response_ids"]),
+            err_msg=f"spec_kw={spec_kw}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a["response_mask"]), np.asarray(b["response_mask"])
+        )
+
+
+def test_contiguous_path_unaffected_by_impl():
+    """The contiguous layout always takes the XLA path (its gather
+    collapses to a reshape — the baseline the benches attribute
+    against), so the impl knob must be a bit-exact no-op there. Pinned
+    at the op level: identical inputs through `contiguous=True` with
+    both impl values produce IDENTICAL bits."""
+    quant, Hkv, T, PS = "int8", 2, 1, 4
+    L, NP, B, D = 2, 9, 3, 8
+    S = 2 * PS
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(k1, (B, T, Hkv, D), jnp.float32)
+    kn = jax.random.normal(k2, (B, T, Hkv, D), jnp.float32)
+    vn = jax.random.normal(k3, (B, T, Hkv, D), jnp.float32)
+    # the engine's contiguous table: page_table[b, j] == 1 + b*MP + j
+    table = 1 + jnp.arange(B * 2, dtype=jnp.int32).reshape(B, 2)
+    pools = paged_kv.init_pool(L, NP, PS, Hkv, D, quant, jnp.float32)
+    slot_pos = jnp.zeros((B,), jnp.int32)
+    bias = jnp.zeros((B, 1, T, S), jnp.float32)
+    outs = []
+    for impl in ("xla", "pallas"):
+        o, _ = paged_attention_step(
+            q, kn, vn, pools, jnp.int32(0), table, slot_pos, bias, 1.0,
+            contiguous=True, impl=impl,
+        )
+        outs.append(np.asarray(o))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+# -- trunk-KV page sharing ----------------------------------------------
+
+
+def test_spec_trunk_shared_pool_accounting(tiny_lm, queue):
+    """Hydra draft trunk-KV sharing: same greedy stream as the unshared
+    layout, trunk pages held ONCE (refcounted, one physical pool whose
+    layer axis extends by only the branch depth), and the pool balances
+    after the chunk: free + held + null == pool, held == 0 drained."""
+    lm, params = tiny_lm
+    ids, mask = queue
+    ref = {
+        "blocks": jax.tree_util.tree_map(lambda x: x[1:], params["blocks"]),
+        **{k: v for k, v in params.items() if k != "blocks"},
+    }
+    sh = hydra_shared_trunk_layers(lm.cfg.n_layer, 1)
+    assert sh == 1
+    assert hydra_shared_trunk_layers(lm.cfg.n_layer, lm.cfg.n_layer) == 0
+    assert hydra_shared_trunk_layers(lm.cfg.n_layer, -1) == 0
+    st = _settings(False, n=9)
+    NP = 1 + 2 * paged_kv.pages_per_slot(6, 9 + 3, 4)
+
+    def run(spec):
+        fn = jax.jit(
+            lambda p, rp, i, m, r: engine_generate(
+                lm, p, i, m, r, st, spec,
+                draft_params=compose_draft_params(lm.cfg, p, rp),
+            )
+        )
+        return fn(params, ref, ids, mask, jax.random.PRNGKey(2))
+
+    nosh = run(EngineSpec(slots=2, page_size=4, spec_decode=True, draft_k=3))
+    shared = run(
+        EngineSpec(
+            slots=2, page_size=4, spec_decode=True, draft_k=3,
+            draft_shared_layers=sh,
+        )
+    )
+    np.testing.assert_array_equal(
+        np.asarray(nosh["response_ids"]), np.asarray(shared["response_ids"])
+    )
+    # the full tentpole intersection: trunk sharing THROUGH the pallas
+    # kernel (draft layers remapped into the extended pool's index
+    # space) still reproduces the stream
+    shared_pk = run(
+        EngineSpec(
+            slots=2, page_size=4, spec_decode=True, draft_k=3,
+            draft_shared_layers=sh, paged_attention_impl="pallas",
+        )
+    )
+    np.testing.assert_array_equal(
+        np.asarray(nosh["response_ids"]),
+        np.asarray(shared_pk["response_ids"]),
+    )
+    g = shared["gen_stats"]
+    # drained chunk: every page back on the stack, no refcount holds
+    assert int(g["free_pages"]) == NP - 1
+    assert int(g["held_pages"]) == 0
+    assert int(g["free_pages"]) + int(g["held_pages"]) + 1 == NP
+    # the unshared layout balances identically (refcounts cover both)
+    g0 = nosh["gen_stats"]
+    assert int(g0["free_pages"]) == NP - 1 and int(g0["held_pages"]) == 0
+
+
+def test_spec_shared_undersized_pool_balances(tiny_lm, queue):
+    """Refcounted release under pool starvation: oom-truncated lanes
+    release both stream holds, so even a deliberately undersized pool
+    ends balanced (free == pool - null, nothing leaked)."""
+    lm, params = tiny_lm
+    ids, mask = queue
+    ref = {
+        "blocks": jax.tree_util.tree_map(lambda x: x[1:], params["blocks"]),
+        **{k: v for k, v in params.items() if k != "blocks"},
+    }
+    st = dataclasses.replace(_settings(False, n=9), eos_token_id=-1)
+    spec = EngineSpec(
+        slots=2, page_size=4, spec_decode=True, draft_k=3,
+        draft_shared_layers=1, pool_pages=6,
+    )
+    fn = jax.jit(
+        lambda p, rp, i, m, r: engine_generate(
+            lm, p, i, m, r, st, spec,
+            draft_params=compose_draft_params(lm.cfg, p, rp),
+        )
+    )
+    g = fn(params, ref, ids, mask, jax.random.PRNGKey(2))["gen_stats"]
+    assert int(g["oom_truncated"]) > 0
+    assert int(g["held_pages"]) == 0
+    assert int(g["free_pages"]) == 6 - 1
+
+
+# -- sharded engine lane groups -----------------------------------------
+
+
+def test_grouped_lanes_match_single_group_stream(tiny_lm, queue):
+    """data_groups=2 over a 5-row queue (pad path included): greedy AND
+    fixed-seed sampled streams are token-for-token the single-group
+    engine's — global-row RNG ids + global-id-space offsets make this
+    structural — and the aggregated stats subtract the dummy pad rows
+    exactly."""
+    lm, params = tiny_lm
+    ids, mask = queue
+    greedy_single = None
+    for do_sample in (False, True):
+        st = _settings(do_sample)
+        single = _run(
+            lm, params, ids, mask, st, EngineSpec(slots=2, page_size=4)
+        )
+        if not do_sample:
+            greedy_single = single
+        grouped = _run(
+            lm, params, ids, mask, st,
+            EngineSpec(slots=2, page_size=4, data_groups=2), grouped=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(single["response_ids"]),
+            np.asarray(grouped["response_ids"]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(single["response_mask"]),
+            np.asarray(grouped["response_mask"]),
+        )
+        gs, gg = single["gen_stats"], grouped["gen_stats"]
+        for k in ("refills", "real_tokens", "truncated", "unserved"):
+            assert int(np.asarray(gs[k])) == int(np.asarray(gg[k])), k
+    # an EXPLICIT pool_pages is the TOTAL budget, split ceil(1/G) per
+    # group (22 -> 11 each): the drained free stacks prove the split,
+    # and a non-starving explicit budget keeps the stream equality
+    expl = _run(
+        lm, params, ids, mask, _settings(False),
+        EngineSpec(slots=2, page_size=4, data_groups=2, pool_pages=22),
+        grouped=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(greedy_single["response_ids"]),
+        np.asarray(expl["response_ids"]),
+    )
+    assert int(np.asarray(expl["gen_stats"]["free_pages"])) == 2 * (11 - 1)
+
+
+def test_grouped_lanes_sharded_over_mesh(tiny_lm, queue):
+    """The same grouped run with the group axis sharding-constrained
+    over a 2-way device mesh (each lane group's pools/tables on its own
+    device slice) still reproduces the single-group goldens."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    lm, params = tiny_lm
+    ids, mask = queue
+    st = _settings(False)
+    single = _run(lm, params, ids, mask, st, EngineSpec(slots=2, page_size=4))
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+    gshard = NamedSharding(mesh, PartitionSpec("dp"))
+    spec = EngineSpec(slots=2, page_size=4, data_groups=2)
+    fn = jax.jit(
+        lambda p, i, m, r: engine_generate_grouped(
+            lm, p, i, m, r, st, spec, group_sharding=gshard
+        )
+    )
+    with mesh:
+        out = fn(params, ids, mask, jax.random.PRNGKey(2))
+    np.testing.assert_array_equal(
+        np.asarray(single["response_ids"]), np.asarray(out["response_ids"])
+    )
+
+
+# -- serve frontend lane groups -----------------------------------------
+
+
+def test_grouped_serve_frontend_matches_single_group(tiny_lm, tmp_path):
+    """The serve call site: a frontend with groups=2 (per-group warm
+    pools + ledgers, one stacked vmapped dispatch) returns the SAME
+    tokens per request as groups=1 — request streams are pure functions
+    of (serve.seed, request id) — and each group's ledger partitions
+    its own pool exactly."""
+    from trlx_tpu.serve.config import ServeConfig
+    from trlx_tpu.serve.frontend import ServeFrontend
+    from trlx_tpu.serve.request import RESULTS_TOPIC, ServeRequest
+
+    lm, params = tiny_lm
+    PS, P, N, NP = 4, 16, 6, 48
+    settings = SamplerSettings(
+        max_new_tokens=N, do_sample=True, eos_token_id=EOS, pad_token_id=PAD
+    )
+    spec = EngineSpec(slots=2, page_size=PS, paged=True, pool_pages=NP)
+
+    @jax.jit
+    def jfn(p, ids, mask, rng, budget, warm, pin, ready, rngrow):
+        return engine_generate(
+            lm, p, ids, mask, rng, settings, spec, row_budget=budget,
+            warm=warm, q_pin=pin, q_ready=ready, q_rng_row=rngrow,
+        )
+
+    @jax.jit
+    def jfn_g(p, ids, mask, rng, budget, warm, pin, ready, rngrow):
+        def one(i, m, b, w, pn, rd, rr):
+            return engine_generate(
+                lm, p, i, m, rng, settings, spec, row_budget=b, warm=w,
+                q_pin=pn, q_ready=rd, q_rng_row=rr,
+            )
+
+        return jax.vmap(one)(ids, mask, budget, warm, pin, ready, rngrow)
+
+    def build(G, sub):
+        runner = (
+            (lambda *a: jfn(params, *a)) if G == 1
+            else (lambda *a: jfn_g(params, *a))
+        )
+        cfg = ServeConfig.from_dict(dict(
+            enabled=True, max_batch=2, page_size=PS, max_prompt_len=P,
+            max_new_tokens=N, default_max_tokens=4, pool_pages=NP,
+            groups=G,
+        ))
+        geom = dict(
+            P=P, N=N, page_size=PS, pool_pages=NP, pad_token_id=PAD,
+            n_layer=lm.cfg.n_layer, n_kv_head=lm.cfg.n_kv_head,
+            head_dim=lm.cfg.head_dim, kv_quant=None, dtype=lm.cfg.dtype,
+            groups=G,
+        )
+        return ServeFrontend(cfg, runner, geom, str(tmp_path / sub))
+
+    def serve_all(fe):
+        now = fe._clock()
+        reqs = [
+            ServeRequest(rid=f"r{i}", prompt_ids=[11 + i, 21, 31],
+                         max_tokens=4, deadline_s=60.0)
+            for i in range(4)
+        ]
+        for r in reqs:
+            fe.sched.submit(r, now)
+        toks = {}
+        for _ in range(6):
+            fe.tick()
+            for r in reqs:
+                meta = fe.transport.get_meta(RESULTS_TOPIC, r.rid)
+                if meta is not None and r.rid not in toks:
+                    toks[r.rid] = tuple(meta.get("tokens") or ())
+            if len(toks) == len(reqs):
+                break
+        assert len(toks) == len(reqs), "not all requests served"
+        return toks
+
+    fe1 = build(1, "g1")
+    t1 = serve_all(fe1)
+    fe2 = build(2, "g2")
+    t2 = serve_all(fe2)
+    assert t1 == t2
+    assert fe2.G == 2 and len(fe2.ledgers) == 2
+    for led in fe2.ledgers:
+        led.check_invariants()
+        acc = led.accounting()
+        assert acc["free"] + acc["held"] == acc["total"]
+    assert fe2.stats_summary()["lane_groups"] == 2
+    fe1.close()
+    fe2.close()
+
+
+# -- config surface ------------------------------------------------------
+
+
+def test_new_config_knobs_validate():
+    cfg = GenEngineConfig.from_dict(
+        {"paged_attention_impl": "pallas", "data_groups": 2}
+    )
+    assert cfg.paged_attention_impl == "pallas"
+    mcfg = TransformerConfig(
+        vocab_size=8, hidden_size=8, n_layer=1, n_head=1
+    )
+    spec = cfg.resolve(8, mcfg)
+    assert spec.paged_attention_impl == "pallas" and spec.data_groups == 2
+    # groups clip to the batch width like slots do
+    assert GenEngineConfig.from_dict({"data_groups": 8}).resolve(
+        2, mcfg
+    ).data_groups == 2
+    with pytest.raises(ValueError, match="paged_attention_impl"):
+        GenEngineConfig.from_dict({"paged_attention_impl": "triton"})
+    with pytest.raises(ValueError, match="data_groups"):
+        GenEngineConfig.from_dict({"data_groups": 0})
+    from trlx_tpu.serve.config import ServeConfig
+
+    with pytest.raises(ValueError, match="groups"):
+        ServeConfig.from_dict({"groups": 0})
+    assert ServeConfig.from_dict({"groups": 2}).groups == 2

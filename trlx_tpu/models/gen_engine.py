@@ -95,6 +95,20 @@ class GenEngineConfig:
     draft_k       drafted tokens per speculative round.
     kv_quant      "int8" | "none"; None follows the model's
                   kv_cache_quant (the production rollout default).
+    paged_attention_impl  "xla" (gather path) | "pallas" (the paged
+                  decode kernel: pages stream from the pool via the
+                  page table as block index map — nothing S-wide is
+                  ever gathered). Applies to the paged layout only;
+                  the contiguous layout always takes the XLA path (its
+                  gather is already a fused reshape). On TPU the
+                  pallas impl needs page_size % 128 == 0.
+    data_groups   independent engine LANE GROUPS per call: the queue
+                  splits into this many shards, each with its own
+                  slots/pool/page-table/allocator, run as one stacked
+                  dispatch (group state shards over the mesh's data
+                  axes when the geometry divides). RNG stays keyed on
+                  the GLOBAL queue row, so greedy output is
+                  token-for-token the single-group stream.
     """
 
     enabled: bool = False
@@ -106,6 +120,8 @@ class GenEngineConfig:
     spec_decode: bool = False
     draft_k: int = 4
     kv_quant: Optional[str] = None
+    paged_attention_impl: str = "xla"
+    data_groups: int = 1
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "GenEngineConfig":
@@ -126,6 +142,13 @@ class GenEngineConfig:
             raise ValueError(
                 f"ppo.gen_engine.kv_quant must be none/int8, got {cfg.kv_quant!r}"
             )
+        if cfg.paged_attention_impl not in ("xla", "pallas"):
+            raise ValueError(
+                "ppo.gen_engine.paged_attention_impl must be xla/pallas, "
+                f"got {cfg.paged_attention_impl!r}"
+            )
+        if cfg.data_groups < 1:
+            raise ValueError("ppo.gen_engine.data_groups must be >= 1")
         return cfg
 
     def resolve(self, batch: int, model_cfg) -> "EngineSpec":
@@ -138,6 +161,9 @@ class GenEngineConfig:
         slots = self.slots or batch
         if batch:
             slots = min(slots, batch)
+        groups = self.data_groups
+        if batch:
+            groups = max(1, min(groups, batch))
         return EngineSpec(
             slots=slots,
             page_size=self.page_size,
@@ -147,12 +173,23 @@ class GenEngineConfig:
             spec_decode=self.spec_decode,
             draft_k=self.draft_k,
             kv_quant=None if quant == "none" else quant,
+            paged_attention_impl=self.paged_attention_impl,
+            data_groups=groups,
         )
 
 
 @dataclass(frozen=True)
 class EngineSpec:
-    """Static engine geometry (hashable: keys the jit cache)."""
+    """Static engine geometry (hashable: keys the jit cache).
+
+    ``draft_shared_layers`` is DERIVED, not user config: with a hydra
+    (policy-trunk + frozen-branch) speculative draft, the draft's
+    bottom ``draft_shared_layers`` layers are the policy's trunk — the
+    trainer sets it from the composed reference's branch depth so the
+    engine stores trunk KV ONCE (the pool's layer axis extends by only
+    the branch depth instead of doubling; see engine_generate). It is
+    only valid when ``compose_draft_params`` built the draft — a
+    full-copy draft shares nothing and must leave it 0."""
 
     slots: int
     page_size: int = 128
@@ -162,6 +199,23 @@ class EngineSpec:
     spec_decode: bool = False
     draft_k: int = 4
     kv_quant: Optional[str] = None
+    paged_attention_impl: str = "xla"
+    data_groups: int = 1
+    draft_shared_layers: int = 0
+
+
+def hydra_shared_trunk_layers(n_layer: int, ref_branch_layers) -> int:
+    """Trunk layers a composed hydra draft shares with the policy pool:
+    ``L - k`` when the frozen reference is a top-``k`` branch
+    (0 < k < L); 0 for a full-copy reference (its layers all diverge
+    from the policy's the moment training moves) and for k == 0. The
+    ONE derivation shared by the trainer (`_engine_spec`) and the
+    memory-doctor planners, so the spec the jit traces and the bytes
+    the preflight admits can't disagree."""
+    k = ref_branch_layers
+    if k is None or k <= 0 or k >= n_layer:
+        return 0
+    return n_layer - k
 
 
 def _round_up(x: int, to: int) -> int:
@@ -206,6 +260,10 @@ def engine_generate(
     q_pin: Optional[Array] = None,  # [Q] bool: keep pages at finish
     q_ready: Optional[Array] = None,  # [Q] page-aligned shared prefix len
     q_rng_row: Optional[Array] = None,  # [Q] per-row RNG id base
+    rng_space: Optional[int] = None,  # id-space width (default Q): the
+    # GLOBAL queue size when this call serves one shard of a grouped
+    # run, so the acceptance/residual RNG offsets match the
+    # single-group stream exactly
 ) -> Dict[str, Array]:
     """Generate a continuation for every queue row through the engine.
 
@@ -272,6 +330,36 @@ def engine_generate(
     pad = jnp.int32(settings.pad_token_id)
     if spec.spec_decode and draft_params is None:
         raise ValueError("spec_decode needs draft_params (the reference)")
+    # spec-decode trunk-KV sharing (hydra draft = policy trunk + frozen
+    # branch): the draft's trunk KV is IDENTICAL to the policy's by
+    # construction — same weights, same token inputs, same positions —
+    # so instead of a full second pool the ONE pool's layer axis
+    # extends by just the draft's BRANCH depth. Trunk pages are held
+    # once; the pool refcounts account for the two logical holders
+    # (policy stream + draft stream) of every page.
+    shared = spec.draft_shared_layers if spec.spec_decode else 0
+    if shared:
+        if not 0 < shared < cfg.n_layer:
+            raise ValueError(
+                f"draft_shared_layers={shared} must be in (0, n_layer="
+                f"{cfg.n_layer})"
+            )
+        KB = cfg.n_layer - shared  # draft branch layers stored past L
+        draft_layer_ixs = jnp.concatenate(
+            [
+                jnp.arange(shared, dtype=jnp.int32),
+                cfg.n_layer + jnp.arange(KB, dtype=jnp.int32),
+            ]
+        )
+    else:
+        KB = 0
+        draft_layer_ixs = None
+    pool_layers = cfg.n_layer + KB
+    # every spec-decode page is held by BOTH streams (trunk layers by
+    # construction; branch layers ride the same physical page of the
+    # extended pool), so page lifetime runs through the refcount
+    # machinery: +2 at allocation, two decrements at release
+    refcounted = spec.spec_decode and spec.paged
     serving = warm is not None
     if serving:
         if not spec.paged:
@@ -313,9 +401,12 @@ def engine_generate(
     row_budget = jnp.clip(row_budget.astype(jnp.int32), 1, N)
 
     # RNG id spaces: token draws at r*N + j; acceptance and residual
-    # draws in disjoint ranges above them
-    OFF_ACC = (Q + 1) * N
-    OFF_RES = 2 * (Q + 1) * N
+    # draws in disjoint ranges above them. rng_space widens the id
+    # space to the GLOBAL queue size under grouped lanes, so a shard's
+    # offsets land exactly where the single-group run's do.
+    Qr = rng_space or Q
+    OFF_ACC = (Qr + 1) * N
+    OFF_RES = 2 * (Qr + 1) * N
 
     def _rng_ids(ix: Array) -> Array:
         """RNG id base per queue row: the queue index by default; the
@@ -346,11 +437,14 @@ def engine_generate(
             state["pinned"] = jnp.int32(0)
         else:
             pool = paged_kv.init_pool(
-                cfg.n_layer, NP, PS, cfg.n_kv_head, cfg.head_dim, quant,
+                pool_layers, NP, PS, cfg.n_kv_head, cfg.head_dim, quant,
                 cfg.dtype,
             )
             state = {"pool": pool}
-            if spec.spec_decode:
+            if spec.spec_decode and not shared:
+                # full-copy draft: nothing is shared — it keeps its own
+                # full-depth pool over the same page ids (the historic
+                # 2x layout, now only paid when it is actually needed)
                 state["dpool"] = paged_kv.init_pool(
                     cfg.n_layer, NP, PS, cfg.n_kv_head, cfg.head_dim, quant,
                     cfg.dtype,
@@ -359,6 +453,8 @@ def engine_generate(
                 free, ntop = paged_kv.init_alloc(NP)
                 state["free"], state["ntop"] = free, ntop
                 state["table"] = jnp.zeros((SLOTS, MP), jnp.int32)
+                if refcounted:
+                    state["refcnt"] = paged_kv.init_refcounts(NP)
             else:
                 state["table"] = _contig_table()
         state.update(
@@ -386,7 +482,7 @@ def engine_generate(
         )
         return state
 
-    def _paged_cache(pool, state, slot_pos, key_mask):
+    def _paged_cache(pool, state, slot_pos, key_mask, draft=False):
         cache = dict(
             pool,
             page_table=state["table"],
@@ -396,10 +492,53 @@ def engine_generate(
         )
         if not spec.paged:
             cache["contiguous"] = True
+        elif spec.paged_attention_impl != "xla":
+            cache["attn_impl"] = spec.paged_attention_impl
+        if draft and shared:
+            # the draft's trunk layers read/write the POLICY pool's
+            # trunk slots; its branch layers the extension slots
+            cache["layer_ixs"] = draft_layer_ixs
         return cache
 
+    def _draft_pool(state):
+        return state["pool"] if shared else state["dpool"]
+
+    def _with_draft_pool(state, pool):
+        return dict(state, pool=pool) if shared else dict(state, dpool=pool)
+
+    def _note_alloc(state, ids):
+        """Freshly popped pages enter with refcount 2 in spec-decode
+        mode: one hold per stream (policy + draft) of the page."""
+        if not refcounted:
+            return state
+        return dict(
+            state,
+            refcnt=state["refcnt"].at[ids].add(
+                2 * (ids > 0).astype(jnp.int32)
+            ),
+        )
+
+    def _free_slot_pages(state, pages, is_real):
+        """Return pages to the free stack. Spec-decode mode releases
+        through the refcount machinery — one decrement per stream, the
+        second (count-zero) release pushes the page — so trunk pages
+        are provably held ONCE and `free + held == pool` balances."""
+        if refcounted:
+            free, ntop, rc = paged_kv.release_refcounted(
+                state["free"], state["ntop"], state["refcnt"], pages, is_real
+            )
+            free, ntop, rc = paged_kv.release_refcounted(
+                free, ntop, rc, pages, is_real
+            )
+            return dict(state, free=free, ntop=ntop, refcnt=rc)
+        free, ntop = paged_kv.push_free(
+            state["free"], state["ntop"], pages, is_real
+        )
+        return dict(state, free=free, ntop=ntop)
+
     def _prefill_into_slots(
-        prms, pool, state, ids, mask, posns, slot, do, ready=None
+        prms, pool, state, ids, mask, posns, slot, do, ready=None,
+        branch_only=False,
     ):
         """Dense prefill of [R, P] prompts, scattered into `slot`'s
         pages. Returns (pool, last_hidden [R, E]). ``ready`` [R] gates
@@ -407,7 +546,10 @@ def engine_generate(
         positions live in SHARED pages, already prefilled by the
         request that created the cache entry — this v1 recomputes their
         KV transiently in the temp cache but never writes it, which is
-        what makes the shared pages safely read-only)."""
+        what makes the shared pages safely read-only). ``branch_only``
+        (trunk-sharing draft prefill) scatters just the draft's BRANCH
+        layers into the pool's extension slots: its trunk KV is the
+        policy prefill's, already written."""
         key_mask = jnp.concatenate(
             [mask, jnp.zeros((R, Pc - P), jnp.int32)], axis=1
         ) if Pc != P else mask
@@ -417,6 +559,15 @@ def engine_generate(
         )
         ck = out["cache"]["k"][:, :, :P]  # [L, R, P, Hkv, D]
         cv = out["cache"]["v"][:, :, :P]
+        lsel = None
+        if branch_only:
+            ck = ck[cfg.n_layer - KB:]
+            cv = cv[cfg.n_layer - KB:]
+            lsel = cfg.n_layer + jnp.arange(KB, dtype=jnp.int32)
+        elif shared:
+            # extended pool: the policy stack fills layers 0..L-1, the
+            # extension slots belong to the draft branch
+            lsel = jnp.arange(cfg.n_layer, dtype=jnp.int32)
         tbl = state["table"][jnp.clip(slot, 0, SLOTS - 1)]
         prompt_pos = jnp.broadcast_to(
             jnp.arange(P, dtype=jnp.int32)[None, :], (R, P)
@@ -431,20 +582,28 @@ def engine_generate(
             vq, vs = paged_kv.quantize_rows(cv)
             pool = dict(
                 pool,
-                pk=paged_kv.scatter_prefill(pool["pk"], pids, offs, kq),
-                pv=paged_kv.scatter_prefill(pool["pv"], pids, offs, vq),
+                pk=paged_kv.scatter_prefill(
+                    pool["pk"], pids, offs, kq, layer_ixs=lsel
+                ),
+                pv=paged_kv.scatter_prefill(
+                    pool["pv"], pids, offs, vq, layer_ixs=lsel
+                ),
                 pk_scale=paged_kv.scatter_prefill(
-                    pool["pk_scale"], pids, offs, ks
+                    pool["pk_scale"], pids, offs, ks, layer_ixs=lsel
                 ),
                 pv_scale=paged_kv.scatter_prefill(
-                    pool["pv_scale"], pids, offs, vs
+                    pool["pv_scale"], pids, offs, vs, layer_ixs=lsel
                 ),
             )
         else:
             pool = dict(
                 pool,
-                pk=paged_kv.scatter_prefill(pool["pk"], pids, offs, ck),
-                pv=paged_kv.scatter_prefill(pool["pv"], pids, offs, cv),
+                pk=paged_kv.scatter_prefill(
+                    pool["pk"], pids, offs, ck, layer_ixs=lsel
+                ),
+                pv=paged_kv.scatter_prefill(
+                    pool["pv"], pids, offs, cv, layer_ixs=lsel
+                ),
             )
         return pool, out["hidden_states"][:, -1]
 
@@ -474,10 +633,10 @@ def engine_generate(
             # return the refilled slots' old pages, then allocate fresh
             # prompt pages (often the very pages just freed)
             old = state["table"][jnp.clip(slot, 0, SLOTS - 1)]
-            free, ntop = paged_kv.push_free(
-                state["free"], state["ntop"], old.reshape(-1),
-                jnp.repeat(do, MP),
+            state = _free_slot_pages(
+                state, old.reshape(-1), jnp.repeat(do, MP)
             )
+            free, ntop = state["free"], state["ntop"]
             table = state["table"].at[slot].set(0, mode="drop")
             pgrid_pp = jnp.arange(PP, dtype=jnp.int32)[None, :]
             if serving:
@@ -487,9 +646,10 @@ def engine_generate(
                 got, free, ntop = paged_kv.pop_pages(
                     free, ntop, want.reshape(-1)
                 )
-                shared = warm["row_table"][qc][:, :PP]
+                shared_rows = warm["row_table"][qc][:, :PP]
                 entries = jnp.where(
-                    pgrid_pp < ready_pg[:, None], shared, got.reshape(R, PP)
+                    pgrid_pp < ready_pg[:, None], shared_rows,
+                    got.reshape(R, PP),
                 )
             else:
                 got, free, ntop = paged_kv.pop_pages(
@@ -499,7 +659,9 @@ def engine_generate(
             table = table.at[slot[:, None], pgrid_pp].set(
                 entries, mode="drop"
             )
-            state = dict(state, free=free, ntop=ntop, table=table)
+            state = _note_alloc(
+                dict(state, free=free, ntop=ntop, table=table), got
+            )
 
         posns = jnp.maximum(jnp.cumsum(mask, axis=1) - 1, 0)
         pool, h_last = _prefill_into_slots(
@@ -509,9 +671,10 @@ def engine_generate(
         state = dict(state, pool=pool)
         if spec.spec_decode:
             dpool, _ = _prefill_into_slots(
-                draft_params, state["dpool"], state, ids, mask, posns, slot, do
+                draft_params, _draft_pool(state), state, ids, mask, posns,
+                slot, do, branch_only=bool(shared),
             )
-            state = dict(state, dpool=dpool)
+            state = _with_draft_pool(state, dpool)
 
         if spec.paged:
             # prompt-pad page COMPACTION: a prompt page holding nothing
@@ -539,9 +702,10 @@ def engine_generate(
                 is_dead = is_dead & (pgrid >= ready_pg[:, None])
             rows_tbl = state["table"][jnp.clip(slot, 0, SLOTS - 1)][:, :PP]
             # the freed pages are this refill's own fresh pops (never a
-            # cache entry's), so the refcount-free push is exact
-            free, ntop = paged_kv.push_free(
-                state["free"], state["ntop"], rows_tbl.reshape(-1),
+            # cache entry's), so the release is exact: refcount-free
+            # push, or both stream holds dropped in spec-decode mode
+            state = _free_slot_pages(
+                state, rows_tbl.reshape(-1),
                 (is_dead & (rows_tbl > 0)).reshape(-1),
             )
             reclaimed_now = (is_dead & (rows_tbl > 0)).sum().astype(jnp.int32)
@@ -549,7 +713,7 @@ def engine_generate(
                 jnp.where(is_dead, 0, rows_tbl), mode="drop"
             )
             state = dict(
-                state, free=free, ntop=ntop, table=table,
+                state, table=table,
                 reclaimed=state["reclaimed"] + reclaimed_now,
             )
 
@@ -637,12 +801,10 @@ def engine_generate(
                 saved_tables=saved_tables, saved_len=saved_len,
                 pinned=pinned,
             )
-        free, ntop = paged_kv.push_free(
-            state["free"], state["ntop"], rows.reshape(-1),
-            jnp.repeat(lanes, MP),
+        state = _free_slot_pages(
+            state, rows.reshape(-1), jnp.repeat(lanes, MP)
         )
-        table = jnp.where(lanes[:, None], 0, rows)
-        return dict(state, free=free, ntop=ntop, table=table)
+        return dict(state, table=jnp.where(lanes[:, None], 0, rows))
 
     def _ensure_page(state: Dict[str, Any], position: Array) -> Dict[str, Any]:
         """Lazy response-page allocation for each active lane's write at
@@ -659,14 +821,17 @@ def engine_generate(
             jnp.arange(SLOTS), pi
         ].set(jnp.where(miss & (got > 0), got, have))
         starve = miss & (got == 0)
-        state = dict(
-            state,
-            free=free,
-            ntop=ntop,
-            table=table,
-            active=active & ~starve,
-            oom=state["oom"] + starve.sum().astype(jnp.int32),
-            truncated=state["truncated"] + starve.sum().astype(jnp.int32),
+        state = _note_alloc(
+            dict(
+                state,
+                free=free,
+                ntop=ntop,
+                table=table,
+                active=active & ~starve,
+                oom=state["oom"] + starve.sum().astype(jnp.int32),
+                truncated=state["truncated"] + starve.sum().astype(jnp.int32),
+            ),
+            got,
         )
         return _release_pages(state, starve)
 
@@ -728,7 +893,9 @@ def engine_generate(
         # -- draft: K single-token steps off the reference ---------------
         def dbody(carry, j):
             dpool, tok_in = carry
-            cache = _paged_cache(dpool, dict(state, active=active), p + j, km)
+            cache = _paged_cache(
+                dpool, dict(state, active=active), p + j, km, draft=True
+            )
             out = model(
                 draft_params, tok_in[:, None],
                 positions=(base_pos + j)[:, None], cache=cache,
@@ -752,14 +919,19 @@ def engine_generate(
             return (dpool, x), (x, jax.nn.softmax(ql, axis=-1))
 
         (dpool, _), (xs, qprobs) = jax.lax.scan(
-            dbody, (state["dpool"], state["cur"]),
+            dbody, (_draft_pool(state), state["cur"]),
             jnp.arange(K, dtype=jnp.int32),
         )
         xs = xs.transpose(1, 0)  # [SLOTS, K]
 
         # -- verify: ONE policy forward over the k drafted inputs --------
+        # Trunk sharing: the verify runs on the POST-draft pool (the
+        # draft just wrote its branch KV into the extension layers —
+        # and its trunk writes, which the verify's own update-carry-
+        # first scatter overwrites with the identical values).
         ver_in = jnp.concatenate([state["cur"][:, None], xs[:, : K - 1]], axis=1)
-        cache = _paged_cache(state["pool"], dict(state, active=active), p, km)
+        ver_pool = dpool if shared else state["pool"]
+        cache = _paged_cache(ver_pool, dict(state, active=active), p, km)
         out = model(
             params, ver_in,
             positions=base_pos[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :],
@@ -838,10 +1010,12 @@ def engine_generate(
         km = km.at[jnp.arange(SLOTS)[:, None], window].set(
             keep.astype(jnp.int32)
         )
+        # shared mode: `pool` (the verify output) already carries the
+        # draft's branch-layer writes — there is no second buffer
         state = dict(
             state,
             pool=pool,
-            dpool=dpool,
+            **({} if shared else {"dpool": dpool}),
             kmask=km,
             resp_ids=resp_ids,
             resp_mask=resp_mask,
@@ -892,6 +1066,15 @@ def engine_generate(
         "reclaimed_pages": final["reclaimed"],
         "unserved": Q - final["qnext"],
     }
+    if spec.paged:
+        # end-of-call free-stack depth: with every lane finished this
+        # must equal pool - 1 (the null page) — the `free + held ==
+        # pool` balance the spec-decode accounting tests pin
+        stats["free_pages"] = final["ntop"]
+    if refcounted:
+        # pages still refcount-held at exit (0 after a drained chunk):
+        # free_pages + held_pages + 1 null page == pool, always
+        stats["held_pages"] = (final["refcnt"] > 0).sum().astype(jnp.int32)
     if spec.spec_decode:
         stats.update(
             spec_rounds=final["rounds"],
@@ -906,7 +1089,6 @@ def engine_generate(
     }
     if serving:
         stats["pinned_pages"] = final["pinned"]
-        stats["free_pages"] = final["ntop"]
         # the persistent pool state the serving host carries into the
         # next call (plus per-row pin adoptions)
         out["kv_state"] = {
@@ -920,18 +1102,148 @@ def engine_generate(
     return out
 
 
+def engine_generate_grouped(
+    model: TransformerLM,
+    params: Dict,
+    q_ids: Array,  # [Q, P]
+    q_mask: Array,  # [Q, P]
+    rng: jax.Array,
+    settings: SamplerSettings,
+    spec: EngineSpec,
+    draft_params: Optional[Dict] = None,
+    row_budget: Optional[Array] = None,
+    group_sharding=None,
+) -> Dict[str, Array]:
+    """Run the engine as ``spec.data_groups`` INDEPENDENT lane groups.
+
+    The queue splits into G contiguous shards; each shard gets its own
+    full engine instance — slots, page pool, page table, free stack —
+    and all G run as ONE stacked dispatch (`jax.vmap` over the group
+    axis). With ``group_sharding`` (a `NamedSharding` whose axis 0 spec
+    names mesh data axes) the stacked queue is sharding-constrained so
+    GSPMD places each group's engine state — pools, tables, slot lanes
+    — on that group's device slice: the engine's control flow stays one
+    program, but its memory and per-step compute shard over the mesh
+    instead of replicating (multi-chip rollout workers / serve
+    frontends, ROADMAP item 3's second half).
+
+    Output equivalence is structural: RNG ids are the GLOBAL queue row
+    (``q_rng_row``) and the acceptance/residual offsets use the global
+    id space (``rng_space``), so greedy output is token-for-token the
+    single-group engine's, and sampled streams are the same draws. A
+    queue not divisible by G is padded with dummy rows (one real token,
+    budget 1 — the serving tier's padding trick); their emissions are
+    trimmed from the outputs and subtracted from the stats.
+    """
+    G = spec.data_groups
+    if G <= 1:
+        return engine_generate(
+            model, params, q_ids, q_mask, rng, settings, spec,
+            draft_params=draft_params, row_budget=row_budget,
+        )
+    Q, P = q_ids.shape
+    N = settings.max_new_tokens
+    Qg = -(-Q // G)
+    npad = G * Qg - Q
+    q_ids = q_ids.astype(jnp.int32)
+    q_mask = q_mask.astype(jnp.int32)
+    if row_budget is None:
+        row_budget = jnp.full((Q,), N, jnp.int32)
+    row_budget = jnp.clip(row_budget.astype(jnp.int32), 1, N)
+    if npad:
+        pad_ids = jnp.full(
+            (npad, P), settings.pad_token_id, jnp.int32
+        ).at[:, -1].set(0)
+        pad_mask = jnp.zeros((npad, P), jnp.int32).at[:, -1].set(1)
+        q_ids = jnp.concatenate([q_ids, pad_ids])
+        q_mask = jnp.concatenate([q_mask, pad_mask])
+        row_budget = jnp.concatenate(
+            [row_budget, jnp.ones((npad,), jnp.int32)]
+        )
+    rng_rows = jnp.arange(G * Qg, dtype=jnp.int32)
+
+    def split(x):
+        return x.reshape((G, Qg) + x.shape[1:])
+
+    gq_ids, gq_mask = split(q_ids), split(q_mask)
+    g_budget, g_rows = split(row_budget), split(rng_rows)
+    if group_sharding is not None:
+        gq_ids = jax.lax.with_sharding_constraint(gq_ids, group_sharding)
+        gq_mask = jax.lax.with_sharding_constraint(gq_mask, group_sharding)
+    # an EXPLICIT pool_pages is the TOTAL page budget (same meaning as
+    # the single-group run): each group gets its ceil(1/G) share. Note
+    # the one caveat this implies: under a DELIBERATELY undersized
+    # budget, which lanes oom-truncate can differ from the single-group
+    # run (allocation is per-group, not global) — the token-for-token
+    # guarantee is for pools that don't starve, and the default
+    # worst-case sizing (pool_pages=0) never starves.
+    sub = dataclasses.replace(
+        spec, data_groups=1,
+        pool_pages=-(-spec.pool_pages // G) if spec.pool_pages else 0,
+    )
+    SLOTS = max(1, min(sub.slots, Qg))
+
+    def one_group(ids, mask, budget, rows):
+        return engine_generate(
+            model, params, ids, mask, rng, settings, sub,
+            draft_params=draft_params, row_budget=budget,
+            q_rng_row=rows, rng_space=Q,
+        )
+
+    out = jax.vmap(one_group)(gq_ids, gq_mask, g_budget, g_rows)
+    merged = {
+        k: out[k].reshape((G * Qg,) + out[k].shape[2:])[:Q]
+        for k in ("sequences", "response_ids", "response_mask")
+    }
+    g = out["gen_stats"]  # every stat is [G]
+    steps = g["decode_steps"].sum()
+    lane_steps = g["occupancy"] * g["decode_steps"].astype(jnp.float32) * SLOTS
+    stats: Dict[str, Array] = {
+        "decode_steps": steps,
+        "refills": g["refills"].sum(),
+        "real_tokens": g["real_tokens"].sum(),
+        "occupancy": lane_steps.sum()
+        / jnp.maximum(steps.astype(jnp.float32) * SLOTS, 1.0),
+        "truncated": g["truncated"].sum(),
+        "oom_truncated": g["oom_truncated"].sum(),
+        "reclaimed_pages": g["reclaimed_pages"].sum(),
+        "unserved": g["unserved"].sum(),
+    }
+    for k in ("free_pages", "held_pages", "spec_rounds", "drafted", "accepted"):
+        if k in g:
+            stats[k] = g[k].sum()
+    if npad:
+        # dummy-row corrections: each pad row emits exactly its single
+        # budgeted token through one refill, and counts truncated
+        # unless that token happened to be EOS
+        dummy_tok = out["response_ids"].reshape(G * Qg, -1)[Q:, 0]
+        dummy_eos = (dummy_tok == jnp.int32(settings.eos_token_id)).sum(
+            dtype=jnp.int32
+        )
+        stats["real_tokens"] = stats["real_tokens"] - npad
+        stats["refills"] = stats["refills"] - npad
+        stats["truncated"] = stats["truncated"] - (npad - dummy_eos)
+    merged["gen_stats"] = stats
+    return merged
+
+
 def make_engine_fn(
     model: TransformerLM,
     settings: SamplerSettings,
     spec: EngineSpec,
 ):
     """Jitted engine entry: `(params[, draft_params], q_ids, q_mask,
-    rng[, row_budget]) -> outputs`. One executable per (Q, P) shape."""
+    rng[, row_budget]) -> outputs`. One executable per (Q, P) shape.
+    Routes through the grouped wrapper when the spec asks for sharded
+    lane groups (`data_groups > 1`)."""
+    run = (
+        engine_generate_grouped if spec.data_groups > 1 else engine_generate
+    )
     if spec.spec_decode:
 
         @partial(jax.jit, static_argnums=())
         def fn(params, draft_params, q_ids, q_mask, rng, row_budget=None):
-            return engine_generate(
+            return run(
                 model, params, q_ids, q_mask, rng, settings, spec,
                 draft_params=draft_params, row_budget=row_budget,
             )
@@ -940,7 +1252,7 @@ def make_engine_fn(
 
     @partial(jax.jit, static_argnums=())
     def fn(params, q_ids, q_mask, rng, row_budget=None):
-        return engine_generate(
+        return run(
             model, params, q_ids, q_mask, rng, settings, spec,
             row_budget=row_budget,
         )

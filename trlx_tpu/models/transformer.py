@@ -274,6 +274,7 @@ class Attention(nn.Module):
                 cache["slot_pos"], attn_bias, scale,
                 lane_valid=cache.get("lane_valid"),
                 contiguous=bool(cache.get("contiguous", False)),
+                impl=cache.get("attn_impl", "xla"),
             )
         elif cache is not None:
             # update-carry-FIRST: write this layer's new [B, T, Hkv, D]
@@ -1063,7 +1064,10 @@ class TransformerLM:
             )
             meta = {
                 name: cache[name]
-                for name in ("page_table", "slot_pos", "lane_valid", "contiguous")
+                for name in (
+                    "page_table", "slot_pos", "lane_valid", "contiguous",
+                    "attn_impl",
+                )
                 if name in cache
             }
 
@@ -1083,7 +1087,15 @@ class TransformerLM:
             from trlx_tpu.ops.remat import wrap_remat as _wrap
 
             paged_body = _wrap(paged_body, remat)
-            xs: Dict[str, Any] = {"p": block_params, "ix": jnp.arange(n)}
+            # "layer_ixs" remaps this forward's layers onto pool layer
+            # slots (gen_engine's spec-decode trunk sharing: the hydra
+            # DRAFT's trunk layers index the policy pool's trunk — their
+            # KV is identical by construction — while its branch layers
+            # index the extension slots past the policy stack)
+            layer_ixs = cache.get("layer_ixs")
+            if layer_ixs is None:
+                layer_ixs = jnp.arange(n)
+            xs: Dict[str, Any] = {"p": block_params, "ix": layer_ixs}
             if flags is not None:
                 xs["flag"] = flags
             carry, _ = jax.lax.scan(

@@ -165,6 +165,11 @@ class TelemetryAggregator:
             row["engine"] = {
                 k: round(v, 4) for k, v in sorted(self._last_stats.items())
             }
+            # provenance: WHICH decode implementation (static sampler /
+            # engine xla gather / engine pallas kernel, x lane groups)
+            # produced the tokens behind these numbers
+            if self.static.get("decode_impl"):
+                row["engine"]["decode_impl"] = self.static["decode_impl"]
         self.cycles.append(row)
         del self.cycles[: max(len(self.cycles) - self.max_cycles, 0)]
         return row
@@ -222,6 +227,13 @@ class TelemetryAggregator:
             out["engine"] = {
                 k: round(v, 4) for k, v in sorted(self._last_stats.items())
             }
+        # kernel attribution for the headline: a recorded telemetry.json
+        # must say which decode implementation its tok/s number came
+        # from (static sampler vs engine-paged-xla vs engine-paged-
+        # pallas, x lane groups) — the same honesty rule as the bench
+        # pillars' per-pillar attribution
+        if self.static.get("decode_impl"):
+            out["decode_impl"] = self.static["decode_impl"]
         mfu = self.mfu_estimate(rows)
         if mfu is not None:
             out["mfu_estimate"] = mfu

@@ -215,3 +215,29 @@ def running_moments_update(
     )
     batch_std = jnp.sqrt(xs_var * xs_count / jnp.maximum(xs_count - 1, 1e-8))
     return new_state, xs_mean, batch_std
+
+
+# ---------------------------------------------------------------------------
+# Shared pallas plumbing — every kernel family (ops/flash_attention.py,
+# ops/decode_attention.py, the paged decode kernel) makes the same two
+# decisions the same way; private per-file copies of these had already
+# drifted into three call sites before they were factored here.
+# ---------------------------------------------------------------------------
+
+
+def interpret_mode() -> bool:
+    """True when pallas kernels should run interpreted (no Mosaic on
+    this backend). CPU-only: TPU/GPU lower for real. Tier-1 runs every
+    kernel through this path, which is what makes kernel==reference
+    goldens runnable without device time."""
+    return jax.default_backend() == "cpu"
+
+
+def pick_block(n: int, block: int) -> int:
+    """Largest power-of-two shrink of `block` that divides `n` (from
+    min(block, n) downward). Callers gate `n` on their own alignment
+    floors (e.g. 128-divisibility for lane-dim dynamic slices)."""
+    b = min(block, n)
+    while n % b:
+        b //= 2
+    return b

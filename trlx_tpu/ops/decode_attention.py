@@ -1,4 +1,18 @@
-"""Pallas fused single-token decode attention over an int8 KV cache.
+"""Pallas fused decode attention: contiguous int8 caches AND paged pools.
+
+Two kernel families live here behind the two decode-cache layouts:
+
+  * ``decode_attention_int8`` — the original contiguous-layout kernel
+    (stacked [L, B, Hkv, S, D] int8 caches, one (batch, kv-head) grid
+    cell streaming its S-width rows; design notes below).
+  * ``paged_attention_pallas`` (selected through
+    ``paged_attention_step(impl="pallas")``) — the paged-pool kernel:
+    the slot→page table becomes the block index map, so K/V pages load
+    from the pool's HBM layout without the gathered S-width cache ever
+    materializing, per-row int8 scales fold into the score/prob tiles
+    in-kernel, GQA attends grouped, and the same kernel serves the T=1
+    decode step and the T=draft_k speculative verify forward.
+
 
 Decode at large batch×seq is bound on the full-cache read every step
 (1.61 GB int8 at 1.3B b8 seq2048). Driving that read through XLA ops
@@ -39,6 +53,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from trlx_tpu.ops.common import interpret_mode as _interpret
+
 NEG_INF = -1e30
 CHUNK = 512  # fp32 score tile per in-kernel step: [rep, CHUNK]
 
@@ -55,18 +71,37 @@ def paged_attention_step(
     sm_scale: float,
     lane_valid: Optional[jnp.ndarray] = None,  # [B] bool; False -> trash write
     contiguous: bool = False,
+    impl: str = "xla",
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """One layer's attention over a paged KV cache: write the T incoming
     tokens' K/V into their pages, then attend every query against the
-    slot's full logical sequence (gathered pages), with the per-row
-    quant scales folded into the score / prob vectors so int8 K/V are
-    never dequantized at S width (the dense int8 path's folded-scale
-    recipe, generalized to per-row indirection and per-row positions).
+    slot's full logical sequence, with the per-row quant scales folded
+    into the score / prob vectors so int8 K/V are never dequantized at
+    S width (the dense int8 path's folded-scale recipe, generalized to
+    per-row indirection and per-row positions).
 
     Serves both the single-token decode step (T=1) and the speculative
     verify forward (T=draft_k): causality among the T incoming tokens is
     carried by `attn_bias` (slot-index comparison), so the same code is
     exact for both. Returns (out [B, T, H, D], updated pools).
+
+    ``impl`` selects the attend half (``gen_engine.paged_attention_impl``):
+
+      xla     gather the slot's logical [B, S] view of the pool, then
+              plain-XLA attention over it. GQA attends GROUPED (one
+              einsum per kv-head group) — kv is never repeat-
+              materialized at S width.
+      pallas  :func:`paged_attention_pallas` — the page table becomes
+              the kernel's block index map, so K/V pages stream from
+              the pool's HBM layout into VMEM without the gathered
+              S-width cache ever existing.
+
+    The write half (a [B, T] scatter) is tiny and shared by both. The
+    ``contiguous`` layout always takes the XLA path: its gather
+    collapses to a slice+reshape that XLA fuses into the attention
+    reads like a dense cache, which is the exact behavior the
+    ``paged=false`` benches attribute against — a kernel there would
+    change the baseline, not beat it.
     """
     from trlx_tpu.ops.paged_kv import (
         gather_layer,
@@ -75,6 +110,8 @@ def paged_attention_step(
         write_positions,
     )
 
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"paged attention impl must be xla/pallas, got {impl!r}")
     B, T, H, D = q.shape
     Hkv = k_new.shape[2]
     PS = pools["pk"].shape[2]
@@ -101,16 +138,15 @@ def paged_attention_step(
     # read AFTER the write (update-carry-first, like the dense cache
     # branch): each query sees every token up to and including itself;
     # older/unwritten/stale slots are excluded by attn_bias
+    if impl == "pallas" and not contiguous:
+        out = paged_attention_pallas(
+            q, new_pools, layer_ix, page_table, attn_bias, sm_scale
+        )
+        return out, new_pools
+
     k_all = gather_layer(new_pools["pk"], layer_ix, page_table, contiguous)
     v_all = gather_layer(new_pools["pv"], layer_ix, page_table, contiguous)
-    if H != Hkv:
-        rep = H // Hkv
-        k_all = jnp.repeat(k_all, rep, axis=2)
-        v_all = jnp.repeat(v_all, rep, axis=2)
-    scores = jnp.einsum(
-        "bthd,bshd->bhts", q, k_all.astype(q.dtype),
-        preferred_element_type=jnp.float32,
-    ) * sm_scale
+    ks_all = vs_all = None
     if quant:
         ks_all = gather_layer(
             new_pools["pk_scale"], layer_ix, page_table, contiguous
@@ -118,25 +154,216 @@ def paged_attention_step(
         vs_all = gather_layer(
             new_pools["pv_scale"], layer_ix, page_table, contiguous
         )
-        if H != Hkv:
-            rep = H // Hkv
-            ks_all = jnp.repeat(ks_all, rep, axis=2)
-            vs_all = jnp.repeat(vs_all, rep, axis=2)
-        # per-row K scale rides the score tensor; per-row V scale rides
-        # the prob tensor — both commute out of the attention reductions
-        scores = scores * ks_all.transpose(0, 2, 1)[:, :, None, :]
-        probs = jax.nn.softmax(scores + attn_bias, axis=-1)
-        probs = (probs * vs_all.transpose(0, 2, 1)[:, :, None, :]).astype(
-            q.dtype
-        )
+    if H == Hkv:
+        scores = jnp.einsum(
+            "bthd,bshd->bhts", q, k_all.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if quant:
+            # per-row K scale rides the score tensor; per-row V scale
+            # rides the prob tensor — both commute out of the reductions
+            scores = scores * ks_all.transpose(0, 2, 1)[:, :, None, :]
+            probs = jax.nn.softmax(scores + attn_bias, axis=-1)
+            probs = (probs * vs_all.transpose(0, 2, 1)[:, :, None, :]).astype(
+                q.dtype
+            )
+        else:
+            probs = jax.nn.softmax(scores + attn_bias, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v_all.astype(q.dtype))
+        return out.astype(q.dtype), new_pools
+
+    # GQA: attend GROUPED — the einsum batches over kv heads with the
+    # rep query heads of each group as a free axis, so kv (and scales)
+    # are read at Hkv width instead of being jnp.repeat-materialized to
+    # H x S per step (the rep-fold memory the old fallback paid)
+    rep = H // Hkv
+    qg = q.reshape(B, T, Hkv, rep, D)
+    scores = jnp.einsum(
+        "btgrd,bsgd->bgrts", qg, k_all.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale  # [B, Hkv, rep, T, S]
+    bias_g = attn_bias[:, :, None]  # [B, 1, 1, T, S] broadcasts over (g, r)
+    if quant:
+        scores = scores * ks_all.transpose(0, 2, 1)[:, :, None, None, :]
+        probs = jax.nn.softmax(scores + bias_g, axis=-1)
+        probs = (
+            probs * vs_all.transpose(0, 2, 1)[:, :, None, None, :]
+        ).astype(q.dtype)
     else:
-        probs = jax.nn.softmax(scores + attn_bias, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhts,bshd->bthd", probs, v_all.astype(q.dtype))
-    return out.astype(q.dtype), new_pools
+        probs = jax.nn.softmax(scores + bias_g, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrts,bsgd->btgrd", probs, v_all.astype(q.dtype))
+    return out.reshape(B, T, H, D).astype(q.dtype), new_pools
 
 
-def _interpret() -> bool:
-    return jax.default_backend() == "cpu"
+def _paged_kernel(
+    lx_ref,  # scalar prefetch: [1] layer index (consumed by index maps)
+    pt_ref,  # scalar prefetch: [B*MP] flattened page table (index maps)
+    q_ref,  # [1, Hkv, rep*T, D] — group-blocked queries, rows t*rep+r
+    k_ref,  # [1, 1, PS, Hkv, D] — ONE page, routed here by pt_ref
+    v_ref,  # [1, 1, PS, Hkv, D]
+    *rest,  # (+ks_ref/vs_ref when quant) b_ref, o_ref, o/m/l scratch
+    sm_scale,
+    rep,
+    quant,
+):
+    """One (batch row, page) grid cell: score the row's queries against
+    this page's keys for every kv head, fold the page's per-row int8
+    scales in, and fold the tile into the online-softmax accumulators.
+    Pages are the INNERMOST grid axis, so the accumulators live in VMEM
+    scratch across the row's page sweep and the output block flushes
+    once at the last page."""
+    if quant:
+        ks_ref, vs_ref, b_ref, o_ref, o_scratch, m_scratch, l_scratch = rest
+    else:
+        b_ref, o_ref, o_scratch, m_scratch, l_scratch = rest
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    Hkv = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        o_scratch[...] = jnp.zeros_like(o_scratch)
+        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+
+    # additive bias strip [T, PS] carries ALL masking (per-row lengths,
+    # slot-index causality, null pages); rows are t*rep+r so the rep
+    # group members of token t share bias[t]
+    bias_rows = jnp.repeat(b_ref[0, 0], rep, axis=0)  # [rep*T, PS]
+    for h in range(Hkv):  # static unroll: per-kv-head 2D dots
+        qh = q_ref[0, h].astype(jnp.float32)  # [rep*T, D]
+        kh = k_ref[0, 0, :, h, :].astype(jnp.float32)  # [PS, D]
+        s = jax.lax.dot_general(
+            qh, kh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [rep*T, PS]
+        if quant:
+            # per-slot K dequant folded into the score tile
+            s = s * ks_ref[0, 0, :, h][None, :]
+        s = s + bias_rows
+        m_run = m_scratch[h]  # [rep*T, 1]
+        l_run = l_scratch[h]
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_run - m_new)
+        p = jnp.exp(s - m_new)
+        l_scratch[h] = l_run * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_scratch[h] = m_new
+        if quant:
+            # per-slot V dequant rides the prob tile (commutes out of
+            # the over-S dot, exactly like the gather path)
+            p = p * vs_ref[0, 0, :, h][None, :]
+        vh = v_ref[0, 0, :, h, :].astype(jnp.float32)
+        o_scratch[h] = o_scratch[h] * corr + jax.lax.dot_general(
+            p, vh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _flush():
+        o_ref[0] = (
+            o_scratch[...] / jnp.maximum(l_scratch[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(
+    q,  # [B, T, H, D]
+    pools: Dict[str, jnp.ndarray],  # POST-write pools (pk/pv [+ scales])
+    layer_ix,  # scalar int32
+    page_table,  # [B, MP] int32
+    attn_bias,  # [B, 1, T, S] additive fp32
+    sm_scale: float,
+):
+    """Pallas paged-attention: the page table IS the block index map.
+
+    Grid (B, MP) with pages innermost: cell (b, j) DMAs page
+    ``page_table[b, j]`` of this layer straight out of the pool's
+    [L, NP, PS, Hkv, D] HBM layout (both table and layer index arrive
+    as scalar-prefetch arguments, so the routing happens before the
+    kernel body runs) and folds it into per-(kv-head) online-softmax
+    accumulators held in VMEM scratch across the row's page sweep. The
+    gathered [B, S, Hkv, D] logical cache — the XLA path's three extra
+    O(S·D) materializations per layer — never exists anywhere. GQA
+    attends grouped: queries arrive group-blocked ([Hkv, rep*T, D] per
+    row), so each page is read ONCE per row and shared by its group's
+    rep query heads. Null pages (table entry 0) are loaded but fully
+    masked by the bias strip, matching the gather path's null-page
+    semantics slot for slot.
+
+    One kernel serves the T=1 decode step and the T=draft_k speculative
+    verify forward — causality among the T incoming tokens rides the
+    same slot-index ``attn_bias`` the XLA path uses.
+    """
+    B, T, H, D = q.shape
+    PS, Hkv = pools["pk"].shape[2], pools["pk"].shape[3]
+    MP = page_table.shape[1]
+    quant = "pk_scale" in pools
+    if H % Hkv:
+        raise ValueError(f"n_head={H} not a multiple of n_kv_head={Hkv}")
+    rep = H // Hkv
+    if not _interpret() and PS % 128:
+        raise ValueError(
+            f"gen_engine.paged_attention_impl=pallas needs page_size a "
+            f"multiple of 128 on TPU (got {PS}): the per-page bias/score "
+            "tiles are lane-blocked at 128 — use page_size=128 or "
+            "paged_attention_impl=xla"
+        )
+    # group-blocked queries: row t*rep + r of group g is query head
+    # g*rep + r at token t (consecutive rep heads share a kv head)
+    qg = q.reshape(B, T, Hkv, rep, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, Hkv, rep * T, D
+    )
+
+    def page_ix(b, j, lx, pt):
+        return (lx[0], pt[b * MP + j], 0, 0, 0)
+
+    def scale_ix(b, j, lx, pt):
+        return (lx[0], pt[b * MP + j], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, Hkv, rep * T, D), lambda b, j, lx, pt: (b, 0, 0, 0)),
+        pl.BlockSpec((1, 1, PS, Hkv, D), page_ix),
+        pl.BlockSpec((1, 1, PS, Hkv, D), page_ix),
+    ]
+    operands = [qg, pools["pk"], pools["pv"]]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, PS, Hkv), scale_ix),
+            pl.BlockSpec((1, 1, PS, Hkv), scale_ix),
+        ]
+        operands += [pools["pk_scale"], pools["pv_scale"]]
+    in_specs.append(
+        pl.BlockSpec((1, 1, T, PS), lambda b, j, lx, pt: (b, 0, 0, j))
+    )
+    operands.append(attn_bias.astype(jnp.float32))
+
+    kernel = functools.partial(
+        _paged_kernel, sm_scale=sm_scale, rep=rep, quant=quant
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, MP),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, Hkv, rep * T, D), lambda b, j, lx, pt: (b, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((Hkv, rep * T, D), jnp.float32),
+                pltpu.VMEM((Hkv, rep * T, 1), jnp.float32),
+                pltpu.VMEM((Hkv, rep * T, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep * T, D), q.dtype),
+        interpret=_interpret(),
+    )(
+        jnp.reshape(layer_ix, (1,)).astype(jnp.int32),
+        page_table.reshape(-1).astype(jnp.int32),
+        *operands,
+    )
+    return out.reshape(B, Hkv, T, rep, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, T, H, D
+    )
 
 
 def _decode_kernel(
@@ -214,9 +441,9 @@ def decode_attention_int8(
     # largest power-of-two chunk <= CHUNK that divides S: callers are
     # gated on S % 128 == 0, so this bottoms out at >= 128 (lane-aligned
     # for the in-kernel dynamic loads) instead of rejecting e.g. S=640
-    ckk = min(CHUNK, S)
-    while S % ckk:
-        ckk //= 2
+    from trlx_tpu.ops.common import pick_block
+
+    ckk = pick_block(S, CHUNK)
     if ckk < 128:
         raise ValueError(f"cache length {S} must be a multiple of 128")
 

@@ -41,15 +41,14 @@ from jax.ad_checkpoint import checkpoint_name
 import numpy as np
 from jax.experimental import pallas as pl
 
+from trlx_tpu.ops.common import interpret_mode as _interpret
+from trlx_tpu.ops.common import pick_block as _pick_block
+
 NEG_INF = -1e30
 # key/query chunk for the in-kernel loops: each fp32 score tile is
 # [block, CHUNK]. 1024 runs the 8k fwd+bwd ~3x faster than 512 on v5e
 # (better MXU occupancy per DMA) while keeping tiles ~1 MB in VMEM.
 CHUNK = 1024
-
-
-def _interpret() -> bool:
-    return jax.default_backend() == "cpu"
 
 
 def _attention_reference(q, k, v, key_mask, causal: bool, sm_scale: float):
@@ -70,13 +69,6 @@ def _attention_reference(q, k, v, key_mask, causal: bool, sm_scale: float):
         s = jnp.where(key_mask[:, None, None, :] > 0, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
-
-
-def _pick_block(n: int, block: int) -> int:
-    b = min(block, n)
-    while n % b:
-        b //= 2
-    return b
 
 
 def _tile_valid(bq, ck, row0, col0, causal):

@@ -38,11 +38,12 @@ becomes a reshape — and exists so the engine can attribute the paging
 indirection's cost/benefit separately from continuous batching
 (bench.py decode section).
 
-All ops here are plain XLA (gathers/scatters): the repo's own
-measurements (Attention's int8 branch) found the folded-scale XLA
-decode faster than the pallas kernel at the production geometry, so the
-paged path follows the same recipe; a pallas paged kernel can slot in
-behind `paged_attention_step` (ops/decode_attention.py) later.
+All ops here are plain XLA (gathers/scatters). The attend half has two
+implementations behind `paged_attention_step` (ops/decode_attention.py):
+the XLA gather path (grouped-GQA einsum over the logical [B, S] view)
+and the pallas paged kernel (`gen_engine.paged_attention_impl: pallas`),
+which uses the page table as its block index map so the gathered
+S-width view never materializes.
 """
 
 from __future__ import annotations
@@ -217,9 +218,19 @@ def scatter_prefill(
     pool_leaf: Array,  # [L, NP, PS, ...]
     pids: Array,  # [R, P]
     offs: Array,  # [R, P]
-    values: Array,  # [L, R, P, ...]
+    values: Array,  # [Lv, R, P, ...]
+    layer_ixs: Optional[Array] = None,  # [Lv] pool layer slots
 ) -> Array:
-    """Scatter a whole prefilled prompt block (all layers at once)."""
+    """Scatter a whole prefilled prompt block (all layers at once).
+
+    ``layer_ixs`` routes ``values``' layers onto specific pool layer
+    slots (gen_engine's spec-decode trunk sharing scatters the DRAFT's
+    branch layers into the extension slots past the policy stack);
+    None = identity (values span the whole leaf)."""
+    if layer_ixs is not None:
+        return pool_leaf.at[
+            layer_ixs[:, None, None], pids[None, :, :], offs[None, :, :]
+        ].set(values.astype(pool_leaf.dtype))
     return pool_leaf.at[:, pids, offs].set(values.astype(pool_leaf.dtype))
 
 

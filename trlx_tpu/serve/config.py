@@ -56,6 +56,17 @@ class ServeConfig:
                        are evicted (deadline eviction reclaims them).
     max_cache_entries  prefix + session entries kept before LRU
                        eviction of refcount-zero entries.
+    groups             independent serve LANE GROUPS: the frontend
+                       keeps one warm pool + page ledger per group,
+                       assigns requests to groups (sessions/prefixes
+                       sticky by key hash so their pinned pages stay
+                       in one pool), and runs every group in ONE
+                       stacked engine dispatch whose group axis shards
+                       over the mesh's data axes when the geometry
+                       divides — the serve frontend itself becomes
+                       multi-chip. Request token streams are
+                       per-request-id RNG and therefore invariant to
+                       the group count.
     transport          request/response backend (exp/net.py spec):
                        ``{}`` = shared_fs under
                        ``<train.checkpoint_dir>/serve``; ``{backend:
@@ -83,6 +94,7 @@ class ServeConfig:
     sessions: bool = True
     session_deadline_s: float = 600.0
     max_cache_entries: int = 32
+    groups: int = 1
     transport: Optional[Dict[str, Any]] = None
     seed: int = 0
 
@@ -115,4 +127,6 @@ class ServeConfig:
             )
         if cfg.max_batches_per_tick < 1:
             raise ValueError("train.serve.max_batches_per_tick must be >= 1")
+        if cfg.groups < 1:
+            raise ValueError("train.serve.groups must be >= 1")
         return cfg

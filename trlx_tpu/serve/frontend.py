@@ -73,6 +73,7 @@ class _RowCtx:
     adopt_session: Optional[str] = None  # session key to adopt at finish
     adopt_prefix: Optional[List[int]] = None  # pioneer's prefix ids
     shared_pages: int = 0
+    group: int = 0  # lane group whose pool/ledger serves this row
     note: str = ""  # surfaced in the result's detail
 
 
@@ -119,12 +120,37 @@ class ServeFrontend:
         self.NP = int(geom["pool_pages"])
         self.PP = -(-self.P // self.PS)
         self.pad_id = int(geom["pad_token_id"])
-        # the persistent serve pool (device) + its host ledger
-        self.pool = paged_kv.init_pool(
+        # sharded lane groups: G independent (pool, ledger) pairs; one
+        # stacked dispatch serves them all (the runner is vmapped over
+        # the group axis when G > 1 — trainer/base._serve_start).
+        # Requests route to groups sticky by session/prefix key, so an
+        # entry's pinned pages always live in the pool that holds them.
+        self.G = int(geom.get("groups", 1) or 1)
+        if self.G != cfg.groups:
+            raise ValueError(
+                f"serve geometry groups={self.G} != config groups="
+                f"{cfg.groups} — the runner was traced for a different "
+                "lane-group count"
+            )
+        # the persistent serve pool(s) (device) + host ledger(s): G == 1
+        # keeps the historic unstacked layout (and exact call contract);
+        # G > 1 stacks a leading group axis on every pool leaf
+        pool0 = paged_kv.init_pool(
             geom["n_layer"], self.NP, self.PS, geom["n_kv_head"],
             geom["head_dim"], geom["kv_quant"], geom["dtype"],
         )
-        self.ledger = skv.PageLedger(self.NP, self.PS)
+        if self.G == 1:
+            self.pool = pool0
+        else:
+            import jax.numpy as jnp
+
+            self.pool = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((self.G,) + x.shape, x.dtype), pool0
+            )
+        self.ledgers = [
+            skv.PageLedger(self.NP, self.PS) for _ in range(self.G)
+        ]
+        self.ledger = self.ledgers[0]  # the single-group fast path
         self.sched = SLOScheduler(cfg.default_deadline_s, cfg.max_batch)
         # serving RNG: ONE fixed base key — the engine folds the
         # per-request rng_row in, so a request's stream depends only on
@@ -230,9 +256,10 @@ class ServeFrontend:
             ))
         # a session with a turn already QUEUED must not lose its pinned
         # history to the idle-deadline sweep out from under that turn
-        self.ledger.expire_deadlines(
-            now, skip=self.sched.pending_session_keys()
-        )
+        for ledger in self.ledgers:
+            ledger.expire_deadlines(
+                now, skip=self.sched.pending_session_keys()
+            )
         starved = self.chaos is not None and self.chaos.consult(
             "serve_lane_starvation"
         )
@@ -292,8 +319,26 @@ class ServeFrontend:
         ])
         return ids, mask
 
+    def _group_of(self, req: ServeRequest) -> int:
+        """Lane group for a request: sessions and prefixes hash their
+        CACHE KEY (sticky — their pinned pages live in exactly one
+        group's pool), everything else hashes the request id (stateless
+        spread). Stable across processes (crc32, not PYTHONHASHSEED)."""
+        if self.G == 1:
+            return 0
+        import zlib
+
+        if req.session_id and self.cfg.sessions:
+            key = skv.session_key(req.session_id)
+        elif req.prefix_ids and self.cfg.prefix_cache:
+            key = skv.prefix_key(list(req.prefix_ids))
+        else:
+            key = req.rid
+        return zlib.crc32(key.encode()) % self.G
+
     def _build_row(
-        self, pend: Pending, now: float, used_keys: set
+        self, pend: Pending, now: float, used_keys: set,
+        ledger: skv.PageLedger,
     ) -> _RowCtx:
         req = pend.req
         budget = min(
@@ -313,7 +358,7 @@ class ServeFrontend:
                 # turn would fork the pinned conversation
                 raise DeferRow()
             used_keys.add(key)
-            entry = self.ledger.acquire(key, now)
+            entry = ledger.acquire(key, now)
             if entry is not None:
                 tail_ids = list(entry.pending_ids) + list(req.prompt_ids)
                 tail_mask = list(entry.pending_mask) + [1] * len(
@@ -325,7 +370,7 @@ class ServeFrontend:
                         tail_mask,
                     )
                 except RowError:
-                    self.ledger.release(key)
+                    ledger.release(key)
                     raise RowError(
                         "session overflow: the pinned conversation plus "
                         "the new turn no longer fits the serve row — end "
@@ -344,19 +389,21 @@ class ServeFrontend:
             # expected a continuation (entry deadline-evicted between
             # turns) can see it was served without context
             ctx = self._prefix_or_plain(
-                pend, budget, rrow, now, pin=True, used_keys=used_keys
+                pend, budget, rrow, now, pin=True, used_keys=used_keys,
+                ledger=ledger,
             )
             ctx.adopt_session = key
             ctx.note = "fresh session (no pinned history)"
             return ctx
 
         return self._prefix_or_plain(
-            pend, budget, rrow, now, pin=False, used_keys=used_keys
+            pend, budget, rrow, now, pin=False, used_keys=used_keys,
+            ledger=ledger,
         )
 
     def _prefix_or_plain(
         self, pend: Pending, budget: int, rrow: int, now: float, pin: bool,
-        used_keys: set,
+        used_keys: set, ledger: skv.PageLedger,
     ) -> _RowCtx:
         req = pend.req
         table_row = np.zeros(self.MP, np.int32)
@@ -364,7 +411,7 @@ class ServeFrontend:
         A = skv.aligned_len(len(prefix), self.PS)
         if self.cfg.prefix_cache and A >= self.PS:
             key = skv.prefix_key(prefix)
-            entry = self.ledger.acquire(key, now)
+            entry = ledger.acquire(key, now)
             if entry is not None:
                 try:
                     ids, mask = self._compose(
@@ -376,7 +423,7 @@ class ServeFrontend:
                     # over-long request: the acquired ref must not
                     # outlive the row (a leaked ref would pin the
                     # entry's pages against eviction forever)
-                    self.ledger.release(key)
+                    ledger.release(key)
                     raise
                 npg = len(entry.pages)
                 table_row[:npg] = entry.pages
@@ -416,12 +463,15 @@ class ServeFrontend:
 
     def _run_batch(self, batch: List[Pending]) -> None:
         now = self._clock()
-        rows: List[_RowCtx] = []
+        rows_by_group: List[List[_RowCtx]] = [[] for _ in range(self.G)]
         used_keys: set = set()
         deferred: List[Pending] = []
         for pend in batch:
+            g = self._group_of(pend.req)
             try:
-                rows.append(self._build_row(pend, now, used_keys))
+                ctx = self._build_row(pend, now, used_keys, self.ledgers[g])
+                ctx.group = g
+                rows_by_group[g].append(ctx)
             except DeferRow:
                 deferred.append(pend)
             except RowError as e:
@@ -433,10 +483,11 @@ class ServeFrontend:
                 ))
         if deferred:
             self.sched.requeue(deferred)
+        rows = [c for grp in rows_by_group for c in grp]
         if not rows:
             return
         try:
-            self._dispatch_rows(rows)
+            self._dispatch_rows(rows_by_group)
         except Exception:
             # a failed batch (device error, transport hiccup mid-result)
             # must not strand its requests: release every still-held
@@ -446,7 +497,7 @@ class ServeFrontend:
             # wedge or a leaked pin)
             for c in rows:
                 if c.entry_key is not None:
-                    self.ledger.release(c.entry_key)
+                    self.ledgers[c.group].release(c.entry_key)
                     c.entry_key = None
             self.sched.requeue([c.pend for c in rows])
             self.stats["batch_failures"] = (
@@ -454,22 +505,12 @@ class ServeFrontend:
             )
             raise
 
-    def _dispatch_rows(self, rows: List[_RowCtx]) -> None:
-        import jax.numpy as jnp
-
-        # pool pressure: make room for the batch's worst-case pages —
-        # prompt AND response (a lane can grow to MP pages through
-        # decode) — by LRU-evicting refcount-zero entries; a shortfall
-        # degrades to fewer admitted lanes inside the engine
-        # (oom-truncation, reported as an error result), never a
-        # deadlock
-        self.ledger.evict_for(
-            len(rows) * self.MP, self.cfg.max_cache_entries
-        )
+    def _assemble_group(self, rows: List[_RowCtx]):
+        """One group's [max_batch]-wide engine arrays; unfilled rows are
+        dummy lanes (one real token, budget 1 — finished at refill)."""
         Q = self.cfg.max_batch
         ids = np.full((Q, self.P), self.pad_id, np.int32)
         mask = np.zeros((Q, self.P), np.int32)
-        # dummy rows: one real token, budget 1 — finished at refill
         ids[:, -1] = 0
         mask[:, -1] = 1
         budget = np.ones(Q, np.int32)
@@ -484,94 +525,168 @@ class ServeFrontend:
             ready[i] = c.ready
             rngrow[i] = c.rngrow
             table[i] = c.table_row
-        refcnt = self.ledger.compose_refcnt(
-            [c.table_row for c in rows if c.ready > 0]
-        )
-        warm = {
-            "pool": self.pool,
-            "free": jnp.asarray(self.ledger.free),
-            "ntop": jnp.int32(self.ledger.ntop),
-            "refcnt": jnp.asarray(refcnt),
-            "row_table": jnp.asarray(table),
-        }
+        return ids, mask, budget, pin, ready, rngrow, table
+
+    def _dispatch_rows(self, rows_by_group: List[List[_RowCtx]]) -> None:
+        import jax.numpy as jnp
+
+        # pool pressure: make room for each group's worst-case pages —
+        # prompt AND response (a lane can grow to MP pages through
+        # decode) — by LRU-evicting refcount-zero entries; a shortfall
+        # degrades to fewer admitted lanes inside the engine
+        # (oom-truncation, reported as an error result), never a
+        # deadlock
+        for g, grp in enumerate(rows_by_group):
+            if grp:
+                self.ledgers[g].evict_for(
+                    len(grp) * self.MP, self.cfg.max_cache_entries
+                )
+        assembled = [self._assemble_group(grp) for grp in rows_by_group]
+        refcnts = [
+            self.ledgers[g].compose_refcnt(
+                [c.table_row for c in grp if c.ready > 0]
+            )
+            for g, grp in enumerate(rows_by_group)
+        ]
         t0 = self._clock()
-        out = self.runner(
-            jnp.asarray(ids), jnp.asarray(mask), self._rng,
-            jnp.asarray(budget), warm, jnp.asarray(pin),
-            jnp.asarray(ready), jnp.asarray(rngrow),
-        )
-        resp = np.asarray(out["response_ids"])
-        rmask = np.asarray(out["response_mask"])
-        kvs = out["kv_state"]
-        saved_t = np.asarray(kvs["saved_tables"])
-        saved_l = np.asarray(kvs["saved_len"])
+        if self.G == 1:
+            ids, mask, budget, pin, ready, rngrow, table = assembled[0]
+            warm = {
+                "pool": self.pool,
+                "free": jnp.asarray(self.ledger.free),
+                "ntop": jnp.int32(self.ledger.ntop),
+                "refcnt": jnp.asarray(refcnts[0]),
+                "row_table": jnp.asarray(table),
+            }
+            out = self.runner(
+                jnp.asarray(ids), jnp.asarray(mask), self._rng,
+                jnp.asarray(budget), warm, jnp.asarray(pin),
+                jnp.asarray(ready), jnp.asarray(rngrow),
+            )
+            kvs = out["kv_state"]
+            self.pool = kvs["pool"]
+            self.ledger.adopt_stack(
+                np.asarray(kvs["free"]), int(kvs["ntop"])
+            )
+            per_group = [(
+                rows_by_group[0], ids, mask,
+                np.asarray(out["response_ids"]),
+                np.asarray(out["response_mask"]),
+                np.asarray(kvs["saved_tables"]),
+                np.asarray(kvs["saved_len"]),
+                self.ledger,
+            )]
+            gstats = {
+                k: float(np.asarray(v)) for k, v in out["gen_stats"].items()
+            }
+        else:
+            # sharded lanes: ONE stacked dispatch serves every group
+            # (the runner is vmapped over axis 0; empty groups ride as
+            # all-dummy batches so their warm pools round-trip intact)
+            def stk(i):
+                return jnp.asarray(np.stack([a[i] for a in assembled]))
+
+            warm = {
+                "pool": self.pool,  # stacked leaves [G, ...]
+                "free": jnp.asarray(
+                    np.stack([led.free for led in self.ledgers])
+                ),
+                "ntop": jnp.asarray(
+                    np.asarray([led.ntop for led in self.ledgers], np.int32)
+                ),
+                "refcnt": jnp.asarray(np.stack(refcnts)),
+                "row_table": stk(6),
+            }
+            out = self.runner(
+                stk(0), stk(1), self._rng, stk(2), warm, stk(3), stk(4),
+                stk(5),
+            )
+            kvs = out["kv_state"]
+            self.pool = kvs["pool"]
+            free_np = np.asarray(kvs["free"])
+            ntop_np = np.asarray(kvs["ntop"])
+            resp_np = np.asarray(out["response_ids"])
+            rmask_np = np.asarray(out["response_mask"])
+            saved_t_np = np.asarray(kvs["saved_tables"])
+            saved_l_np = np.asarray(kvs["saved_len"])
+            per_group = []
+            for g, grp in enumerate(rows_by_group):
+                self.ledgers[g].adopt_stack(free_np[g], int(ntop_np[g]))
+                per_group.append((
+                    grp, assembled[g][0], assembled[g][1], resp_np[g],
+                    rmask_np[g], saved_t_np[g], saved_l_np[g],
+                    self.ledgers[g],
+                ))
+            gstats = {
+                k: float(np.asarray(v).sum())
+                for k, v in out["gen_stats"].items()
+            }
         wall = max(self._clock() - t0, 1e-9)
         self.stats["batches"] += 1
-        g = {k: float(np.asarray(v)) for k, v in out["gen_stats"].items()}
-        # honest accounting: the batch is padded to max_batch with dummy
+        # honest accounting: batches are padded to max_batch with dummy
         # lanes (1 emitted token each) — count only REAL requests'
         # tokens, and drop the dummy-polluted ratios
-        real_toks = int(rmask[: len(rows)].sum())
-        g["real_tokens"] = float(real_toks)
-        g.pop("truncated", None)
-        g.pop("occupancy", None)
-        for k, v in g.items():
+        real_toks = sum(
+            int(pg[4][: len(pg[0])].sum()) for pg in per_group
+        )
+        gstats["real_tokens"] = float(real_toks)
+        gstats.pop("truncated", None)
+        gstats.pop("occupancy", None)
+        for k, v in gstats.items():
             self._gen_stats[k] = self._gen_stats.get(k, 0.0) + v
         # gauges, not counters: free_pages is the end-of-call stack
         # depth; pinned_pages re-counts a session's whole page set
         # every turn, so the accumulated sum is meaningless — keep the
         # last call's value (current pinned residency lives in
         # kv_held_pages in the summary)
-        self._gen_stats["free_pages"] = g.get("free_pages", 0.0)
-        self._gen_stats["pinned_pages"] = g.get("pinned_pages", 0.0)
-        # adopt the end-of-call pool + free stack
-        self.pool = kvs["pool"]
-        self.ledger.adopt_stack(np.asarray(kvs["free"]), int(kvs["ntop"]))
+        self._gen_stats["free_pages"] = gstats.get("free_pages", 0.0)
+        self._gen_stats["pinned_pages"] = gstats.get("pinned_pages", 0.0)
         decode_tok_s = real_toks / wall
         done = self._clock()
-        for i, c in enumerate(rows):
-            if c.entry_key is not None:
-                self.ledger.release(c.entry_key)
-                c.entry_key = None  # the failure handler must not re-release
-            n = int(rmask[i].sum())
-            if c.pin:
-                self._adopt_row(c, ids[i], mask[i], resp[i], n,
-                                saved_t[i], saved_l[i], done)
-            met = done <= c.pend.deadline_t
-            if not met:
-                self.stats["deadline_missed"] += 1
-            self.stats["completed"] += 1
-            if n == 0:
-                # the engine could not admit the lane at all (pool
-                # exhausted past what eviction could reclaim): an
-                # honest error beats a silent empty completion
-                self.stats["errors"] += 1
-            if n == 0:
-                parts = ["unserved: serve pool exhausted"]
-            else:
-                parts = [p for p in (
-                    c.note, "" if met else "completed past deadline"
-                ) if p]
-            res = ServeResult(
-                rid=c.pend.req.rid,
-                status=OK if n > 0 else ERROR,
-                tokens=[int(t) for t in resp[i][rmask[i] > 0]],
-                detail="; ".join(parts),
-                latency_s=done - c.pend.arrival_t,
-                queue_wait_s=t0 - c.pend.arrival_t,
-                decode_tok_s=decode_tok_s,
-                shared_pages=c.shared_pages,
-                session_id=c.pend.req.session_id,
-            )
-            self._records.append(_Record(
-                latency_s=res.latency_s, queue_wait_s=res.queue_wait_s,
-                decode_tok_s=decode_tok_s, deadline_met=met,
-            ))
-            self._post(res)
+        for grp, ids, mask, resp, rmask, saved_t, saved_l, ledger in per_group:
+            for i, c in enumerate(grp):
+                if c.entry_key is not None:
+                    ledger.release(c.entry_key)
+                    c.entry_key = None  # failure handler must not re-release
+                n = int(rmask[i].sum())
+                if c.pin:
+                    self._adopt_row(c, ids[i], mask[i], resp[i], n,
+                                    saved_t[i], saved_l[i], done, ledger)
+                met = done <= c.pend.deadline_t
+                if not met:
+                    self.stats["deadline_missed"] += 1
+                self.stats["completed"] += 1
+                if n == 0:
+                    # the engine could not admit the lane at all (pool
+                    # exhausted past what eviction could reclaim): an
+                    # honest error beats a silent empty completion
+                    self.stats["errors"] += 1
+                if n == 0:
+                    parts = ["unserved: serve pool exhausted"]
+                else:
+                    parts = [p for p in (
+                        c.note, "" if met else "completed past deadline"
+                    ) if p]
+                res = ServeResult(
+                    rid=c.pend.req.rid,
+                    status=OK if n > 0 else ERROR,
+                    tokens=[int(t) for t in resp[i][rmask[i] > 0]],
+                    detail="; ".join(parts),
+                    latency_s=done - c.pend.arrival_t,
+                    queue_wait_s=t0 - c.pend.arrival_t,
+                    decode_tok_s=decode_tok_s,
+                    shared_pages=c.shared_pages,
+                    session_id=c.pend.req.session_id,
+                )
+                self._records.append(_Record(
+                    latency_s=res.latency_s, queue_wait_s=res.queue_wait_s,
+                    decode_tok_s=decode_tok_s, deadline_met=met,
+                ))
+                self._post(res)
         del self._records[:-512]
 
     def _adopt_row(self, c, row_ids, row_mask, resp, n, table_row,
-                   saved_len, now) -> None:
+                   saved_len, now, ledger: skv.PageLedger) -> None:
         """Fold a pinned row's pages into the cache (session turn or
         prefix pioneer); surplus pages past the aligned boundary go
         straight back to the free stack (the copy-on-write half: the
@@ -616,9 +731,9 @@ class ServeFrontend:
                     "a released page) — pages freed, session not pinned",
                     c.adopt_session,
                 )
-                self.ledger.push_unheld(table_row)
+                ledger.push_unheld(table_row)
                 return
-            self.ledger.adopt(
+            ledger.adopt(
                 c.adopt_session, "session",
                 np.asarray(keep_pages, np.int32),
                 np.concatenate(keep_blocks_ids)
@@ -631,7 +746,7 @@ class ServeFrontend:
                 now=now,
                 deadline_t=now + self.cfg.session_deadline_s,
             )
-            self.ledger.push(surplus)
+            ledger.push(surplus)
             return
         if (
             c.adopt_prefix is not None
@@ -640,17 +755,17 @@ class ServeFrontend:
         ):
             Ap = skv.aligned_len(len(c.adopt_prefix), self.PS)
             npp = Ap // self.PS
-            self.ledger.adopt(
+            ledger.adopt(
                 skv.prefix_key(c.adopt_prefix), "prefix",
                 table_row[:npp],
                 np.asarray(c.adopt_prefix[:Ap], np.int32),
                 np.ones(Ap, np.int32),
                 pending_ids=[], now=now,
             )
-            self.ledger.push(table_row[npp:][table_row[npp:] > 0])
+            ledger.push(table_row[npp:][table_row[npp:] > 0])
             return
         # nothing adoptable: free everything the pin kept
-        self.ledger.push_unheld(table_row)
+        ledger.push_unheld(table_row)
 
     # -- results -----------------------------------------------------------
 
@@ -717,13 +832,23 @@ class ServeFrontend:
 
     def stats_summary(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {**self.stats, **self.sched.stats}
-        out.update({f"kv_{k}": v for k, v in self.ledger.stats.items()})
+        # ledger counters/accounting sum over lane groups (G == 1 is
+        # the degenerate single-ledger sum)
+        kv: Dict[str, float] = {}
+        for led in self.ledgers:
+            for k, v in led.stats.items():
+                kv[k] = kv.get(k, 0) + v
+        out.update({f"kv_{k}": v for k, v in kv.items()})
         out.update(
             {f"engine_{k}": v for k, v in self._gen_stats.items()}
         )
         out["pending"] = self.sched.pending
-        out["cache_entries"] = len(self.ledger.entries)
-        out["kv_held_pages"] = self.ledger.accounting()["held"]
+        out["cache_entries"] = sum(len(led.entries) for led in self.ledgers)
+        out["kv_held_pages"] = sum(
+            led.accounting()["held"] for led in self.ledgers
+        )
+        if self.G > 1:
+            out["lane_groups"] = self.G
         out.update(self.slo_report())
         return out
 

@@ -874,8 +874,25 @@ class TPUBaseTrainer(BaseRLTrainer):
         shrink_pool rung scales slots (and any explicit pool_pages)
         by ``train.memory.pool_shrink_factor`` — fewer lanes, smaller
         pool, same output contract (the queue just drains in more
-        refill waves)."""
+        refill waves). Speculative decoding derives the draft's shared
+        trunk depth here (hydra reference: its branch is the top-k
+        layers, so the composed draft shares the other L-k with the
+        policy — stored ONCE in the extended pool), which keeps the
+        spec the jit traces and the bytes the memory doctor plans in
+        agreement by construction."""
         spec = self._engine_cfg.resolve(batch, self._lm().cfg)
+        if spec.spec_decode:
+            from trlx_tpu.models.gen_engine import hydra_shared_trunk_layers
+
+            L = self._lm().cfg.n_layer
+            ref = getattr(self, "ref_params", None)
+            if ref is not None and "blocks" in ref:
+                kb = jax.tree_util.tree_leaves(ref["blocks"])[0].shape[0]
+            else:
+                kb = getattr(self.config.model, "num_layers_unfrozen", -1)
+            sh = hydra_shared_trunk_layers(L, kb)
+            if sh:
+                spec = dataclasses.replace(spec, draft_shared_layers=sh)
         scale = self.memdoctor.pool_scale() if self.memdoctor.enabled else 1.0
         if scale < 1.0:
             spec = dataclasses.replace(
@@ -888,10 +905,40 @@ class TPUBaseTrainer(BaseRLTrainer):
             )
         return spec
 
+    def _decode_impl(self) -> str:
+        """Provenance string for the flight recorder: which decode
+        implementation produces this run's rollout tokens (so a
+        recorded telemetry.json says which kernel its tok/s headline
+        came from)."""
+        if not self._engine_cfg.enabled:
+            return "static"
+        if not self._engine_cfg.paged:
+            impl = "engine-contiguous"
+        else:
+            impl = f"engine-paged-{self._engine_cfg.paged_attention_impl}"
+        if self._engine_cfg.data_groups > 1:
+            impl += f"-x{self._engine_cfg.data_groups}"
+        return impl
+
+    def _engine_group_sharding(self, groups: int):
+        """NamedSharding that places each engine lane group's state on
+        its own slice of the mesh's data axes (None when the geometry
+        doesn't divide — the groups then run as one replicated stacked
+        dispatch, which is still correct, just not multi-chip)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        for axes in (("dp", "fsdp"), ("dp",)):
+            size = 1
+            for ax in axes:
+                size *= self.mesh.shape.get(ax, 1)
+            if size > 1 and groups % size == 0:
+                return NamedSharding(self.mesh, PartitionSpec(axes))
+        return None
+
     def _get_engine_fn(self, settings: SamplerSettings, shape: Tuple[int, int]):
         from trlx_tpu.models.gen_engine import (
             compose_draft_params,
-            engine_generate,
+            engine_generate_grouped,
         )
 
         spec = self._engine_spec(shape[0])
@@ -899,6 +946,10 @@ class TPUBaseTrainer(BaseRLTrainer):
         if key not in self._engine_fns:
             lm = self._lm()
             model = self.model
+            gshard = (
+                self._engine_group_sharding(spec.data_groups)
+                if spec.data_groups > 1 else None
+            )
 
             if spec.spec_decode:
 
@@ -907,9 +958,9 @@ class TPUBaseTrainer(BaseRLTrainer):
 
                     base = _effective_base(model, params)
                     draft = compose_draft_params(lm.cfg, base, ref_params)
-                    return engine_generate(
+                    return engine_generate_grouped(
                         lm, base, input_ids, attention_mask, rng, settings,
-                        spec, draft_params=draft,
+                        spec, draft_params=draft, group_sharding=gshard,
                     )
 
             else:
@@ -917,9 +968,10 @@ class TPUBaseTrainer(BaseRLTrainer):
                 def fn(params, input_ids, attention_mask, rng):
                     from trlx_tpu.models.wrappers import _effective_base
 
-                    return engine_generate(
+                    return engine_generate_grouped(
                         lm, _effective_base(model, params), input_ids,
                         attention_mask, rng, settings, spec,
+                        group_sharding=gshard,
                     )
 
             self._engine_fns[key] = jax.jit(fn)
@@ -989,6 +1041,11 @@ class TPUBaseTrainer(BaseRLTrainer):
             refill_width=0,
             spec_decode=False,
             kv_quant=None if quant == "none" else quant,
+            # serve decode rides the SAME kernel selection as rollout
+            # decode: one knob (method.gen_engine.paged_attention_impl)
+            # decides which attend implementation every engine call —
+            # training or serving — runs on (docs/serving.md)
+            paged_attention_impl=self._engine_cfg.paged_attention_impl,
         )
 
     def _serve_start(self) -> None:
@@ -1019,22 +1076,54 @@ class TPUBaseTrainer(BaseRLTrainer):
         lm = self._lm()
         model = self.model
 
-        def fn(params, q_ids, q_mask, rng, row_budget, warm, q_pin,
-               q_ready, q_rng_row):
-            from trlx_tpu.models.wrappers import _effective_base
+        groups = self._serve_cfg.groups
+        if groups > 1:
+            # sharded serve lanes: G independent warm pools/ledgers
+            # (trlx_tpu/serve/frontend.py owns the grouping), served by
+            # ONE stacked vmap dispatch whose group axis shards over
+            # the mesh's data axes when the geometry divides — the
+            # serve frontend itself becomes multi-chip. Request streams
+            # are per-request-id RNG, so tokens are invariant to the
+            # group count by construction.
+            def fn(params, q_ids, q_mask, rng, row_budget, warm, q_pin,
+                   q_ready, q_rng_row):
+                from trlx_tpu.models.wrappers import _effective_base
 
-            return engine_generate(
-                lm, _effective_base(model, params), q_ids, q_mask, rng,
-                settings, spec, row_budget=row_budget, warm=warm,
-                q_pin=q_pin, q_ready=q_ready, q_rng_row=q_rng_row,
-            )
+                base = _effective_base(model, params)
+
+                def one_group(ids, mask, budget, w, pin, ready, rngrow):
+                    return engine_generate(
+                        lm, base, ids, mask, rng, settings, spec,
+                        row_budget=budget, warm=w, q_pin=pin,
+                        q_ready=ready, q_rng_row=rngrow,
+                    )
+
+                return jax.vmap(one_group)(
+                    q_ids, q_mask, row_budget, warm, q_pin, q_ready,
+                    q_rng_row,
+                )
+
+        else:
+
+            def fn(params, q_ids, q_mask, rng, row_budget, warm, q_pin,
+                   q_ready, q_rng_row):
+                from trlx_tpu.models.wrappers import _effective_base
+
+                return engine_generate(
+                    lm, _effective_base(model, params), q_ids, q_mask, rng,
+                    settings, spec, row_budget=row_budget, warm=warm,
+                    q_pin=q_pin, q_ready=q_ready, q_rng_row=q_rng_row,
+                )
 
         jfn = jax.jit(fn)
+        gshard = (
+            self._engine_group_sharding(groups) if groups > 1 else None
+        )
 
         def runner(q_ids, q_mask, rng, row_budget, warm, q_pin, q_ready,
                    q_rng_row):
             with self.mesh:
-                sharding = replicated_sharding(self.mesh)
+                sharding = gshard or replicated_sharding(self.mesh)
                 return jfn(
                     self.params,
                     jax.device_put(q_ids, sharding),
@@ -1054,6 +1143,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             "head_dim": lm_cfg.head_dim,
             "kv_quant": spec.kv_quant,
             "dtype": lm_cfg.dtype,
+            "groups": groups,
         }
         self.serve = ServeFrontend(
             self._serve_cfg, runner, geom,
@@ -2837,6 +2927,7 @@ class TPUBaseTrainer(BaseRLTrainer):
             batch_size=self.config.train.batch_size,
             seq_length=self.config.train.seq_length,
             mesh={ax: int(s) for ax, s in self.mesh.shape.items()},
+            decode_impl=self._decode_impl(),
         )
         try:
             # serving frontend (train.serve.*): external requests ride

@@ -514,15 +514,22 @@ def _dtype_size(name: Optional[str]) -> int:
 
 
 def engine_pool_bytes(spec, model_cfg, prompt_len: int, max_new: int) -> int:
-    """Device bytes of ONE decode-engine page pool for a resolved
-    :class:`~trlx_tpu.models.gen_engine.EngineSpec` (mirrors
-    paged_kv.init_pool's shapes; speculative decoding doubles it —
-    the draft keeps its own pool)."""
+    """Device bytes of the decode-engine POLICY page pool(s) for a
+    resolved :class:`~trlx_tpu.models.gen_engine.EngineSpec` (mirrors
+    paged_kv.init_pool's shapes, x data_groups lane-group pools).
+    Speculative decoding adds :func:`draft_pool_bytes` on top — a full
+    second pool for a full-copy draft, or just the branch layers when
+    the hydra trunk is shared."""
     from trlx_tpu.ops import paged_kv
 
     K = spec.draft_k if spec.spec_decode else 0
     MP = paged_kv.pages_per_slot(prompt_len, max_new + K, spec.page_size)
-    NP = (spec.pool_pages or (1 + spec.slots * MP)) if spec.paged else (
+    groups = max(getattr(spec, "data_groups", 1), 1)
+    # an explicit pool_pages is the TOTAL budget split ceil(1/G) per
+    # lane group (engine_generate_grouped); worst-case sizing is per
+    # group — both match the engine's actual allocation
+    explicit = -(-spec.pool_pages // groups) if spec.pool_pages else 0
+    NP = (explicit or (1 + spec.slots * MP)) if spec.paged else (
         1 + spec.slots * MP
     )
     L = model_cfg.n_layer
@@ -533,7 +540,21 @@ def engine_pool_bytes(spec, model_cfg, prompt_len: int, max_new: int) -> int:
     else:
         itemsize = 2 if str(model_cfg.dtype) in ("bfloat16", "bf16") else 4
         per_pool = 2 * cells * itemsize
-    return per_pool
+    # sharded lane groups: G per-group pools (with the group axis
+    # sharded over the mesh the per-device share is 1/G of this, but
+    # the preflight plans the unsharded ceiling)
+    return per_pool * groups
+
+
+def draft_pool_bytes(pool_b: int, n_layer: int, shared_layers: int) -> int:
+    """Bytes the speculative DRAFT adds on top of the policy pool: a
+    full-copy draft keeps its own full-depth pool (``pool_b``); a hydra
+    draft with ``shared_layers`` trunk layers shared stores only its
+    BRANCH layers (gen_engine's extended-pool layout — trunk KV is held
+    once), i.e. (L - shared)/L of one pool."""
+    if shared_layers <= 0:
+        return pool_b
+    return pool_b * (n_layer - shared_layers) // n_layer
 
 
 def estimate_plan(trainer) -> HBMPlan:
@@ -642,8 +663,16 @@ def estimate_plan(trainer) -> HBMPlan:
                    and trainer.memdoctor.pool_scale() < 1.0 else ""),
             )
             if spec.spec_decode:
-                plan.add("rollout", "engine_draft_pool", pool_b,
-                         "speculative draft keeps its own pool")
+                sh = getattr(spec, "draft_shared_layers", 0)
+                db = draft_pool_bytes(
+                    pool_b, _model_cfg(trainer).n_layer, sh
+                )
+                plan.add(
+                    "rollout", "engine_draft_pool", db,
+                    f"draft branch layers only ({sh} trunk layers share "
+                    "the policy pool)" if sh
+                    else "speculative draft keeps its own pool (full copy)",
+                )
                 if ref is not None:
                     plan.add("rollout", "draft_params", tree_bytes(ref),
                              "reference as draft (hydra composes a trunk copy)")
@@ -1219,8 +1248,17 @@ def analytic_plan(
                  f"{spec.slots} slots, page_size {spec.page_size}, "
                  f"quant {spec.kv_quant or 'none'}")
         if spec.spec_decode:
-            plan.add("rollout", "engine_draft_pool", pool_b,
-                     "speculative draft pool")
+            from trlx_tpu.models.gen_engine import hydra_shared_trunk_layers
+
+            sh = hydra_shared_trunk_layers(
+                L, int(getattr(config.model, "num_layers_unfrozen", -1))
+            )
+            plan.add(
+                "rollout", "engine_draft_pool",
+                draft_pool_bytes(pool_b, L, sh),
+                f"draft branch layers only ({sh} trunk layers share the "
+                "policy pool)" if sh else "speculative draft pool (full copy)",
+            )
     else:
         kv_quant = tdict.get("kv_cache_quant")
         kv_size = 1 if kv_quant in ("int8", "int8_kernel") else 2
