@@ -1868,6 +1868,10 @@ def bench_chaos() -> dict:
     # learner restart against real worker processes, golden-checked
     # bit-equal to the in-process exp path
     fleet_leg = bench_chaos_fleet()
+    # network control-plane leg: the same fleet tcp-only with NO
+    # shared filesystem — lossy link, hub crash-and-restart, worker
+    # partition past the TTL, torn weight fetch — bit-equal throughout
+    net_leg = bench_chaos_net()
     # memory-doctor leg: injected fused-block/prefill OOMs recover
     # through the degradation ladder without process death, hbm_creep
     # trips the `memory` signal, and preflight rejects an over-budget
@@ -1881,6 +1885,7 @@ def bench_chaos() -> dict:
         **stall,
         **exp_leg,
         **fleet_leg,
+        **net_leg,
         **mem_leg,
         **serve_leg,
         "chaos_completed_steps": int(trainer.iter_count),
@@ -2355,16 +2360,20 @@ def _fleet_stream(ckpt_dir):
 
 
 def bench_fleet_child(role: str, ckpt_dir: str, ident: str,
-                      chaos_json: str, staleness_json: str) -> int:
+                      chaos_json: str, staleness_json: str,
+                      fleet_json: str = "-") -> int:
     """Child body for ``--fleet-child <role> <ckpt> <id> <chaos>
-    <staleness>``: a real worker process (``role=worker``) serving the
-    fleet dir, or a real learner process (``role=learner``) running the
-    tiny fleet config — the restart leg kills and relaunches the
-    latter."""
+    <staleness> [fleet]``: a real worker process (``role=worker``)
+    serving the fleet dir, or a real learner process (``role=learner``)
+    running the tiny fleet config — the restart leg kills and
+    relaunches the latter. ``fleet`` overlays ``_FLEET_KNOBS`` (the
+    network leg passes a tcp ``transport`` spec through it, so a worker
+    can ride a socket hub with NO path shared with the learner)."""
     chaos = json.loads(chaos_json) if chaos_json != "-" else None
     staleness = json.loads(staleness_json) if staleness_json != "-" else None
+    fleet = json.loads(fleet_json) if fleet_json != "-" else {}
     config = _chaos_fleet_config(
-        ckpt_dir, fleet=dict(_FLEET_KNOBS), chaos=chaos,
+        ckpt_dir, fleet={**_FLEET_KNOBS, **fleet}, chaos=chaos,
         staleness=staleness,
     )
     if role == "worker":
@@ -2388,7 +2397,7 @@ def bench_fleet_child(role: str, ckpt_dir: str, ident: str,
 
 
 def _spawn_fleet(role: str, ckpt_dir: str, ident: str, chaos=None,
-                 staleness=None):
+                 staleness=None, fleet=None):
     import subprocess
     import sys as _sys
 
@@ -2396,7 +2405,8 @@ def _spawn_fleet(role: str, ckpt_dir: str, ident: str, chaos=None,
         [_sys.executable, os.path.join(REPO, "bench.py"), "--fleet-child",
          role, ckpt_dir, ident,
          json.dumps(chaos) if chaos else "-",
-         json.dumps(staleness) if staleness else "-"],
+         json.dumps(staleness) if staleness else "-",
+         json.dumps(fleet) if fleet else "-"],
         # only the learner's stdout is consumed (FLEET_LEARNER record);
         # worker stdout goes to devnull — the repo logger writes to
         # stdout and an un-drained pipe would block a chatty worker
@@ -2642,6 +2652,215 @@ def bench_chaos_fleet() -> dict:
             b_rec["fleet"]["membership_epoch"]
         ),
         "fleet_leg_wall_s": round(time.time() - t0, 1),
+    }
+
+
+def _net_free_port() -> int:
+    """An OS-assigned loopback port for a leg's hub (bound-then-closed;
+    the bench's single-process orchestration makes reuse races moot)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return int(port)
+
+
+def _run_net_leg(tag, n_workers=2, learner_chaos=None, worker_chaos=None,
+                 worker_faults=None, staleness=None, worker_fleet=None):
+    """One TCP-ONLY fleet learn(): the learner hosts the socket hub
+    in-process and every real worker child runs on its OWN checkpoint
+    dir with a client spec pointing at the hub — no two processes share
+    a single path (the shared-filesystem-free acceptance posture).
+    ``worker_faults[i]`` arms worker i's LINK with the deterministic
+    transport fault injector (spec ``faults`` sub-dict);
+    ``worker_chaos[i]`` arms its chaos monkey (fleet_partition /
+    net_partition / broadcast_torn_fetch fire in the worker).
+    Returns (trainer, stream, codes, [learner_dir, *worker_dirs])."""
+    import shutil
+
+    import trlx_tpu
+
+    port = _net_free_port()
+    spec = {"backend": "tcp", "host": "127.0.0.1", "bind": "127.0.0.1",
+            "port": port, "timeout_s": 5.0}
+    ckpt_dir = os.path.join("/tmp", f"chaos_net_{tag}_ckpts")
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    w_dirs = [os.path.join("/tmp", f"chaos_net_{tag}_w{i}_ckpts")
+              for i in range(n_workers)]
+    workers = []
+    for i, wd in enumerate(w_dirs):
+        shutil.rmtree(wd, ignore_errors=True)
+        w_spec = dict(spec)
+        if (worker_faults or {}).get(i):
+            w_spec["faults"] = worker_faults[i]
+        workers.append(_spawn_fleet(
+            "worker", wd, f"w{i}",
+            chaos=(worker_chaos or {}).get(i), staleness=staleness,
+            fleet={"transport": w_spec, **(worker_fleet or {})},
+        ))
+    try:
+        config = _chaos_fleet_config(
+            ckpt_dir,
+            fleet={**_FLEET_KNOBS, "transport": spec},
+            chaos=learner_chaos, staleness=staleness,
+            guardrails=dict(enabled=True, loss_spike_sigma=0.0),
+        )
+        trainer = trlx_tpu.train(
+            reward_fn=_fleet_reward, prompts=_FLEET_PROMPTS, config=config
+        )
+        codes = [w.wait(timeout=240) for w in workers]
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+    return trainer, _fleet_stream(ckpt_dir), codes, [ckpt_dir] + w_dirs
+
+
+def bench_chaos_net() -> dict:
+    """Network control-plane chaos proof (part of ``bench.py --chaos``):
+    the partition-tolerance acceptance for the tcp transport — the
+    whole fleet (dispatch/delivery, membership, shutdown, weight
+    broadcast) crossing a socket with NO shared filesystem.
+
+    1. tcp-only clean run, one worker's link randomly DROPPING frames:
+       loss stream BIT-IDENTICAL to the in-process exp run, both worker
+       processes exit clean, and ZERO fleet directories exist anywhere
+       (learner and each worker run on disjoint checkpoint dirs);
+    2. hub CRASH-AND-RESTART mid-run (all volatile hub state lost):
+       the learner re-stamps the membership epoch and worker beats
+       re-register; the wiped registry costs AT MOST the interrupted
+       cycle (its chunk degrades to in-process production, bit-equal
+       by construction) and the fleet recovers — later chunks dispatch
+       to re-registered workers and the stream stays bit-identical;
+    3. worker PARTITIONED past the TTL on the tcp control plane (chaos
+       partition mid-chunk + periodic link-level net_partition spans):
+       TTL eviction + bit-identical re-dispatch, the late duplicate
+       delivery dedups away, stream bit-identical (staleness mode
+       ``reject`` re-leases anything a healed-but-stale link produced);
+    4. TORN weight fetch (every retry of the fetch torn): the worker
+       rejects the chunk on sha256, KEEPS its prior version, the stale
+       chunks flow through the ``exp.staleness`` clip gate, the
+       ``staleness`` signal trips, the run completes without abort.
+    """
+    import shutil
+
+    import trlx_tpu
+
+    t0 = time.time()
+    # in-process exp baseline (no fleet): the reference stream
+    ckpt_ff = os.path.join("/tmp", "chaos_net_ff_ckpts")
+    shutil.rmtree(ckpt_ff, ignore_errors=True)
+    trlx_tpu.train(
+        reward_fn=_fleet_reward, prompts=_FLEET_PROMPTS,
+        config=_chaos_fleet_config(ckpt_ff),
+    )
+    stream_ff = _fleet_stream(ckpt_ff)
+
+    # 1. tcp-only + lossy link == in-process exp (golden), zero shared
+    # paths: the dropped ops surface as ConnectionError and every
+    # consumer path (beat, scan, delivery, fetch) retries through them
+    clean, stream_clean, codes, dirs = _run_net_leg(
+        "clean",
+        worker_faults={0: {"seed": 11,
+                           "faults": [{"fault": "drop", "every": 17}]}},
+    )
+    assert stream_clean == stream_ff, (
+        "tcp-only fleet run diverged from the in-process exp run:\n"
+        f"{stream_ff}\n{stream_clean}"
+    )
+    nsum = clean._fleet.stats_summary()
+    assert nsum["delivered"] >= 4 and nsum["degradations"] == 0, nsum
+    assert codes == [0, 0], codes
+    for d in dirs:
+        assert not os.path.isdir(os.path.join(d, "fleet")), (
+            f"tcp-only run must not create a fleet dir, found one in {d}"
+        )
+
+    # 2. hub crash-and-restart: volatile state (registry, dispatches,
+    # broadcast chunks) all lost mid-run; recovery is re-registration
+    # via beats + the interrupted cycle re-publishing its snapshot
+    crash, stream_crash, codes, _ = _run_net_leg(
+        "hubcrash",
+        learner_chaos=dict(seed=0, faults=[
+            {"fault": "hub_crash", "at": 2}]),
+    )
+    hsum = crash._fleet.stats_summary()
+    assert hsum["hub_restarts"] >= 1, hsum
+    # the wiped registry may cost the interrupted cycle ONLY: its
+    # chunk degrades to in-process production (bit-equal) and the next
+    # beats bring the fleet back for the remaining dispatches
+    assert hsum["degradations"] <= 1, hsum
+    assert hsum["recoveries"] >= hsum["degradations"], hsum
+    assert hsum["delivered"] >= 2, hsum
+    assert stream_crash == stream_ff, (
+        "stream diverged across the hub crash-and-restart:\n"
+        f"{stream_ff}\n{stream_crash}"
+    )
+    assert codes == [0, 0], codes
+
+    # 3. partitioned worker: a chaos partition pins the eviction to
+    # w0's FIRST chunk (silent past the 2s TTL while holding the
+    # assignment -> deterministic re-dispatch), and link-level
+    # net_partition spans keep knocking its socket out on top; reject
+    # staleness (max 0) re-leases anything produced with a version the
+    # healed link missed, so the consumed stream stays bit-identical
+    part, stream_part, codes, _ = _run_net_leg(
+        "part",
+        worker_chaos={0: dict(seed=0, stall_delay=6.0, faults=[
+            {"fault": "fleet_partition", "at": 1},
+            {"fault": "net_partition", "every": 300}])},
+        staleness={"mode": "reject", "max_staleness": 0},
+        # a link partitioned ACROSS the learner's shutdown misses the
+        # hub-held flag forever (the hub closes once beats go silent):
+        # the worker's bounded detach path must turn that into a clean
+        # exit in leg time, not a hang
+        worker_fleet={"detach_timeout_s": 25.0},
+    )
+    psum = part._fleet.stats_summary()
+    assert psum["membership_evictions"] >= 1, psum
+    assert psum["redispatches"] >= 1, psum
+    assert stream_part == stream_ff, (
+        "stream diverged under tcp worker partition:\n"
+        f"{stream_ff}\n{stream_part}"
+    )
+    # clean exits prove the partition never read as a crash or a
+    # shutdown order: the worker either re-registered and saw the
+    # hub-held flag, or bounded-detached AFTER the learner was done
+    assert codes == [0, 0], codes
+
+    # 4. torn weight fetch: span 40 keeps EVERY retry of the fetch torn
+    # across many refresh ticks, so the chunk dispatched right after
+    # the publish is provably produced with the KEPT prior version
+    stale_cfg = {"mode": "clip", "max_staleness": 0, "clip_c": 0.3}
+    torn, _, codes, _ = _run_net_leg(
+        "torn", n_workers=1,
+        worker_chaos={0: dict(seed=0, faults=[
+            {"fault": "broadcast_torn_fetch", "at": 2, "span": 40}])},
+        staleness=stale_cfg,
+    )
+    assert torn.iter_count >= torn.config.train.total_steps, (
+        f"torn-fetch leg aborted at step {torn.iter_count}"
+    )
+    assert "staleness" in torn.guardrails.trip_history, (
+        f"expected a staleness trip from the kept prior version, saw "
+        f"{torn.guardrails.trip_history}"
+    )
+    tsum = torn._exp.stats_summary()
+    assert tsum["staleness_clips"] >= 1, tsum
+    assert torn._fleet.stats_summary()["degradations"] == 0
+    assert codes == [0], codes
+
+    return {
+        "net_bit_identical_under_faults": True,
+        "net_clean_delivered": int(nsum["delivered"]),
+        "net_no_shared_fs": True,
+        "net_hub_restarts": int(hsum["hub_restarts"]),
+        "net_partition_evictions": int(psum["membership_evictions"]),
+        "net_partition_redispatches": int(psum["redispatches"]),
+        "net_torn_staleness_clips": int(tsum["staleness_clips"]),
+        "net_leg_wall_s": round(time.time() - t0, 1),
     }
 
 
@@ -2963,7 +3182,7 @@ def main():
         return
     if "--fleet-child" in sys.argv:
         i = sys.argv.index("--fleet-child")
-        sys.exit(bench_fleet_child(*sys.argv[i + 1:i + 6]))
+        sys.exit(bench_fleet_child(*sys.argv[i + 1:i + 7]))
     if "--chaos" in sys.argv:
         print(json.dumps({"metric": "ppo_chaos_smoke", **bench_chaos()}))
         return

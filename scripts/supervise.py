@@ -314,9 +314,11 @@ def supervise_fleet(
     flap_window_s: float = 60.0,
     flap_limit: int = 3,
     poll_s: float = 0.2,
+    hub_cmd: Optional[List[str]] = None,
 ) -> int:
     """Fleet mode (``--worker-cmd``): the learner and N rollout workers
-    run as sibling child processes with PER-ROLE exit-class routing.
+    — plus, with ``--hub-cmd``, an external transport hub — run as
+    sibling child processes with PER-ROLE exit-class routing.
 
     learner   routed exactly like :func:`supervise` — clean stop ends
               the fleet (workers are signalled, then terminated as the
@@ -334,14 +336,29 @@ def supervise_fleet(
               in a row retires the SLOT (ledger ``gave_up``) instead of
               the run — the learner degrades below ``fleet.min_workers``
               on its own if too many slots retire.
+    hub       (``--hub-cmd``, e.g. ``python -m trlx_tpu.exp.net --port
+              9123`` with the run's transport spec at ``host_hub:
+              false``) the load-bearing message bus: ANY exit while the
+              run lives is an outage, so the routing is
+              relaunch-first. A clean exit (0 — operator SIGTERM)
+              relaunches immediately; a crash relaunches with doubling
+              backoff. Clients are built to ride it out: reconnect
+              backoff+jitter on every rpc, workers re-register on their
+              next beat, the learner re-dispatches and re-publishes
+              into the empty hub. But ``flap_limit`` rapid hub deaths
+              in a row means nothing can talk to anything — the whole
+              fleet stops (ledger ``gave_up``, exit 1), unlike a
+              retired worker slot. The hub is launched FIRST and
+              stopped LAST, so relaunching roles always find the bus.
 
     Every decision lands in the same JSONL ledger with a ``role`` field
-    (``learner`` / ``worker-<i>``)."""
+    (``learner`` / ``worker-<i>`` / ``hub``)."""
     import signal
 
     t_now = time.time
     learner: Optional[subprocess.Popen] = None
     workers: List[Optional[subprocess.Popen]] = [None] * len(worker_cmds)
+    hub: Optional[subprocess.Popen] = None
     wstate = [
         {"streak": 0, "delay": backoff_s, "next_launch": 0.0,
          "launched": 0.0, "retired": False, "attempt": 0}
@@ -352,6 +369,11 @@ def supervise_fleet(
     l_delay = backoff_s
     l_next_launch = 0.0
     l_launched = 0.0
+    h_attempt = 0
+    h_streak = 0
+    h_delay = backoff_s
+    h_next_launch = 0.0
+    h_launched = 0.0
     resume_from: Optional[str] = None
 
     def spawn_learner():
@@ -367,6 +389,27 @@ def supervise_fleet(
         workers[i] = subprocess.Popen(worker_cmds[i], env=dict(os.environ))
         wstate[i]["launched"] = t_now()
         wstate[i]["attempt"] += 1
+
+    def spawn_hub():
+        nonlocal hub, h_attempt, h_launched
+        h_attempt += 1
+        h_launched = t_now()
+        hub = subprocess.Popen(hub_cmd, env=dict(os.environ))
+
+    def stop_proc(proc, sig=signal.SIGTERM, grace_s: float = 10.0):
+        if proc is None:
+            return
+        if proc.poll() is None:
+            try:
+                proc.send_signal(sig)
+            except OSError:
+                pass
+            deadline = t_now() + grace_s
+            while proc.poll() is None and t_now() < deadline:
+                time.sleep(poll_s)
+            if proc.poll() is None:
+                proc.kill()
+        proc.wait()  # reap — an embedding caller must not leak zombies
 
     def stop_workers(sig=signal.SIGTERM, grace_s: float = 10.0):
         for proc in workers:
@@ -385,12 +428,75 @@ def supervise_fleet(
                 proc.kill()
             proc.wait()  # reap — an embedding caller must not leak zombies
 
+    def stop_fleet():
+        # workers first (they need the hub to observe the shutdown
+        # flag), hub last
+        stop_workers()
+        stop_proc(hub)
+
     try:
+        if hub_cmd:
+            spawn_hub()
         spawn_learner()
         for i in range(len(worker_cmds)):
             spawn_worker(i)
         while True:
             time.sleep(poll_s)
+            # -- hub routing (the message bus everyone needs) -----------
+            if hub_cmd:
+                hcode = hub.poll() if hub is not None else None
+                if hcode is not None:
+                    run_s = t_now() - h_launched
+                    record = {
+                        "role": "hub", "attempt": h_attempt,
+                        "exit_code": int(hcode),
+                        "exit_class": classify(hcode),
+                        "run_s": round(run_s, 3),
+                    }
+                    hub = None
+                    if run_s >= flap_window_s:
+                        h_streak, h_delay = 0, backoff_s
+                    else:
+                        h_streak += 1
+                    if h_streak >= flap_limit:
+                        ledger.append({
+                            **record, "action": "gave_up",
+                            "reason": (
+                                f"{h_streak} rapid hub deaths in a row "
+                                "— the bus is load-bearing; stopping "
+                                "the whole fleet"
+                            ),
+                        })
+                        print(
+                            "supervise: hub flapping — stopping learner "
+                            "+ workers", file=sys.stderr,
+                        )
+                        stop_proc(learner)
+                        learner = None
+                        stop_workers()
+                        return 1
+                    if hcode == 0:
+                        # a deliberate stop of a load-bearing role is
+                        # still an outage mid-run: relaunch immediately
+                        ledger.append({
+                            **record, "action": "restart",
+                            "backoff_s": 0.0,
+                        })
+                        h_next_launch = t_now()
+                    else:
+                        ledger.append({
+                            **record, "action": "restart",
+                            "backoff_s": round(h_delay, 3),
+                        })
+                        h_next_launch = t_now() + h_delay
+                        h_delay = min(h_delay * 2, backoff_max_s)
+                    print(
+                        f"supervise: hub exit {hcode}; relaunching "
+                        "(clients reconnect + re-register)",
+                        file=sys.stderr,
+                    )
+                if hub is None and t_now() >= h_next_launch:
+                    spawn_hub()
             # -- learner routing (the run's fate) -----------------------
             code = learner.poll() if learner is not None else None
             if code is not None:
@@ -407,7 +513,7 @@ def supervise_fleet(
                     ledger.append({**record, "action": "done"})
                     print("supervise: learner finished cleanly; "
                           "stopping the worker fleet")
-                    stop_workers()
+                    stop_fleet()
                     return 0
                 if run_s >= flap_window_s:
                     l_streak, l_delay = 0, backoff_s
@@ -424,7 +530,7 @@ def supervise_fleet(
                     )
                     print(f"supervise: giving up ({reason}); stopping "
                           "the worker fleet", file=sys.stderr)
-                    stop_workers()
+                    stop_fleet()
                     return 1
                 if exit_class == "stalled":
                     resume_from = latest_emergency_snapshot(checkpoint_dir)
@@ -504,7 +610,7 @@ def supervise_fleet(
             except subprocess.TimeoutExpired:
                 learner.kill()
                 learner.wait()
-        stop_workers()
+        stop_fleet()
         return 130
     except BaseException:
         # a failed spawn (bad worker command), a full-disk ledger write,
@@ -514,7 +620,7 @@ def supervise_fleet(
         if learner is not None and learner.poll() is None:
             learner.kill()
             learner.wait()
-        stop_workers()
+        stop_fleet()
         raise
 
 
@@ -568,6 +674,17 @@ def main(argv=None) -> int:
              "slots (each formatting '{i}' with its index)",
     )
     parser.add_argument(
+        "--hub-cmd", default=None,
+        help="FLEET MODE: an external transport-hub command (e.g. "
+             "\"python -m trlx_tpu.exp.net --port 9123\") run as its "
+             "own supervised role — pair with a run config whose "
+             "transport spec says host_hub: false. Any hub exit "
+             "mid-run is an outage: clean exits relaunch immediately, "
+             "crashes with doubling backoff, and a flapping hub stops "
+             "the whole fleet (it is load-bearing, unlike a worker "
+             "slot)",
+    )
+    parser.add_argument(
         "--flight-dir", default="",
         help="flight-recorder dir to mirror ledger records into as "
              "'supervisor' events (default <checkpoint-dir>/flight; "
@@ -593,6 +710,8 @@ def main(argv=None) -> int:
             or os.path.join(args.checkpoint_dir, "flight")
         ),
     )
+    if args.hub_cmd and not args.worker_cmd:
+        parser.error("--hub-cmd is a fleet-mode role; add --worker-cmd")
     if args.worker_cmd:
         import shlex
 
@@ -617,6 +736,7 @@ def main(argv=None) -> int:
             backoff_max_s=args.backoff_max,
             flap_window_s=args.flap_window,
             flap_limit=args.flap_limit,
+            hub_cmd=shlex.split(args.hub_cmd) if args.hub_cmd else None,
         )
     return supervise(
         command,

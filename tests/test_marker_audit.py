@@ -36,7 +36,7 @@ TIER1_BUDGETS = {
     # (supervisor 8s) and the version-gated skip files (remat 0.3,
     # multihost 0.05, properties 0.06, pipeline_parallel 4.9 measured
     # 2026-08-03).
-    "test_curves.py": 3,
+    "test_curves.py": 2,
     "test_deferred_stats.py": 5,
     "test_dpo.py": 15,
     # r09 re-baseline: every touched-or-large budget re-measured
@@ -47,7 +47,7 @@ TIER1_BUDGETS = {
     # generation 11.5s, seq2seq 16.6s, remat 0.3s, models 16.2s
     # (raised 15->20), peft 13.9s, trainers 7.9s
     "test_elastic.py": 34,
-    "test_examples.py": 4,
+    "test_examples.py": 2,
     "test_exp_queue.py": 29,
     "test_fault_tolerance.py": 63,
     "test_flash_attention.py": 14,
@@ -82,6 +82,15 @@ TIER1_BUDGETS = {
     # multihost 0.05s, pipeline_parallel 4.9s, ring_attention 6.3s,
     # sharding 6.1s, properties 0.06s measured 2026-08-03
     "test_multihost.py": 2,
+    # r16: transport/fault-injector suite — all tier-1 tests are
+    # host-side (loopback TcpHub, fake-clock fault schedules, tiny
+    # numpy payloads), measured 3.3s serial on THIS 1-core container
+    # (2026-08-07, ~2x budget scale -> ~1.6); the multi-process
+    # partition-and-rejoin integration is slow-marked (bench --chaos
+    # network leg is its acceptance gate). Paid under the unchanged
+    # 780 ceiling by trimming curves 3->2 (0.14s measured here) and
+    # examples 4->2 (0.35s measured here), both re-measured same day.
+    "test_net.py": 3,
     # r11: flight-recorder suite (fake-clock units + ONE tiny learn()
     # integration) — measured ~20s serial on the 8-way CPU mesh
     # (2026-08-04). Paid for under the unchanged ceiling by trimming

@@ -30,6 +30,27 @@ is a no-op returning False. That single primitive covers fleet chunk
 dispatch/delivery and serve request/response traffic; richer semantics
 (ordering, leases, staleness) stay where they are — in exp/transport.py
 and the consumers — on top of it.
+
+Alongside immutable messages the interface carries RECORDS: small
+mutable JSON documents with last-write-wins semantics (``put_record``
+/ ``get_record`` / ``list_records`` / ``delete_record``). Records are
+what the fleet CONTROL PLANE is made of — membership epochs, worker
+heartbeats, quarantine verdicts, the shutdown flag, broadcast
+manifests and the CURRENT pointer — so once they ride the transport,
+a worker fleet needs NO shared filesystem at all. On the shared-fs
+backend a record (topic, name) is exactly ``<root>/<topic>/<name>.json``
+written atomically, which makes the refactor byte-identical to the
+pre-records fleet layout (``membership.json``, ``workers/<id>.json``,
+…).
+
+Fault injection: :class:`FaultyTransport` wraps any backend with a
+deterministic, seed-driven per-link fault schedule (drop / delay /
+duplicate / reorder / partition) using the SAME entry grammar and
+per-fault RNG-stream discipline as ``utils/chaos.py`` (append-only
+fault tuple, one ``random.Random(seed * 1_000_003 + i)`` stream per
+fault), so a hostile network is a reproducible test, not a flake
+generator. Configure it with a ``faults`` sub-dict in any transport
+spec, or wrap programmatically in tests.
 """
 
 from __future__ import annotations
@@ -37,16 +58,23 @@ from __future__ import annotations
 import io
 import json
 import os
+import random
 import shutil
 import socket
 import socketserver
 import struct
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from trlx_tpu.utils import logging
+from trlx_tpu.utils.resilient import (
+    DeadlineExceeded,
+    call_with_deadline,
+    compute_backoff,
+)
 
 logger = logging.get_logger(__name__)
 
@@ -94,6 +122,32 @@ class Transport:
             if name.startswith(prefix):
                 self.delete(topic, name)
 
+    # -- records: mutable last-write-wins JSON documents ------------------
+    #
+    # Messages are immutable (second put dedups); records are the
+    # opposite — rewritten in place on every heartbeat / pointer flip.
+    # Both live in the same topic namespace without colliding: on
+    # shared-fs a record is a ``<name>.json`` FILE where a message is a
+    # directory, and ``list``/``list_records`` each see only their own
+    # kind.
+
+    def put_record(self, topic: str, name: str, meta: Dict[str, Any]) -> None:
+        """Write (or atomically overwrite) a record."""
+        raise NotImplementedError
+
+    def get_record(self, topic: str, name: str) -> Optional[Dict[str, Any]]:
+        """The record, or None when absent (a torn/mid-write record
+        also reads as absent — the writer side is atomic)."""
+        raise NotImplementedError
+
+    def list_records(self, topic: str) -> List[str]:
+        """Record names in the topic, sorted."""
+        raise NotImplementedError
+
+    def delete_record(self, topic: str, name: str) -> None:
+        """Drop a record (idempotent; absent is fine)."""
+        raise NotImplementedError
+
     def close(self) -> None:
         pass
 
@@ -139,13 +193,52 @@ class SharedFSTransport(Transport):
             entries = sorted(os.listdir(self._dir(topic)))
         except OSError:
             return []
-        # ".tmp_" entries are half-committed message dirs mid-rename
+        # ".tmp_" entries are half-committed message dirs mid-rename;
+        # plain files are RECORDS (``<name>.json``), not messages
         return [
-            e for e in entries if not e.startswith(".") and ".tmp" not in e
+            e for e in entries
+            if not e.startswith(".") and ".tmp" not in e
+            and os.path.isdir(self._dir(topic, e))
         ]
 
     def delete(self, topic, name):
         shutil.rmtree(self._dir(topic, name), ignore_errors=True)
+
+    # -- records (``<root>/<topic>/<name>.json``, atomic rewrite) ---------
+
+    def _record_path(self, topic: str, name: str) -> str:
+        return os.path.join(self._dir(topic), f"{name}.json")
+
+    def put_record(self, topic, name, meta):
+        from trlx_tpu.utils.checkpointing import atomic_json_write
+
+        os.makedirs(self._dir(topic), exist_ok=True)
+        atomic_json_write(self._record_path(topic, name), dict(meta))
+
+    def get_record(self, topic, name):
+        try:
+            with open(self._record_path(topic, name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def list_records(self, topic):
+        try:
+            entries = sorted(os.listdir(self._dir(topic)))
+        except OSError:
+            return []
+        return [
+            e[: -len(".json")] for e in entries
+            if e.endswith(".json") and not e.startswith(".")
+            and ".tmp" not in e
+            and os.path.isfile(self._dir(topic, e))
+        ]
+
+    def delete_record(self, topic, name):
+        try:
+            os.remove(self._record_path(topic, name))
+        except OSError:
+            pass
 
 
 # -- TCP backend --------------------------------------------------------
@@ -193,9 +286,12 @@ def _unpack_arrays(blob: bytes) -> Dict[str, np.ndarray]:
 class _HubHandler(socketserver.BaseRequestHandler):
     def handle(self):
         hub: "TcpHub" = self.server.hub  # type: ignore[attr-defined]
+        # a half-open peer (died mid-frame, dropped link) must time out
+        # instead of pinning this handler thread forever
+        self.request.settimeout(hub.handler_timeout_s)
         try:
             header, blob = _recv_frame(self.request)
-        except (ConnectionError, ValueError, json.JSONDecodeError):
+        except (OSError, ConnectionError, ValueError, json.JSONDecodeError):
             return
         cmd = header.get("cmd")
         topic = header.get("topic", "")
@@ -204,6 +300,7 @@ class _HubHandler(socketserver.BaseRequestHandler):
         out_blob = b""
         with hub._lock:
             store = hub._topics.setdefault(topic, {})
+            records = hub._records.setdefault(topic, {})
             if cmd == "put":
                 if name in store:
                     resp["status"] = "duplicate"
@@ -225,6 +322,17 @@ class _HubHandler(socketserver.BaseRequestHandler):
                 resp["names"] = sorted(store)
             elif cmd == "delete":
                 store.pop(name, None)
+            elif cmd == "put_record":
+                records[name] = dict(header.get("meta") or {})
+            elif cmd == "get_record":
+                rec = records.get(name)
+                resp["found"] = rec is not None
+                if rec is not None:
+                    resp["meta"] = rec
+            elif cmd == "list_records":
+                resp["names"] = sorted(records)
+            elif cmd == "delete_record":
+                records.pop(name, None)
             else:
                 resp = {"ok": False, "error": f"unknown cmd {cmd!r}"}
         resp["blob_len"] = len(out_blob)
@@ -247,12 +355,20 @@ class TcpHub:
     right durability class for redeliverable traffic (chunks regenerate
     from replay snapshots, serve requests are client-retried)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        handler_timeout_s: float = 30.0,
+    ):
         self._server = _HubServer((host, port), _HubHandler)
         self._server.hub = self  # type: ignore[attr-defined]
         self._topics: Dict[str, Dict[str, Tuple[Dict[str, Any], bytes]]] = {}
+        self._records: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self.handler_timeout_s = float(handler_timeout_s)
         self._lock = threading.Lock()
         self.host, self.port = self._server.server_address[:2]
+        self.restarts = 0
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="transport-hub",
             daemon=True,
@@ -264,13 +380,48 @@ class TcpHub:
         self._server.shutdown()
         self._server.server_close()
 
+    def restart(self) -> None:
+        """Crash-and-relaunch in one call (the chaos ``hub_crash``
+        body): drop the server AND every volatile topic/record — which
+        is exactly what a supervised hub relaunch looks like to its
+        clients. Recovery needs no hub-side persistence: clients ride
+        their retry/backoff through the outage, workers re-register on
+        the next heartbeat, lost dispatches get a fresh attempt number
+        from the learner, and re-posted in-flight messages converge
+        through the put dedup."""
+        self.close()
+        with self._lock:
+            self._topics.clear()
+            self._records.clear()
+        self._server = _HubServer((self.host, self.port), _HubHandler)
+        self._server.hub = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="transport-hub",
+            daemon=True,
+        )
+        self._thread.start()
+        self.restarts += 1
+        logger.warning(
+            "transport hub restarted (empty) on %s:%d", self.host, self.port
+        )
+
 
 class TcpTransport(Transport):
     """Socket client for a :class:`TcpHub`. ``retries`` transparently
-    re-sends on connection errors; because PUT is deduplicating by
+    re-sends on connection errors with backoff+jitter between attempts
+    (``resilient.compute_backoff`` — a restarting hub sees a reconnect
+    ramp, not a thundering herd); because PUT is deduplicating by
     (topic, name), the retry loop is idempotent — a lost response whose
     request actually landed converges to ``duplicate``, which callers
-    already treat as success."""
+    already treat as success.
+
+    Every attempt — connect, send, recv — runs under
+    ``resilient.call_with_deadline(rpc_deadline_s)``. ``timeout_s``
+    bounds each individual socket op, but a half-open peer that drips
+    one byte per op could still pin a beat thread indefinitely; the
+    attempt-level deadline (default ``2 * timeout_s``) turns that into
+    a retriable failure that surfaces in watchdog/hang-doctor land
+    instead of a wedge."""
 
     def __init__(
         self,
@@ -279,15 +430,34 @@ class TcpTransport(Transport):
         retries: int = 3,
         timeout_s: float = 10.0,
         drop_hook=None,
+        rpc_deadline_s: Optional[float] = None,
+        backoff_base_s: float = 0.05,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         self.host, self.port = host, int(port)
         self.retries = int(retries)
         self.timeout_s = float(timeout_s)
+        self.rpc_deadline_s = (
+            float(rpc_deadline_s) if rpc_deadline_s is not None
+            else 2.0 * self.timeout_s
+        )
+        self.backoff_base_s = float(backoff_base_s)
+        self._sleep = sleep
         # chaos seam (serve_transport_drop): called before each send;
         # returning True "loses" the frame — the retry loop + hub dedup
         # must make delivery exactly-once anyway
         self.drop_hook = drop_hook
         self.stats = {"sent": 0, "dropped": 0, "retried": 0}
+
+    def _attempt(
+        self, header: Dict[str, Any], blob: bytes
+    ) -> Tuple[Dict[str, Any], bytes]:
+        with socket.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
+        ) as sock:
+            _send_frame(sock, dict(header, blob_len=len(blob)), blob)
+            self.stats["sent"] += 1
+            return _recv_frame(sock)
 
     def _rpc(
         self, header: Dict[str, Any], blob: bytes = b""
@@ -296,20 +466,23 @@ class TcpTransport(Transport):
         for attempt in range(self.retries + 1):
             if attempt:
                 self.stats["retried"] += 1
+                self._sleep(
+                    compute_backoff(
+                        attempt - 1, self.backoff_base_s, max_delay=1.0
+                    )
+                )
             if self.drop_hook is not None and self.drop_hook():
                 # the frame is "lost on the wire": no send this attempt
                 self.stats["dropped"] += 1
                 last = ConnectionError("transport: frame dropped (chaos)")
                 continue
             try:
-                with socket.create_connection(
-                    (self.host, self.port), timeout=self.timeout_s
-                ) as sock:
-                    header = dict(header, blob_len=len(blob))
-                    _send_frame(sock, header, blob)
-                    self.stats["sent"] += 1
-                    return _recv_frame(sock)
-            except (OSError, ConnectionError, ValueError) as e:
+                return call_with_deadline(
+                    self._attempt, self.rpc_deadline_s, header, blob
+                )
+            except (
+                OSError, ConnectionError, ValueError, DeadlineExceeded
+            ) as e:
                 last = e
         raise ConnectionError(
             f"transport: rpc {header.get('cmd')!r} to "
@@ -343,6 +516,242 @@ class TcpTransport(Transport):
     def delete(self, topic, name):
         self._rpc({"cmd": "delete", "topic": topic, "name": name})
 
+    # -- records: last-write-wins, so retries are trivially idempotent ----
+
+    def put_record(self, topic, name, meta):
+        self._rpc(
+            {"cmd": "put_record", "topic": topic, "name": name,
+             "meta": dict(meta)}
+        )
+
+    def get_record(self, topic, name):
+        resp, _ = self._rpc(
+            {"cmd": "get_record", "topic": topic, "name": name}
+        )
+        return (resp.get("meta") or {}) if resp.get("found") else None
+
+    def list_records(self, topic):
+        resp, _ = self._rpc({"cmd": "list_records", "topic": topic})
+        return list(resp.get("names") or [])
+
+    def delete_record(self, topic, name):
+        self._rpc({"cmd": "delete_record", "topic": topic, "name": name})
+
+
+# -- deterministic per-link fault injection -----------------------------
+
+# Append-only, like chaos.FAULT_SITES and for the same reason: each
+# fault draws from its own ``random.Random(seed * 1_000_003 + i)``
+# stream keyed by POSITION, so appending a new fault kind leaves every
+# existing schedule bit-identical. graft-lint's append-discipline check
+# doesn't police this tuple (it isn't a chaos site list), but the
+# contract is identical and tests pin the prefix.
+NET_FAULT_SITES = (
+    "drop",        # this op raises ConnectionError (frame lost on the wire)
+    "delay",       # this op completes after sleeping ``delay_s``
+    "duplicate",   # a put lands TWICE (retry after a lost ack) — dedup eats it
+    "reorder",     # a list returns names in reversed order
+    "partition",   # the LINK goes down for ``partition_s``: every op fails
+)
+
+
+class FaultyTransport(Transport):
+    """Deterministic per-link fault injector wrapping any backend.
+
+    Faults use the exact entry grammar of ``utils/chaos.py`` —
+    ``{fault, at | every | p, span}`` matched against a per-fault
+    op counter — and the same per-fault RNG-stream discipline (see
+    :data:`NET_FAULT_SITES`), so a hostile network is a reproducible
+    schedule, not a flake generator. Configure via a ``faults``
+    sub-dict in any transport spec::
+
+        transport:
+          backend: tcp
+          host: 10.0.0.1
+          port: 9123
+          faults:
+            seed: 7
+            partition_s: 2.0
+            faults: [{fault: partition, at: 3}, {fault: drop, p: 0.01}]
+
+    or wrap programmatically. An armed :class:`~trlx_tpu.utils.chaos.
+    ChaosMonkey` can additionally drive the injector through the
+    ``net_drop`` / ``net_partition`` sites: each attempted op on a
+    LIVE link consults both sites once (a chaos-driven partition lasts
+    ``chaos.stall_delay`` seconds). Because ops-per-second depends on
+    wall-clock (beat threads, poll loops), chaos counts at this seam
+    are timing-dependent — schedules should use ``p:`` or small
+    ``at:`` values, and assertions should target the recovery
+    behavior (eviction, rejoin, bit-equality), which holds no matter
+    which op the fault lands on.
+
+    Gate order per op: existing partition → new partition → drop →
+    delay; ``duplicate`` applies after a successful message put,
+    ``reorder`` to list results. ``clock``/``sleep`` are injectable
+    for fake-clock tests."""
+
+    def __init__(
+        self,
+        inner: Transport,
+        config: Optional[Dict[str, Any]] = None,
+        chaos=None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        from trlx_tpu.utils.chaos import _Entry
+
+        config = dict(config or {})
+        known = {"seed", "faults", "delay_s", "partition_s"}
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f"transport faults: unknown keys {sorted(unknown)}"
+            )
+        self.inner = inner
+        self.chaos = chaos
+        self.seed = int(config.get("seed", 0))
+        self.delay_s = float(config.get("delay_s", 0.05))
+        self.partition_s = float(config.get("partition_s", 1.0))
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._entries: Dict[str, list] = {s: [] for s in NET_FAULT_SITES}
+        self._counts: Dict[str, int] = {s: 0 for s in NET_FAULT_SITES}
+        self._rngs = {
+            site: random.Random(self.seed * 1_000_003 + i)
+            for i, site in enumerate(NET_FAULT_SITES)
+        }
+        for raw in config.get("faults") or []:
+            raw = dict(raw)
+            fault = raw.pop("fault", None)
+            if fault not in NET_FAULT_SITES:
+                raise ValueError(
+                    f"transport faults: unknown fault {fault!r} "
+                    f"(choose from {list(NET_FAULT_SITES)})"
+                )
+            bad = set(raw) - {"at", "span", "every", "p"}
+            if bad:
+                raise ValueError(
+                    f"transport faults[{fault}]: unknown keys {sorted(bad)}"
+                )
+            entry = _Entry(fault=fault, **raw)
+            if entry.at is None and entry.every is None and entry.p is None:
+                raise ValueError(
+                    f"transport faults[{fault}]: one of at/every/p required"
+                )
+            self._entries[fault].append(entry)
+        self._partition_until = 0.0
+        self.stats = {
+            "ops": 0, "dropped": 0, "delayed": 0, "duplicated": 0,
+            "reordered": 0, "partitions": 0, "partitioned_ops": 0,
+        }
+
+    def _consult(self, site: str) -> bool:
+        with self._lock:
+            self._counts[site] += 1
+            count, rng = self._counts[site], self._rngs[site]
+            # evaluate EVERY entry (no short-circuit) so each takes its
+            # p-draw — same stream discipline as ChaosMonkey.consult
+            return any([e.matches(count, rng) for e in self._entries[site]])
+
+    def _gate(self, op: str) -> None:
+        self.stats["ops"] += 1
+        now = self._clock()
+        with self._lock:
+            down = now < self._partition_until
+        if down:
+            self.stats["partitioned_ops"] += 1
+            raise ConnectionError(
+                f"faulty transport: link partitioned ({op})"
+            )
+        partition = self._consult("partition")
+        partition_s = self.partition_s
+        if self.chaos is not None and self.chaos.consult("net_partition"):
+            partition = True
+            partition_s = self.chaos.stall_delay
+        if partition:
+            with self._lock:
+                self._partition_until = now + partition_s
+            self.stats["partitions"] += 1
+            self.stats["partitioned_ops"] += 1
+            raise ConnectionError(
+                f"faulty transport: link partitioned for "
+                f"{partition_s:.2f}s ({op})"
+            )
+        drop = self._consult("drop")
+        if self.chaos is not None and self.chaos.consult("net_drop"):
+            drop = True
+        if drop:
+            self.stats["dropped"] += 1
+            raise ConnectionError(f"faulty transport: frame dropped ({op})")
+        if self._consult("delay"):
+            self.stats["delayed"] += 1
+            self._sleep(self.delay_s)
+
+    def put(self, topic, name, meta, arrays=None, meta_name="meta.json"):
+        self._gate("put")
+        accepted = self.inner.put(
+            topic, name, meta, arrays, meta_name=meta_name
+        )
+        if self._consult("duplicate"):
+            # retry-after-lost-ack: the same frame lands twice; the
+            # inner dedup must report duplicate, proving convergence
+            self.stats["duplicated"] += 1
+            self.inner.put(topic, name, meta, arrays, meta_name=meta_name)
+        return accepted
+
+    def get(self, topic, name, meta_name="meta.json"):
+        self._gate("get")
+        return self.inner.get(topic, name, meta_name=meta_name)
+
+    def get_meta(self, topic, name, meta_name="meta.json"):
+        self._gate("get_meta")
+        return self.inner.get_meta(topic, name, meta_name=meta_name)
+
+    def list(self, topic):
+        self._gate("list")
+        names = self.inner.list(topic)
+        if self._consult("reorder"):
+            self.stats["reordered"] += 1
+            names = list(reversed(names))
+        return names
+
+    def delete(self, topic, name):
+        self._gate("delete")
+        self.inner.delete(topic, name)
+
+    def put_record(self, topic, name, meta):
+        self._gate("put_record")
+        self.inner.put_record(topic, name, meta)
+
+    def get_record(self, topic, name):
+        self._gate("get_record")
+        return self.inner.get_record(topic, name)
+
+    def list_records(self, topic):
+        self._gate("list_records")
+        names = self.inner.list_records(topic)
+        if self._consult("reorder"):
+            self.stats["reordered"] += 1
+            names = list(reversed(names))
+        return names
+
+    def delete_record(self, topic, name):
+        self._gate("delete_record")
+        self.inner.delete_record(topic, name)
+
+    def close(self):
+        self.inner.close()
+
+
+def base_transport(transport: Transport) -> Transport:
+    """Unwrap fault-injector layers to the real backend (used where
+    behavior must key on the BACKEND, e.g. picking the broadcast
+    implementation, not on whether a test wrapped it in faults)."""
+    while isinstance(transport, FaultyTransport):
+        transport = transport.inner
+    return transport
+
 
 def make_hub_transport(
     spec: Optional[Dict[str, Any]],
@@ -357,7 +766,12 @@ def make_hub_transport(
     spec = dict(spec or {})
     if spec.pop("backend", None) != "tcp":
         raise ValueError("make_hub_transport: spec.backend must be 'tcp'")
-    known = {"host", "port", "retries", "timeout_s", "bind"}
+    # ``faults`` in the spec describes the NETWORK links; the hub host's
+    # loopback client isn't one, so it stays unwrapped here (remote
+    # peers pick the faults up through make_transport)
+    spec.pop("faults", None)
+    known = {"host", "port", "retries", "timeout_s", "bind",
+             "rpc_deadline_s", "host_hub"}
     unknown = set(spec) - known
     if unknown:
         raise ValueError(f"transport (tcp hub): unknown keys {sorted(unknown)}")
@@ -366,6 +780,7 @@ def make_hub_transport(
         "127.0.0.1", hub.port,
         retries=int(spec.get("retries", 3)),
         timeout_s=float(spec.get("timeout_s", 10.0)),
+        rpc_deadline_s=spec.get("rpc_deadline_s"),
     )
     advertised = {
         "backend": "tcp", "host": spec.get("host", hub.host),
@@ -379,11 +794,26 @@ def make_server_transport(
 ) -> Tuple[Optional[TcpHub], Transport, Dict[str, Any]]:
     """The CONSUMER side's one-stop bootstrap (serving frontend, fleet
     learner): ``(hub_or_None, transport, advertised client spec)``.
-    tcp specs host the hub via :func:`make_hub_transport`; everything
-    else resolves through :func:`make_transport` (shared-fs peers use
-    the advertised root)."""
+    tcp specs host the hub via :func:`make_hub_transport` — unless
+    ``host_hub: false``, which says an EXTERNAL hub process owns the
+    address (``python -m trlx_tpu.exp.net``, supervised via
+    ``scripts/supervise.py --hub-cmd``) and the consumer should just
+    be a client of it. Everything else resolves through
+    :func:`make_transport` (shared-fs peers use the advertised
+    root)."""
     spec = dict(spec or {})
     if spec.get("backend") == "tcp":
+        if not spec.get("host_hub", True):
+            if not spec.get("port"):
+                raise ValueError(
+                    "transport: host_hub=false needs an explicit port "
+                    "(the external hub's address)"
+                )
+            return None, make_transport(spec, default_root), {
+                "backend": "tcp",
+                "host": spec.get("host", "127.0.0.1"),
+                "port": int(spec["port"]),
+            }
         return make_hub_transport(spec)
     transport = make_transport(spec, default_root)
     return None, transport, {
@@ -396,15 +826,19 @@ def make_transport(
 ) -> Transport:
     """Config -> backend (the CLIENT side for tcp). ``spec`` keys:
     ``backend`` ("shared_fs", default, or "tcp"), ``root``
-    (shared_fs), ``host``/``port`` (tcp client; ``bind`` is tolerated
-    so server and client can share one spec dict),
-    ``retries``/``timeout_s`` (tcp). Unknown keys fail loudly — a
-    typo'd backend must not silently fall back to the default."""
+    (shared_fs), ``host``/``port`` (tcp client; ``bind`` and
+    ``host_hub`` are tolerated so server and client can share one spec
+    dict), ``retries``/``timeout_s``/``rpc_deadline_s`` (tcp), and
+    ``faults`` (any backend — wraps the result in
+    :class:`FaultyTransport`). Unknown keys fail loudly — a typo'd
+    backend must not silently fall back to the default."""
     spec = dict(spec or {})
+    faults = spec.pop("faults", None)
     backend = spec.pop("backend", "shared_fs")
     known = {
         "shared_fs": {"root"},
-        "tcp": {"host", "port", "retries", "timeout_s", "bind"},
+        "tcp": {"host", "port", "retries", "timeout_s", "bind",
+                "rpc_deadline_s", "host_hub"},
     }
     if backend not in known:
         raise ValueError(
@@ -419,9 +853,56 @@ def make_transport(
     if backend == "tcp":
         if "port" not in spec:
             raise ValueError("transport.backend tcp needs host/port")
-        return TcpTransport(
+        transport: Transport = TcpTransport(
             spec.get("host", "127.0.0.1"), spec["port"],
             retries=int(spec.get("retries", 3)),
             timeout_s=float(spec.get("timeout_s", 10.0)),
+            rpc_deadline_s=spec.get("rpc_deadline_s"),
         )
-    return SharedFSTransport(spec.get("root") or default_root)
+    else:
+        transport = SharedFSTransport(spec.get("root") or default_root)
+    if faults:
+        transport = FaultyTransport(transport, faults)
+    return transport
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Standalone hub process: ``python -m trlx_tpu.exp.net --port N``.
+
+    This is the ``host_hub: false`` counterpart — the hub runs as its
+    own supervised role (``scripts/supervise.py --hub-cmd``) so a hub
+    crash is an exit code routed through the supervisor's restart
+    ladder, while learner and workers ride their reconnect/re-register
+    recovery. Exits 0 on SIGTERM/Ctrl-C (a deliberate stop)."""
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.exp.net",
+        description="run a standalone transport hub",
+    )
+    parser.add_argument("--bind", default="127.0.0.1",
+                        help="listen address (0.0.0.0 for remote peers)")
+    parser.add_argument("--port", type=int, required=True,
+                        help="listen port (fixed: clients need it)")
+    parser.add_argument("--handler-timeout-s", type=float, default=30.0)
+    args = parser.parse_args(argv)
+
+    hub = TcpHub(args.bind, args.port,
+                 handler_timeout_s=args.handler_timeout_s)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    print(f"transport hub listening on {hub.host}:{hub.port}", flush=True)
+    try:
+        while not stop.wait(0.2):
+            pass
+    except KeyboardInterrupt:
+        pass
+    hub.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

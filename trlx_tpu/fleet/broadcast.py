@@ -1,6 +1,9 @@
 """Versioned weight broadcast: learner publishes, workers refresh.
 
-One snapshot per policy version under ``<fleet>/broadcast/``::
+Two implementations behind one publish/fetch surface:
+
+:class:`WeightBroadcast` — the golden shared-filesystem channel. One
+snapshot per policy version under ``<fleet>/broadcast/``::
 
     broadcast/
       vNNNNNNNN/arrays.npz     path-keyed host copies of the params
@@ -11,18 +14,32 @@ One snapshot per policy version under ``<fleet>/broadcast/``::
 Publication uses the checkpoint commit discipline: write into a temp
 directory, manifest + fsync, one atomic rename, THEN flip the CURRENT
 pointer — a learner dying mid-publish leaves the previous version
-intact and pointed-to. Consumption verifies the manifest BEFORE
-loading: a corrupt or torn snapshot (bit-rot, a half-replicated
-shared-filesystem read) is rejected and the worker KEEPS its previous
-version — broadcast failure degrades to off-policy data the
-``exp.staleness`` gate corrects, never to wrong weights.
+intact and pointed-to.
+
+:class:`ChunkedBroadcast` — the same contract over any ``exp/net.py``
+Transport (i.e. no shared filesystem): the snapshot is split into
+size-bounded array chunks published as immutable messages, described
+by a manifest RECORD carrying a per-chunk sha256, with a CURRENT
+record flipped last. Workers verify each chunk's digest as it arrives
+and keep verified chunks in a local resume cache, so a partition or
+torn transfer mid-fetch costs a retry of the MISSING chunks, not a
+full re-download. :func:`make_broadcast` picks the implementation from
+the transport backend.
+
+Both consumption paths verify BEFORE loading: a corrupt or torn
+snapshot (bit-rot, a half-replicated shared-filesystem read, a
+mid-republish chunk swap, a forged frame) is rejected with
+:class:`BroadcastCorrupt` and the worker KEEPS its previous version —
+broadcast failure degrades to off-policy data the ``exp.staleness``
+gate corrects, never to wrong weights.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shutil
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -39,6 +56,10 @@ logger = logging.get_logger(__name__)
 CURRENT_FILE = "CURRENT.json"
 ARRAYS_FILE = "arrays.npz"
 META_FILE = "meta.json"
+
+BROADCAST_TOPIC = "broadcast"
+CURRENT_RECORD = "CURRENT"
+DEFAULT_CHUNK_BYTES = 4 * 1024 * 1024
 
 
 class BroadcastCorrupt(RuntimeError):
@@ -148,3 +169,251 @@ class WeightBroadcast:
             arrays = {k: z[k] for k in z.files}
         self.stats["fetched"] += 1
         return int(cur["version"]), arrays
+
+
+# -- transport-native (chunked, resumable) ------------------------------
+
+
+def _chunk_digest(arrays: Dict[str, np.ndarray]) -> str:
+    """sha256 over the CANONICAL content of a chunk: per-array name,
+    dtype, shape, raw bytes, in name order. Deliberately NOT a digest
+    of the packed npz blob — zip containers embed timestamps and the
+    shared-fs backend re-serializes arrays, so the blob is not stable;
+    the array contents are."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(np.asarray(arrays[name]))
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(tuple(a.shape)).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _plan_chunks(
+    arrays: Dict[str, np.ndarray], chunk_bytes: int
+) -> List[List[str]]:
+    """Greedy size-bounded grouping of array names (name order, so the
+    plan — and therefore every chunk digest — is deterministic for a
+    given params tree). An array larger than the budget gets a chunk
+    of its own rather than failing."""
+    groups: List[List[str]] = []
+    current: List[str] = []
+    used = 0
+    for name in sorted(arrays):
+        size = int(np.asarray(arrays[name]).nbytes)
+        if current and used + size > chunk_bytes:
+            groups.append(current)
+            current, used = [], 0
+        current.append(name)
+        used += size
+    if current:
+        groups.append(current)
+    return groups
+
+
+class ChunkedBroadcast:
+    """Weight-snapshot channel over a Transport (tcp hub, or anything
+    else) — the no-shared-filesystem counterpart of
+    :class:`WeightBroadcast` with the same publish/fetch surface.
+
+    Wire layout in topic ``broadcast``:
+
+      message ``vNNNNNNNN_cIIII``  one chunk: its arrays + meta
+                                   {version, chunk, sha256}
+      record  ``vNNNNNNNN``        the manifest: ordered chunk list
+                                   with per-chunk sha256 + array names
+      record  ``CURRENT``          {"version": N, "path": "vNNNNNNNN"}
+                                   — flipped LAST, so a learner dying
+                                   mid-publish leaves the previous
+                                   version pointed-to (same commit
+                                   discipline as the fs channel)
+
+    Fetch verifies every chunk digest against the manifest and stores
+    verified chunks in an in-memory resume cache keyed (name, sha):
+    when a partition tears a fetch, the caller's retry re-reads ONLY
+    the chunks it doesn't hold — per-chunk resume, not a re-download.
+    A manifest/chunk that stays missing or corrupt raises
+    :class:`BroadcastCorrupt`; an unreachable transport raises
+    ``ConnectionError`` (an ``OSError``) — both land in the worker's
+    keep-prior-version path.
+
+    ``chaos`` arms the ``broadcast_torn_fetch`` site: consulted once
+    per chunk actually read off the transport (resume-cache hits skip
+    it — they cost no network), a fire tears that chunk's transfer.
+    """
+
+    def __init__(
+        self,
+        transport,
+        keep: int = 2,
+        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+        chaos=None,
+    ):
+        self.transport = transport
+        self.keep = max(int(keep), 1)
+        self.chunk_bytes = max(int(chunk_bytes), 1)
+        self.chaos = chaos
+        self._cache: Dict[Tuple[str, str], Dict[str, np.ndarray]] = {}
+        self.stats: Dict[str, int] = {
+            "published": 0,
+            "fetched": 0,
+            "corrupt_rejected": 0,
+            "chunks_fetched": 0,
+            "chunks_resumed": 0,
+            "torn_fetches": 0,
+        }
+
+    # -- learner side -----------------------------------------------------
+
+    def publish(
+        self,
+        version: int,
+        arrays: Dict[str, np.ndarray],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Publish ``arrays`` as snapshot ``version`` and flip CURRENT
+        to it. Returns the version name (the fs channel returns a
+        directory; callers treat it as an opaque label). Re-publishing
+        an existing version (learner relaunch, hub restart losing the
+        messages) replaces it wholesale."""
+        name = _version_name(version)
+        # wipe any torn previous incarnation of this version first —
+        # chunk messages are immutable (dedup), so a changed chunk
+        # would otherwise silently keep its old payload
+        self.transport.delete_prefix(BROADCAST_TOPIC, f"{name}_c")
+        chunks = []
+        for i, group in enumerate(_plan_chunks(arrays, self.chunk_bytes)):
+            chunk_arrays = {k: np.asarray(arrays[k]) for k in group}
+            digest = _chunk_digest(chunk_arrays)
+            cname = f"{name}_c{i:04d}"
+            self.transport.put(
+                BROADCAST_TOPIC, cname,
+                {"version": int(version), "chunk": i, "sha256": digest},
+                chunk_arrays,
+            )
+            chunks.append({"name": cname, "sha256": digest,
+                           "arrays": sorted(group)})
+        self.transport.put_record(
+            BROADCAST_TOPIC, name,
+            {"version": int(version), "chunks": chunks,
+             **(meta or {})},
+        )
+        self.transport.put_record(
+            BROADCAST_TOPIC, CURRENT_RECORD,
+            {"version": int(version), "path": name},
+        )
+        self.stats["published"] += 1
+        self._apply_retention(version)
+        logger.info(
+            "weight broadcast: published policy version %d (%d chunks "
+            "over transport)", version, len(chunks),
+        )
+        return name
+
+    def _apply_retention(self, version: int) -> None:
+        try:
+            names = self.transport.list_records(BROADCAST_TOPIC)
+        except (OSError, ConnectionError):
+            return
+        versions = sorted(
+            n for n in names if n.startswith("v") and n[1:].isdigit()
+        )
+        for stale in versions[: -self.keep]:
+            try:
+                self.transport.delete_prefix(BROADCAST_TOPIC, f"{stale}_c")
+                self.transport.delete_record(BROADCAST_TOPIC, stale)
+            except (OSError, ConnectionError):
+                return
+
+    # -- worker side ------------------------------------------------------
+
+    def current_version(self) -> Optional[int]:
+        try:
+            cur = self.transport.get_record(BROADCAST_TOPIC, CURRENT_RECORD)
+            return int(cur["version"]) if cur else None
+        except (OSError, ConnectionError, ValueError, KeyError):
+            return None
+
+    def fetch(self) -> Tuple[int, Dict[str, np.ndarray]]:
+        """Assemble the CURRENT snapshot chunk by chunk, digest-
+        verified. Raises :class:`BroadcastCorrupt` on a missing/
+        mismatched chunk or manifest, ``FileNotFoundError`` when
+        nothing is published yet, ``ConnectionError`` mid-partition;
+        verified chunks survive in the resume cache either way."""
+        cur = self.transport.get_record(BROADCAST_TOPIC, CURRENT_RECORD)
+        if cur is None:
+            raise FileNotFoundError("broadcast: nothing published yet")
+        name = str(cur["path"])
+        manifest = self.transport.get_record(BROADCAST_TOPIC, name)
+        if manifest is None:
+            # CURRENT flipped but the manifest is gone: a hub restart
+            # ate the records mid-read, or retention raced us
+            self.stats["corrupt_rejected"] += 1
+            raise BroadcastCorrupt(
+                f"broadcast: manifest {name} missing behind CURRENT"
+            )
+        # the cache only ever serves the version being fetched
+        self._cache = {
+            k: v for k, v in self._cache.items()
+            if k[0].startswith(f"{name}_c")
+        }
+        out: Dict[str, np.ndarray] = {}
+        for entry in manifest.get("chunks", []):
+            cname, sha = str(entry["name"]), str(entry["sha256"])
+            cached = self._cache.get((cname, sha))
+            if cached is not None:
+                self.stats["chunks_resumed"] += 1
+                out.update(cached)
+                continue
+            if self.chaos is not None and self.chaos.consult(
+                "broadcast_torn_fetch"
+            ):
+                self.stats["torn_fetches"] += 1
+                raise BroadcastCorrupt(
+                    f"broadcast: chunk {cname} transfer torn (chaos)"
+                )
+            msg = self.transport.get(BROADCAST_TOPIC, cname)
+            if msg is None:
+                self.stats["corrupt_rejected"] += 1
+                raise BroadcastCorrupt(
+                    f"broadcast: chunk {cname} missing (torn publish or "
+                    f"hub restart)"
+                )
+            _, arrays = msg
+            if _chunk_digest(arrays) != sha:
+                self.stats["corrupt_rejected"] += 1
+                raise BroadcastCorrupt(
+                    f"broadcast: chunk {cname} failed sha256 verification"
+                )
+            self._cache[(cname, sha)] = arrays
+            self.stats["chunks_fetched"] += 1
+            out.update(arrays)
+        self.stats["fetched"] += 1
+        self._cache.clear()  # assembled — the resume window is over
+        return int(cur["version"]), out
+
+
+def make_broadcast(
+    transport,
+    keep: int = 2,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    chaos=None,
+):
+    """Pick the broadcast channel for a transport: the golden
+    filesystem snapshot layout when the BACKEND is shared-fs (learner
+    and worker may disagree on fault wrappers, so the choice keys on
+    the unwrapped backend — both sides must speak the same layout),
+    chunked-over-transport otherwise. On shared-fs the snapshot files
+    are read directly (not through any fault wrapper): the injector
+    models network links, and the golden path has none."""
+    from trlx_tpu.exp.net import SharedFSTransport, base_transport
+
+    base = base_transport(transport)
+    if isinstance(base, SharedFSTransport):
+        return WeightBroadcast(
+            os.path.join(base.root, BROADCAST_TOPIC), keep=keep
+        )
+    return ChunkedBroadcast(
+        transport, keep=keep, chunk_bytes=chunk_bytes, chaos=chaos
+    )

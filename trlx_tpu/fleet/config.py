@@ -60,19 +60,37 @@ class FleetConfig:
                        verification).
     attach_timeout_s   how long a WORKER waits for the learner's
                        membership record to appear before giving up.
-    transport          chunk dispatch/delivery backend (exp/net.py
-                       spec): ``{}`` = ``{backend: shared_fs}`` rooted
-                       at ``dir`` (the golden pre-interface layout,
-                       bit-equal). ``{backend: tcp, port: N, host:
-                       <learner addr>, bind: 0.0.0.0}`` makes the
-                       LEARNER host a socket hub for the chunk traffic
-                       (use a fixed non-zero port so workers can find
-                       it; workers connect to ``host:port`` with the
-                       same spec dict) so workers can sit on another
-                       machine. Membership + weight broadcast still
-                       live under ``dir`` in v1 — a cross-machine
-                       fleet needs it network-mounted (docs/serving.md
-                       "Transport backends").
+    detach_timeout_s   how long the membership record may stay
+                       unreadable/absent AFTER a successful attach
+                       before the worker concludes the learner AND its
+                       hub are gone for good and exits CLEAN (its
+                       durable output is the chunks it delivered). A
+                       learner restart or hub relaunch inside the
+                       window just re-registers the worker — this
+                       fires only when nothing ever comes back, e.g. a
+                       hosted hub that closed while this worker's link
+                       was partitioned.
+    transport          the fleet's ENTIRE cross-process substrate
+                       (exp/net.py spec) — chunk dispatch/delivery,
+                       membership records, the shutdown flag, AND the
+                       weight broadcast all ride it: ``{}`` =
+                       ``{backend: shared_fs}`` rooted at ``dir`` (the
+                       golden pre-interface layout, bit-equal).
+                       ``{backend: tcp, port: N, host: <learner addr>,
+                       bind: 0.0.0.0}`` makes the LEARNER host a
+                       socket hub (use a fixed non-zero port so
+                       workers can find it; workers connect to
+                       ``host:port`` with the same spec dict) and the
+                       broadcast goes chunked-with-sha256-resume over
+                       the socket — workers then need NO shared
+                       filesystem at all. Add ``host_hub: false`` to
+                       point every role at an EXTERNAL supervised hub
+                       (``python -m trlx_tpu.exp.net``), ``retries``/
+                       ``timeout_s``/``rpc_deadline_s`` to tune the
+                       client's retry ladder, and a ``faults``
+                       sub-dict for the deterministic per-link fault
+                       injector (docs/serving.md "Transport backends",
+                       docs/robustness.md "Network fault model").
     """
 
     enabled: bool = False
@@ -87,6 +105,7 @@ class FleetConfig:
     broadcast_every: int = 1
     broadcast_keep: int = 2
     attach_timeout_s: float = 120.0
+    detach_timeout_s: float = 60.0
     transport: Optional[Dict[str, Any]] = None
 
     @classmethod
@@ -108,6 +127,8 @@ class FleetConfig:
             raise ValueError("fleet.flap_limit must be >= 1")
         if cfg.broadcast_every < 1:
             raise ValueError("fleet.broadcast_every must be >= 1")
+        if cfg.detach_timeout_s <= 0:
+            raise ValueError("fleet.detach_timeout_s must be > 0")
         return cfg
 
     def resolved_dir(self, checkpoint_dir: str) -> str:

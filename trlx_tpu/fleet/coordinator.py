@@ -21,13 +21,21 @@ original atomic-rename protocol, byte for byte::
     chunks/e{epoch}_s{seq}/                the delivered chunk payload
 
 On the tcp backend the same (topic, name) messages live in a
-:class:`trlx_tpu.exp.net.TcpHub` — workers then need no shared
-filesystem for chunk traffic (membership + broadcast still use ``dir``
-in v1).
+:class:`trlx_tpu.exp.net.TcpHub`, and the CONTROL PLANE — membership
+records, the shutdown flag, the chunked weight broadcast — rides the
+very same transport, so workers need no shared filesystem at all.
 
 Delivery is naturally deduplicating: the chunk dir name carries no
 attempt, so whichever attempt's rename lands first wins and the other
 drops itself (both are bit-identical by the replay contract anyway).
+
+Transport failures DEGRADE instead of crash: ``dispatch`` reports
+False, polls read as not-yet-delivered, and the trainer's existing
+below-min-workers ladder takes over (in-process fallback is
+bit-identical by the replay contract). A learner-side chaos
+``hub_crash`` relaunches the hub empty via :meth:`FleetCoordinator.
+crash_hub` — recovery is re-registration (worker beats), fresh
+dispatch attempts, and the put dedup for re-posted in-flight traffic.
 """
 
 from __future__ import annotations
@@ -38,16 +46,16 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from trlx_tpu.fleet.broadcast import WeightBroadcast
+from trlx_tpu.fleet.broadcast import BROADCAST_TOPIC, make_broadcast
 from trlx_tpu.fleet.config import FleetConfig
-from trlx_tpu.fleet.membership import WorkerRegistry
+from trlx_tpu.fleet.membership import MEMBERSHIP_RECORD, WorkerRegistry
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
 
 DISPATCH_DIR = "dispatch"
 CHUNKS_DIR = "chunks"
-BROADCAST_DIR = "broadcast"
+BROADCAST_DIR = BROADCAST_TOPIC
 
 
 def chunk_name(chunk_id: Tuple[int, int]) -> str:
@@ -63,17 +71,21 @@ class FleetCoordinator:
         clock: Callable[[], float] = time.time,
         transport=None,
     ):
-        from trlx_tpu.exp.net import make_server_transport
+        from trlx_tpu.exp.net import (
+            SharedFSTransport,
+            base_transport,
+            make_server_transport,
+        )
 
         self.cfg = cfg
         self.root = root
         self._clock = clock
-        # chunk dispatch/delivery rides the pluggable transport; the
-        # default shared-fs backend reproduces the pre-interface
-        # message-dir layout byte for byte. On the tcp backend the
-        # LEARNER hosts the hub (workers connect with the same spec's
-        # host/port). Membership + broadcast stay under `root`
-        # regardless of backend (v1 scope).
+        # everything — chunk dispatch/delivery, membership records,
+        # weight broadcast — rides the pluggable transport; the default
+        # shared-fs backend reproduces the pre-interface layout byte
+        # for byte. On the tcp backend the LEARNER hosts the hub
+        # (workers connect with the same spec's host/port) unless
+        # ``host_hub: false`` points at an external supervised hub.
         self.hub = None
         if transport is not None:
             self.transport = transport
@@ -82,17 +94,24 @@ class FleetCoordinator:
             self.hub, self.transport, self.transport_spec = (
                 make_server_transport(cfg.transport, root)
             )
-        os.makedirs(os.path.join(root, DISPATCH_DIR), exist_ok=True)
-        os.makedirs(os.path.join(root, CHUNKS_DIR), exist_ok=True)
+        shared_fs = isinstance(
+            base_transport(self.transport), SharedFSTransport
+        )
+        if shared_fs:
+            # golden layout only: a tcp-only learner must leave no
+            # fleet directories behind (proof the workers never need
+            # a shared path)
+            os.makedirs(os.path.join(root, DISPATCH_DIR), exist_ok=True)
+            os.makedirs(os.path.join(root, CHUNKS_DIR), exist_ok=True)
         self.registry = WorkerRegistry(
-            root,
+            root if shared_fs else self.transport,
             worker_ttl_s=cfg.worker_ttl_s,
             flap_limit=cfg.flap_limit,
             flap_backoff_s=cfg.flap_backoff_s,
             clock=clock,
         )
-        self.broadcast = WeightBroadcast(
-            os.path.join(root, BROADCAST_DIR), keep=cfg.broadcast_keep
+        self.broadcast = make_broadcast(
+            self.transport, keep=cfg.broadcast_keep
         )
         # the attach handshake: bump the membership epoch so surviving
         # workers from a previous learner incarnation re-register
@@ -112,6 +131,8 @@ class FleetCoordinator:
             "redispatches": 0,
             "degradations": 0,
             "recoveries": 0,
+            "hub_restarts": 0,
+            "transport_errors": 0,
         }
 
     # -- weight broadcast -------------------------------------------------
@@ -125,12 +146,22 @@ class FleetCoordinator:
         """Publish the policy snapshot for ``version`` if due
         (``fleet.broadcast_every`` versions since the last publish).
         ``post_publish(path)`` is the chaos seam (``broadcast_corrupt``
-        bit-flips the landed snapshot)."""
+        bit-flips the landed snapshot). A transport outage mid-publish
+        leaves the cursor UNMOVED so the next call republishes; workers
+        keep their held version through the gap (staleness-gated)."""
         if self._published_version is not None and (
             version - self._published_version < self.cfg.broadcast_every
         ):
             return
-        path = self.broadcast.publish(version, arrays_fn())
+        try:
+            path = self.broadcast.publish(version, arrays_fn())
+        except (OSError, ConnectionError) as e:
+            self.stats["transport_errors"] += 1
+            logger.error(
+                "fleet: broadcast publish of version %d failed (%s); "
+                "will retry next cycle", version, e,
+            )
+            return
         self._published_version = version
         if post_publish is not None:
             post_publish(path)
@@ -199,15 +230,27 @@ class FleetCoordinator:
         worker: str,
         meta: Dict[str, Any],
         arrays: Dict[str, np.ndarray],
-    ) -> None:
+    ) -> bool:
+        """Post the assignment. False on a transport outage — the
+        caller treats it like an empty live set (degrade to in-process
+        production, bit-identical by the replay contract) and the
+        attempt number is simply never answered."""
         name = f"{chunk_name(chunk_id)}_a{int(attempt)}"
-        self.transport.put(
-            DISPATCH_DIR, name,
-            {**meta, "worker": worker, "attempt": int(attempt),
-             "chunk_id": list(chunk_id)},
-            arrays,
-            meta_name="assignment.json",
-        )
+        try:
+            self.transport.put(
+                DISPATCH_DIR, name,
+                {**meta, "worker": worker, "attempt": int(attempt),
+                 "chunk_id": list(chunk_id)},
+                arrays,
+                meta_name="assignment.json",
+            )
+        except (OSError, ConnectionError) as e:
+            self.stats["transport_errors"] += 1
+            logger.error(
+                "fleet: dispatch of chunk %s attempt %d failed (%s)",
+                chunk_id, attempt, e,
+            )
+            return False
         self.stats["dispatched"] += 1
         if attempt > 1:
             self.stats["redispatches"] += 1
@@ -215,13 +258,20 @@ class FleetCoordinator:
             "fleet: dispatched chunk %s attempt %d to worker %r",
             chunk_id, attempt, worker,
         )
+        return True
 
     def poll_delivery(
         self, chunk_id: Tuple[int, int]
     ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
-        msg = self.transport.get(
-            CHUNKS_DIR, chunk_name(chunk_id), meta_name="chunk.json"
-        )
+        try:
+            msg = self.transport.get(
+                CHUNKS_DIR, chunk_name(chunk_id), meta_name="chunk.json"
+            )
+        except (OSError, ConnectionError):
+            # mid-outage reads as not-yet-delivered; the poll loop's
+            # eviction scan / dispatch timeout owns escalation
+            self.stats["transport_errors"] += 1
+            return None
         if msg is not None:
             self.stats["delivered"] += 1
         return msg
@@ -231,15 +281,47 @@ class FleetCoordinator:
         delivery from an abandoned attempt) — the outstanding dispatch
         assignment stays, so the currently-assigned worker is not
         stranded."""
-        self.transport.delete(CHUNKS_DIR, chunk_name(chunk_id))
+        try:
+            self.transport.delete(CHUNKS_DIR, chunk_name(chunk_id))
+        except (OSError, ConnectionError):
+            self.stats["transport_errors"] += 1
 
     def clear_chunk(self, chunk_id: Tuple[int, int]) -> None:
         """Drop a consumed chunk's delivery + dispatch messages (the
         transport queue owns the payload now; leftovers would only
-        confuse a postmortem)."""
+        confuse a postmortem — and on a volatile hub a restart clears
+        them anyway, so failure here is ignorable)."""
         name = chunk_name(chunk_id)
-        self.transport.delete(CHUNKS_DIR, name)
-        self.transport.delete_prefix(DISPATCH_DIR, f"{name}_a")
+        try:
+            self.transport.delete(CHUNKS_DIR, name)
+            self.transport.delete_prefix(DISPATCH_DIR, f"{name}_a")
+        except (OSError, ConnectionError):
+            self.stats["transport_errors"] += 1
+
+    # -- hub lifecycle (chaos + recovery) --------------------------------
+
+    def crash_hub(self) -> bool:
+        """Chaos ``hub_crash`` body: crash-and-relaunch the learner-
+        hosted hub with ALL volatile state lost — the worst observable
+        outcome of a supervised hub restart. No-op (False) when the
+        fleet isn't hosting one (shared-fs, or external host_hub=false
+        hub whose lifecycle the supervisor owns)."""
+        if self.hub is None:
+            return False
+        self.hub.restart()
+        self.stats["hub_restarts"] += 1
+        # volatile records are gone: re-stamp the attach epoch so
+        # workers' next membership poll sees the SAME epoch (no forced
+        # re-register storm) and the clean-finish semantics survive
+        try:
+            self.registry.control.put_record(
+                "", MEMBERSHIP_RECORD,
+                {"epoch": self.membership_epoch, "learner": "learner",
+                 "stamped_at": self._clock()},
+            )
+        except (OSError, ConnectionError):
+            self.stats["transport_errors"] += 1
+        return True
 
     # -- persistence / teardown ------------------------------------------
 
@@ -258,10 +340,39 @@ class FleetCoordinator:
             "broadcast_every": int(self.cfg.broadcast_every),
         }
 
-    def shutdown(self, reason: str = "clean finish") -> None:
+    def shutdown(
+        self, reason: str = "clean finish",
+        grace_s: Optional[float] = None,
+    ) -> None:
+        """Write the clean-finish flag, then tear down. When this
+        learner hosts the hub the flag lives in HUB memory — closing
+        immediately would take it away before workers poll it — so we
+        wait (bounded by ``grace_s``, default ``2 * worker_ttl_s``)
+        until every current-epoch worker's heartbeat goes silent,
+        i.e. every worker has seen the flag and exited its beat
+        loop."""
         self.registry.shutdown(reason)
-        if self.hub is not None:
-            self.hub.close()
+        if self.hub is None:
+            return
+        grace = (
+            float(grace_s) if grace_s is not None
+            else max(2.0 * self.cfg.worker_ttl_s, 1.0)
+        )
+        beat_gap = 3.0 * max(
+            min(self.cfg.worker_ttl_s / 4.0, 1.0), 0.02
+        )
+        deadline = time.time() + grace
+        while time.time() < deadline:
+            recs = self.registry.worker_records()
+            now = time.time()  # wall clock — matches worker beats
+            if all(
+                now - rec.get("last_beat", 0.0) > beat_gap
+                for rec in recs.values()
+                if rec.get("epoch") == self.membership_epoch
+            ):
+                break
+            time.sleep(max(self.cfg.poll_s, 0.02))
+        self.hub.close()
 
     def stats_summary(self) -> Dict[str, Any]:
         return {

@@ -1,30 +1,47 @@
 """Worker membership: heartbeat-leased registry + membership epochs.
 
 The fleet's liveness story, built on the same TTL/heartbeat shape as
-``exp/leases.py`` but across PROCESS boundaries: all state lives as
-atomically-written JSON records under the fleet directory (a shared
-filesystem is the one channel a TPU pod always has), so either side can
-die at any byte boundary and the survivor reads a consistent picture.
+``exp/leases.py`` but across PROCESS boundaries. All state lives as
+RECORDS on the ``exp/net.py Transport`` seam — last-write-wins JSON
+documents — so the control plane rides whatever backend the fleet is
+configured with: the shared-filesystem default (atomically-written
+files, byte-identical to the pre-transport layout) or a tcp hub (no
+shared filesystem at all). Either side can die at any byte boundary
+and the survivor reads a consistent picture; on tcp, a dead LINK reads
+as absent/unchanged and the TTL machinery turns that into eviction +
+rejoin rather than an exception.
 
-  membership.json    the learner's attach record: a MEMBERSHIP EPOCH
-                     bumped every time a learner attaches (fresh start
-                     OR supervisor relaunch). Workers poll it and
-                     re-register whenever the epoch moves — the
-                     handshake that lets a restarted learner re-attach
-                     a surviving fleet instead of orphaning it.
-  workers/<id>.json  one record per worker, rewritten atomically at
-                     every heartbeat (``last_beat`` + the epoch the
-                     worker registered under + the weight version it
-                     holds). A record silent past ``worker_ttl_s`` is
-                     EVICTED: removed, its in-flight chunk
-                     re-dispatched, and a flap recorded.
-  quarantine/<id>.json  learner-side verdict on a flapping worker
-                     (``flap_limit`` evictions in a row): excluded
-                     from dispatch until ``until``, with the backoff
-                     DOUBLING per repeat quarantine. Expiry re-admits.
-  shutdown.json      clean-finish flag: workers exit 0 when it
-                     appears (a crashed/stalled learner never writes
-                     it, so the fleet survives for the relaunch).
+Record layout (topic, name) — on shared-fs, ``<root>/<topic>/<name>
+.json``:
+
+  ("", "membership")     the learner's attach record: a MEMBERSHIP
+                         EPOCH bumped every time a learner attaches
+                         (fresh start OR supervisor relaunch). Workers
+                         poll it and re-register whenever the epoch
+                         moves — the handshake that lets a restarted
+                         learner re-attach a surviving fleet instead
+                         of orphaning it. The SAME handshake covers a
+                         hub restart: the flag/epoch records are
+                         re-written by the learner's next scan and
+                         workers' next beats re-register.
+  ("workers", <id>)      one record per worker, rewritten at every
+                         heartbeat (``last_beat`` + the epoch the
+                         worker registered under + the weight version
+                         it holds). A record silent past
+                         ``worker_ttl_s`` is EVICTED: removed, its
+                         in-flight chunk re-dispatched, and a flap
+                         recorded. A PARTITIONED worker looks exactly
+                         like a dead one — silent — which is the
+                         point: detection is uniform.
+  ("quarantine", <id>)   learner-side verdict on a flapping worker
+                         (``flap_limit`` evictions in a row): excluded
+                         from dispatch until ``until``, with the
+                         backoff DOUBLING per repeat quarantine.
+                         Expiry re-admits.
+  ("", "shutdown")       clean-finish flag: workers exit 0 when it
+                         appears (a crashed/stalled learner never
+                         writes it, so the fleet survives for the
+                         relaunch).
 
 Clocks are injectable (tier-1 drives eviction/quarantine on a fake
 clock); the cross-process default is ``time.time`` — wall clock,
@@ -36,53 +53,74 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 
 from trlx_tpu.utils import logging
-from trlx_tpu.utils.checkpointing import atomic_json_write
 
 logger = logging.get_logger(__name__)
 
+# legacy shared-fs names; the record topology below maps onto them
+# exactly (topic "" = the fleet root itself)
 MEMBERSHIP_FILE = "membership.json"
 SHUTDOWN_FILE = "shutdown.json"
 WORKERS_DIR = "workers"
 QUARANTINE_DIR = "quarantine"
 
+MEMBERSHIP_RECORD = "membership"
+SHUTDOWN_RECORD = "shutdown"
+WORKERS_TOPIC = "workers"
+QUARANTINE_TOPIC = "quarantine"
 
-def _read_json(path: str) -> Optional[Dict[str, Any]]:
-    """Parse-safe read: a torn/missing record reads as absent (the
-    writer side is atomic, so this only covers a reader racing the
-    very first write)."""
-    import json
+Control = Union[str, "Transport"]  # noqa: F821 — forward ref, see as_control
 
+
+def as_control(control: Control):
+    """Coerce a fleet-root path into the golden shared-fs transport;
+    pass a real :class:`~trlx_tpu.exp.net.Transport` through. Keeps
+    every pre-transport call site (``read_membership(root)``, tests,
+    bench) working unchanged."""
+    if isinstance(control, str):
+        from trlx_tpu.exp.net import SharedFSTransport
+
+        return SharedFSTransport(control)
+    return control
+
+
+def read_membership(control: Control) -> Optional[Dict[str, Any]]:
+    """The learner's attach record, or None when absent OR unreachable
+    (a worker mid-partition keeps its current epoch and retries)."""
     try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError):
+        return as_control(control).get_record("", MEMBERSHIP_RECORD)
+    except (OSError, ConnectionError):
         return None
 
 
-def read_membership(root: str) -> Optional[Dict[str, Any]]:
-    return _read_json(os.path.join(root, MEMBERSHIP_FILE))
-
-
-def shutdown_requested(root: str) -> bool:
-    return os.path.isfile(os.path.join(root, SHUTDOWN_FILE))
+def shutdown_requested(control: Control) -> bool:
+    """True only on a POSITIVE read of the clean-finish flag — an
+    unreachable control plane must not look like a shutdown order."""
+    try:
+        return (
+            as_control(control).get_record("", SHUTDOWN_RECORD) is not None
+        )
+    except (OSError, ConnectionError):
+        return False
 
 
 def write_worker_record(
-    root: str,
+    control: Control,
     worker_id: str,
     epoch: int,
     weights_version: Optional[int],
     clock: Callable[[], float] = time.time,
     joined_at: Optional[float] = None,
 ) -> None:
-    """Register/heartbeat in one atomic rewrite (registration IS the
-    first heartbeat; a rejoin after eviction is just the next one)."""
+    """Register/heartbeat in one record rewrite (registration IS the
+    first heartbeat; a rejoin after eviction or a hub restart is just
+    the next one). Raises on an unreachable control plane — the beat
+    loop swallows and retries on its own cadence."""
     now = clock()
-    atomic_json_write(
-        os.path.join(root, WORKERS_DIR, f"{worker_id}.json"),
+    as_control(control).put_record(
+        WORKERS_TOPIC, worker_id,
         {
             "worker": worker_id,
             "epoch": int(epoch),
@@ -96,23 +134,32 @@ def write_worker_record(
 
 class WorkerRegistry:
     """The learner-side view: membership epochs, liveness, eviction and
-    flap quarantine. One instance per attached learner."""
+    flap quarantine. One instance per attached learner. ``root`` may be
+    a fleet-directory path (golden shared-fs) or any Transport; every
+    read degrades to empty/False under a control-plane outage so a
+    partition trips the fleet's degrade ladder, not an exception."""
 
     def __init__(
         self,
-        root: str,
+        root: Control,
         worker_ttl_s: float,
         flap_limit: int = 3,
         flap_backoff_s: float = 5.0,
         clock: Callable[[], float] = time.time,
     ):
-        self.root = root
+        self.control = as_control(root)
+        self.root = root if isinstance(root, str) else None
         self.worker_ttl_s = float(worker_ttl_s)
         self.flap_limit = int(flap_limit)
         self.flap_backoff_s = float(flap_backoff_s)
         self._clock = clock
-        os.makedirs(os.path.join(root, WORKERS_DIR), exist_ok=True)
-        os.makedirs(os.path.join(root, QUARANTINE_DIR), exist_ok=True)
+        # golden layout: the workers/ and quarantine/ dirs exist from
+        # attach even before the first record lands
+        if self.root is not None:
+            os.makedirs(os.path.join(self.root, WORKERS_DIR), exist_ok=True)
+            os.makedirs(
+                os.path.join(self.root, QUARANTINE_DIR), exist_ok=True
+            )
         self.epoch = 0
         # flap accounting is learner-side in-memory state: an eviction
         # streak per worker, and how many quarantines it has served
@@ -131,18 +178,20 @@ class WorkerRegistry:
         """Attach this learner: bump the membership epoch. Every worker
         registered under an older epoch re-registers when it sees the
         bump — the re-attach handshake that survives a supervisor
-        relaunch (exit 87 path) without orphaning the fleet."""
-        prev = read_membership(self.root)
+        relaunch (exit 87 path) without orphaning the fleet. Raises if
+        the control plane is unreachable: a learner that cannot attach
+        must not pretend it did."""
+        prev = read_membership(self.control)
         self.epoch = int(prev.get("epoch", 0)) + 1 if prev else 1
-        atomic_json_write(
-            os.path.join(self.root, MEMBERSHIP_FILE),
+        self.control.put_record(
+            "", MEMBERSHIP_RECORD,
             {"epoch": self.epoch, "learner": learner,
              "stamped_at": self._clock()},
         )
         # a previous clean finish must not make re-attached workers exit
         try:
-            os.remove(os.path.join(self.root, SHUTDOWN_FILE))
-        except OSError:
+            self.control.delete_record("", SHUTDOWN_RECORD)
+        except (OSError, ConnectionError):
             pass
         logger.info(
             "fleet membership: learner %r opened epoch %d", learner,
@@ -153,12 +202,16 @@ class WorkerRegistry:
     # -- liveness ---------------------------------------------------------
 
     def worker_records(self) -> Dict[str, Dict[str, Any]]:
+        try:
+            names = self.control.list_records(WORKERS_TOPIC)
+        except (OSError, ConnectionError):
+            return {}
         out: Dict[str, Dict[str, Any]] = {}
-        wdir = os.path.join(self.root, WORKERS_DIR)
-        for entry in sorted(os.listdir(wdir)):
-            if not entry.endswith(".json"):
-                continue
-            rec = _read_json(os.path.join(wdir, entry))
+        for name in sorted(names):
+            try:
+                rec = self.control.get_record(WORKERS_TOPIC, name)
+            except (OSError, ConnectionError):
+                rec = None
             if rec and "worker" in rec:
                 out[rec["worker"]] = rec
         return out
@@ -188,10 +241,8 @@ class WorkerRegistry:
             if age <= self.worker_ttl_s:
                 continue
             try:
-                os.remove(
-                    os.path.join(self.root, WORKERS_DIR, f"{wid}.json")
-                )
-            except OSError:
+                self.control.delete_record(WORKERS_TOPIC, wid)
+            except (OSError, ConnectionError):
                 continue
             if rec.get("epoch") != self.epoch:
                 continue  # stale-epoch leftover, not a live-fleet flap
@@ -209,10 +260,10 @@ class WorkerRegistry:
         and beating but not producing). Flap-tracked like a silent
         eviction; the worker's next beat re-registers it (rejoin)."""
         try:
-            os.remove(
-                os.path.join(self.root, WORKERS_DIR, f"{worker_id}.json")
-            )
-        except OSError:
+            if self.control.get_record(WORKERS_TOPIC, worker_id) is None:
+                return False
+            self.control.delete_record(WORKERS_TOPIC, worker_id)
+        except (OSError, ConnectionError):
             return False
         self.stats["evictions"] += 1
         self._record_flap(worker_id)
@@ -224,9 +275,6 @@ class WorkerRegistry:
 
     # -- flap quarantine --------------------------------------------------
 
-    def _quarantine_path(self, worker_id: str) -> str:
-        return os.path.join(self.root, QUARANTINE_DIR, f"{worker_id}.json")
-
     def _record_flap(self, worker_id: str) -> None:
         streak = self._flap_streak.get(worker_id, 0) + 1
         self._flap_streak[worker_id] = streak
@@ -237,11 +285,17 @@ class WorkerRegistry:
         self._quarantines_served[worker_id] = served + 1
         self._flap_streak[worker_id] = 0  # streak restarts post-quarantine
         self.stats["quarantines"] += 1
-        atomic_json_write(
-            self._quarantine_path(worker_id),
-            {"worker": worker_id, "until": self._clock() + backoff,
-             "flaps": streak, "backoff_s": backoff},
-        )
+        try:
+            self.control.put_record(
+                QUARANTINE_TOPIC, worker_id,
+                {"worker": worker_id, "until": self._clock() + backoff,
+                 "flaps": streak, "backoff_s": backoff},
+            )
+        except (OSError, ConnectionError):
+            logger.error(
+                "fleet membership: quarantine record for %r not "
+                "persisted (control plane unreachable)", worker_id,
+            )
         logger.error(
             "fleet membership: worker %r QUARANTINED for %.3gs (%d "
             "evictions in a row >= flap_limit %d); re-admitted with "
@@ -260,14 +314,19 @@ class WorkerRegistry:
 
     def is_quarantined(self, worker_id: str) -> bool:
         """Quarantine verdict, with expiry = re-admission (the record
-        is removed so a re-admitted worker reads as clean)."""
-        rec = _read_json(self._quarantine_path(worker_id))
+        is removed so a re-admitted worker reads as clean). An
+        unreachable control plane reads as not-quarantined — liveness
+        gating already keeps an unreachable fleet out of dispatch."""
+        try:
+            rec = self.control.get_record(QUARANTINE_TOPIC, worker_id)
+        except (OSError, ConnectionError):
+            return False
         if rec is None:
             return False
         if self._clock() >= rec.get("until", 0.0):
             try:
-                os.remove(self._quarantine_path(worker_id))
-            except OSError:
+                self.control.delete_record(QUARANTINE_TOPIC, worker_id)
+            except (OSError, ConnectionError):
                 pass
             self.stats["readmissions"] += 1
             logger.warning(
@@ -283,7 +342,13 @@ class WorkerRegistry:
         """Clean-finish flag: workers exit 0 when they see it. A
         crashed or stalled learner never writes this, so a surviving
         fleet waits for the relaunch's epoch bump instead."""
-        atomic_json_write(
-            os.path.join(self.root, SHUTDOWN_FILE),
-            {"reason": reason, "stamped_at": self._clock()},
-        )
+        try:
+            self.control.put_record(
+                "", SHUTDOWN_RECORD,
+                {"reason": reason, "stamped_at": self._clock()},
+            )
+        except (OSError, ConnectionError):
+            logger.error(
+                "fleet membership: shutdown flag not persisted (control "
+                "plane unreachable); workers will idle to attach_timeout"
+            )

@@ -34,9 +34,9 @@ import time
 from typing import Any, Dict, List, Optional
 
 from trlx_tpu.fleet import serde
-from trlx_tpu.fleet.broadcast import BroadcastCorrupt, WeightBroadcast
+from trlx_tpu.fleet.broadcast import BroadcastCorrupt, make_broadcast
 from trlx_tpu.fleet.config import FleetConfig
-from trlx_tpu.fleet.coordinator import BROADCAST_DIR, CHUNKS_DIR, DISPATCH_DIR
+from trlx_tpu.fleet.coordinator import CHUNKS_DIR, DISPATCH_DIR
 from trlx_tpu.fleet.membership import (
     read_membership,
     shutdown_requested,
@@ -58,18 +58,29 @@ class FleetWorker:
         max_chunks: Optional[int] = None,
         transport=None,
     ):
-        from trlx_tpu.exp.net import make_transport
+        from trlx_tpu.exp.net import FaultyTransport, make_transport
 
         self.trainer = trainer
         self.root = root
         self.cfg = cfg
-        # chunk assignment/delivery messaging (exp/net.py): must be the
+        # ALL cross-process traffic — chunk assignment/delivery AND the
+        # control plane (membership records, shutdown flag, weight
+        # broadcast) — rides one transport (exp/net.py): must be the
         # SAME backend the learner's coordinator built
         self.transport = transport or make_transport(cfg.transport, root)
+        if trainer.chaos is not None and not isinstance(
+            self.transport, FaultyTransport
+        ):
+            # an armed chaos monkey drives this worker's LINK through
+            # the net_drop / net_partition sites (the per-link fault
+            # injector wraps every transport op this worker makes)
+            self.transport = FaultyTransport(
+                self.transport, chaos=trainer.chaos
+            )
         self.worker_id = worker_id or f"worker-{os.getpid()}"
         self.max_chunks = max_chunks
-        self.broadcast = WeightBroadcast(
-            os.path.join(root, BROADCAST_DIR), keep=cfg.broadcast_keep
+        self.broadcast = make_broadcast(
+            self.transport, keep=cfg.broadcast_keep, chaos=trainer.chaos
         )
         self._held_version: Optional[int] = None
         self._epoch: Optional[int] = None
@@ -92,8 +103,8 @@ class FleetWorker:
         if self._epoch is None or self._beat_pause.is_set():
             return
         write_worker_record(
-            self.root, self.worker_id, self._epoch, self._held_version,
-            joined_at=self._joined_at,
+            self.transport, self.worker_id, self._epoch,
+            self._held_version, joined_at=self._joined_at,
         )
 
     def _beat_loop(self) -> None:
@@ -101,17 +112,22 @@ class FleetWorker:
         while not self._beat_stop.is_set():
             try:
                 self._beat_once()
-            except OSError:
-                pass  # transient shared-fs hiccup: the next beat retries
+            except (OSError, ConnectionError):
+                # transient shared-fs hiccup / tcp drop / hub restart:
+                # the next beat retries — and doubles as the
+                # RE-REGISTRATION that recovers from a hub losing its
+                # volatile records
+                pass
             self._beat_stop.wait(interval)
 
     # -- membership -------------------------------------------------------
 
     def _sync_membership(self) -> bool:
-        """Poll membership.json; on an epoch bump, re-register under
-        the new epoch (the learner-restart handshake). Returns False
-        until a learner has attached at all."""
-        m = read_membership(self.root)
+        """Poll the membership record; on an epoch bump, re-register
+        under the new epoch (the learner-restart handshake). Returns
+        False until a learner has attached at all (an unreachable
+        control plane reads the same: keep polling)."""
+        m = read_membership(self.transport)
         if m is None:
             return False
         epoch = int(m.get("epoch", 0))
@@ -259,21 +275,34 @@ class FleetWorker:
         rollout_batch, rows_local = t._score_and_assemble(
             batch, gen_out, stats, iter_count, Clock()
         )
-        delivered = self.transport.put(
-            CHUNKS_DIR,
-            f"e{chunk_id[0]}_s{chunk_id[1]}",
-            {
-                "chunk_id": list(chunk_id),
-                "policy_version": int(self._held_version or 0),
-                "stats": serde.stats_to_wire(stats),
-                "rows_local": int(rows_local),
-                "post_snapshot": serde.snapshot_to_wire(t._exp_snapshot()),
-                "worker": self.worker_id,
-                "attempt": int(meta.get("attempt", 1)),
-            },
-            serde.rollout_to_arrays(rollout_batch),
-            meta_name="chunk.json",
-        )
+        try:
+            delivered = self.transport.put(
+                CHUNKS_DIR,
+                f"e{chunk_id[0]}_s{chunk_id[1]}",
+                {
+                    "chunk_id": list(chunk_id),
+                    "policy_version": int(self._held_version or 0),
+                    "stats": serde.stats_to_wire(stats),
+                    "rows_local": int(rows_local),
+                    "post_snapshot": serde.snapshot_to_wire(
+                        t._exp_snapshot()
+                    ),
+                    "worker": self.worker_id,
+                    "attempt": int(meta.get("attempt", 1)),
+                },
+                serde.rollout_to_arrays(rollout_batch),
+                meta_name="chunk.json",
+            )
+        except (OSError, ConnectionError) as e:
+            # delivery lost to a partition/hub restart: the attempt is
+            # NOT marked done, so the next poll re-produces this exact
+            # assignment — bit-identical by the replay contract — and
+            # re-posts through the dedup
+            logger.warning(
+                "fleet worker %r: delivery of chunk %s failed (%s); "
+                "will regenerate and re-post", self.worker_id, chunk_id, e,
+            )
+            return
         self._done.add(
             f"e{chunk_id[0]}_s{chunk_id[1]}_a{int(meta.get('attempt', 1))}"
         )
@@ -289,7 +318,7 @@ class FleetWorker:
     def run(self) -> int:
         deadline = time.time() + self.cfg.attach_timeout_s
         while not self._sync_membership():
-            if shutdown_requested(self.root):
+            if shutdown_requested(self.transport):
                 return 0
             if time.time() >= deadline:
                 logger.error(
@@ -303,15 +332,38 @@ class FleetWorker:
             target=self._beat_loop, name="fleet-beat", daemon=True
         )
         beat_thread.start()
+        last_attached = time.time()
         try:
             while True:
-                if shutdown_requested(self.root):
+                if shutdown_requested(self.transport):
                     logger.info(
                         "fleet worker %r: learner signalled shutdown "
                         "after %d chunks", self.worker_id, self._produced,
                     )
                     return 0
-                self._sync_membership()
+                if self._sync_membership():
+                    last_attached = time.time()
+                elif (
+                    time.time() - last_attached
+                    >= self.cfg.detach_timeout_s
+                ):
+                    # the control plane has been GONE (membership
+                    # unreadable/absent) for the whole window: a
+                    # learner restart or hub relaunch would have
+                    # re-registered us long ago. The likeliest story
+                    # is a learner that finished and closed its hosted
+                    # hub while our link was partitioned — its
+                    # shutdown flag died with the hub — so exit CLEAN:
+                    # the delivered chunks are this worker's durable
+                    # output either way
+                    logger.warning(
+                        "fleet worker %r: control plane unreachable "
+                        "for detach_timeout_s=%g after %d chunks — "
+                        "assuming the learner is gone; exiting clean",
+                        self.worker_id, self.cfg.detach_timeout_s,
+                        self._produced,
+                    )
+                    return 0
                 assignment = self._next_assignment()
                 if assignment is None:
                     time.sleep(self.cfg.poll_s)

@@ -4351,6 +4351,13 @@ class TPUOnlineTrainer(TPUBaseTrainer):
         from trlx_tpu.fleet import serde as fleet_serde
 
         fleet, cfg, exp = self._fleet, self._fleet_cfg, self._exp
+        if self.chaos is not None and self.chaos.consult("hub_crash"):
+            # chaos: the transport hub dies and is relaunched EMPTY
+            # before this production — workers re-register on their
+            # next beat, this chunk's dispatch gets a fresh attempt
+            # number, and any in-flight delivery re-posts through the
+            # dedup
+            fleet.crash_hub()
         # publish before the readiness gate: workers that are still
         # attaching need the snapshot to produce anything at all. But a
         # DEGRADED fleet with no registered workers at all has no
@@ -4404,7 +4411,10 @@ class TPUOnlineTrainer(TPUBaseTrainer):
         attempt = fleet.next_attempt(chunk_id)
         valid_attempts = {attempt}
         exp.reassign(lease, worker)
-        fleet.dispatch(chunk_id, attempt, worker, wire_meta, arrays)
+        if not fleet.dispatch(chunk_id, attempt, worker, wire_meta, arrays):
+            return degrade_dispatched(
+                f"transport outage dispatching chunk {chunk_id}"
+            )
         deadline = _time.time() + cfg.dispatch_timeout_s
         # delivery is polled every tick, but the membership scan
         # (dir listing + one JSON parse per worker record) only needs
@@ -4455,7 +4465,12 @@ class TPUOnlineTrainer(TPUBaseTrainer):
                 attempt = fleet.next_attempt(chunk_id)
                 valid_attempts.add(attempt)
                 exp.reassign(lease, worker)
-                fleet.dispatch(chunk_id, attempt, worker, wire_meta, arrays)
+                if not fleet.dispatch(
+                    chunk_id, attempt, worker, wire_meta, arrays
+                ):
+                    return degrade_dispatched(
+                        f"transport outage re-dispatching chunk {chunk_id}"
+                    )
                 deadline = _time.time() + cfg.dispatch_timeout_s
                 continue
             if _time.time() >= deadline:

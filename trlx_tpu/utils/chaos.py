@@ -142,6 +142,43 @@ to an exact cycle/call):
                   delivery exactly-once; consulted once per result-post
                   attempt.
 
+  Network / control-plane sites (``exp/net.py`` FaultyTransport +
+  the tcp fleet; the worker's transport is wrapped in the per-link
+  fault injector whenever chaos is armed):
+  net_drop        ONE transport op on the worker's link raises
+                  ConnectionError (the frame is lost on the wire);
+                  client retry/backoff plus the put dedup must
+                  converge to exactly-once; consulted in the worker's
+                  FaultyTransport, once per attempted op on a live
+                  link. NOTE: beat threads and poll loops make op
+                  counts at this seam timing-dependent — schedules
+                  should use ``p:`` or small ``at:`` values and
+                  assertions should target the recovery behavior, not
+                  exact counts.
+  net_partition   the worker's LINK goes down for ``stall_delay``
+                  seconds: every op fails fast, beats stop landing,
+                  the learner evicts + re-dispatches, and the worker
+                  rejoins when the link heals (late deliveries dedup
+                  away); consulted alongside ``net_drop``, with the
+                  same timing caveat.
+  hub_crash       the tcp transport hub loses ALL volatile state and
+                  restarts (what a supervised hub relaunch looks
+                  like): workers re-register on their next beat, the
+                  learner re-stamps the membership epoch and
+                  re-dispatches with fresh attempt numbers, in-flight
+                  deliveries re-post through the dedup; consulted in
+                  the learner, once per fleet chunk production
+                  (no-op on shared-fs / external-hub fleets).
+  broadcast_torn_fetch  one weight-snapshot CHUNK transfer is torn
+                  mid-fetch: the per-chunk sha256 resume cache means
+                  the retry refetches ONLY the missing chunk, and a
+                  snapshot that stays torn keeps the previous version
+                  (chunks then flow through the ``exp.staleness``
+                  gate, exactly like ``broadcast_corrupt``); consulted
+                  in the worker's ChunkedBroadcast, once per chunk
+                  actually read off the transport (cache hits skip —
+                  they cost no network).
+
 Schedule entries select by count: ``{"fault": "nan_loss", "at": 2}``
 fires on the 2nd consult (1-based), ``{"fault": ..., "at": 2, "span": 3}``
 on consults 2..4, and ``{"fault": ..., "every": 5}`` on every 5th.
@@ -201,6 +238,11 @@ FAULT_SITES = (
     "serve_request_timeout",
     "serve_lane_starvation",
     "serve_transport_drop",
+    # network / control-plane sites (appended, same reason)
+    "net_drop",
+    "net_partition",
+    "hub_crash",
+    "broadcast_torn_fetch",
 )
 
 
